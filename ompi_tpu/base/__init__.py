@@ -1,0 +1,20 @@
+"""Foundation layer (OPAL equivalent).
+
+TPU-native re-design of the reference foundation layer
+(``/root/reference/opal/``): the typed var/config registry
+(``opal/mca/base/mca_base_var.c``), the MCA component architecture
+(``opal/mca/base/mca_base_framework.h``), output/verbosity streams and
+aggregated help (``opal/util/output.h``, ``opal/util/show_help.h``),
+container classes (``opal/class/``), and timers (``opal/mca/timer/``).
+"""
+from ompi_tpu.base.var import (  # noqa: F401
+    VarRegistry,
+    Var,
+    VarSource,
+    VarType,
+    Pvar,
+    PvarClass,
+    registry,
+)
+from ompi_tpu.base.mca import Component, Framework, framework  # noqa: F401
+from ompi_tpu.base.output import set_verbosity, show_help  # noqa: F401

@@ -1,0 +1,8 @@
+"""MCA component frameworks (plugin points).
+
+Each subpackage is one framework (``coll``, ``pml``, ``btl``, ``osc``, ``io``,
+``topo``, ``op``, ``accelerator``, ...); each module inside exports a
+``COMPONENT`` object discovered by ``ompi_tpu.base.mca.Framework.discover``,
+the analog of the reference's dlopen component repository
+(``/root/reference/opal/mca/base/mca_base_component_repository.c:420``).
+"""

@@ -1,0 +1,43 @@
+"""Test harness: run everything on the XLA CPU backend with 8 virtual devices.
+
+This is the "fake multi-device backend" the reference never had (SURVEY.md §4):
+single-host N-rank testing the way Open MPI uses ``mpirun -n 8
+--oversubscribe`` over btl/self+sm.  Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon boot hook (sitecustomize) forces jax_platforms=axon; override it
+# before any backend initialization so tests always see 8 CPU devices.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fresh_registry():
+    """Isolated var registry state for config-system tests."""
+    from ompi_tpu.base import mca, output, var
+
+    saved_vars = dict(var.registry._vars)
+    saved_alias = dict(var.registry._alias)
+    saved_pvars = dict(var.registry._pvars)
+    saved_file = dict(var.registry._file)
+    saved_loaded = var.registry._files_loaded
+    yield var.registry
+    var.registry._vars = saved_vars
+    var.registry._alias = saved_alias
+    var.registry._pvars = saved_pvars
+    var.registry._file = saved_file
+    var.registry._files_loaded = saved_loaded
+    var.registry._cli.clear()
+    var.registry._deprecation_warned.clear()
+    output._help_seen.clear()

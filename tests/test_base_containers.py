@@ -1,0 +1,92 @@
+"""Container tests mirroring the reference unit suite (test/class, SURVEY.md §4)."""
+import time
+
+from ompi_tpu.base.containers import (
+    Bitmap,
+    Fifo,
+    Graph,
+    Hotel,
+    IntervalTree,
+    Lifo,
+    PointerArray,
+    RingBuffer,
+)
+
+
+def test_fifo_order():
+    f = Fifo()
+    for i in range(5):
+        f.push(i)
+    assert [f.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert f.pop() is None
+
+
+def test_lifo_order():
+    s = Lifo()
+    for i in range(5):
+        s.push(i)
+    assert [s.pop() for _ in range(5)] == [4, 3, 2, 1, 0]
+
+
+def test_pointer_array_reuse():
+    pa = PointerArray(lowest_free=2)
+    i = pa.add("x")
+    assert i == 2
+    j = pa.add("y")
+    pa.remove(i)
+    k = pa.add("z")
+    assert k == i  # index reuse
+    assert pa.get(j) == "y"
+    assert dict(iter(pa)) == {j: "y", k: "z"}
+
+
+def test_bitmap():
+    b = Bitmap(8)
+    b.set(3)
+    b.set(7)
+    assert b.is_set(3) and not b.is_set(4)
+    assert list(b) == [3, 7]
+    assert b.find_and_set_first_unset() == 0
+    b.clear(3)
+    assert not b.is_set(3)
+    b.set_all()
+    assert b.popcount() == 8
+
+
+def test_ring_buffer_overwrites():
+    r = RingBuffer(3)
+    for i in range(5):
+        r.push(i)
+    assert r.snapshot() == [2, 3, 4]
+
+
+def test_hotel_checkin_checkout_evict():
+    evicted = []
+    h = Hotel(2, eviction_s=0.0, on_evict=lambda room, occ: evicted.append(occ))
+    r1 = h.checkin("a")
+    r2 = h.checkin("b")
+    assert h.checkin("c") == -1  # full
+    assert h.checkout(r1) == "a"
+    h.sweep(now=time.monotonic() + 1)
+    assert evicted == ["b"]
+    assert len(h) == 0
+
+
+def test_interval_tree():
+    t = IntervalTree()
+    t.insert(0, 100, "big")
+    t.insert(10, 20, "small")
+    assert {v for *_, v in t.find_overlapping(15, 30)} == {"big", "small"}
+    assert t.find_containing(12, 18)[2] == "small"  # smallest containing
+    assert t.find_containing(50, 60)[2] == "big"
+    t.delete(10, 20)
+    assert t.find_containing(12, 18)[2] == "big"
+
+
+def test_graph_shortest_path():
+    g = Graph()
+    g.add_edge("a", "b", 1)
+    g.add_edge("b", "c", 1)
+    g.add_edge("a", "c", 5)
+    assert g.shortest_path("a", "c") == ["a", "b", "c"]
+    assert g.shortest_path("c", "a") is None

@@ -1,0 +1,87 @@
+"""MCA framework/component selection tests (SURVEY.md §2.1 MCA base)."""
+import pytest
+
+from ompi_tpu.base.mca import Component, Framework
+from ompi_tpu.base.var import registry
+
+
+class _C(Component):
+    def __init__(self, name, priority, openable=True, queryable=True):
+        self.name = name
+        self.priority = priority
+        self._openable = openable
+        self._queryable = queryable
+        super().__init__()
+        self.closed = False
+
+    def open(self):
+        return self._openable
+
+    def init_query(self):
+        return self if self._queryable else None
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def fw(fresh_registry):
+    f = Framework("tfw" + str(id(object())))  # unique name per test
+    yield f
+    f.close()
+
+
+def test_priority_selection(fw):
+    lo = fw.register(_C("lo", 10))
+    hi = fw.register(_C("hi", 50))
+    assert fw.select() is hi
+    assert fw.select_all() == [hi, lo]
+
+
+def test_failed_open_disqualifies(fw):
+    fw.register(_C("bad", 90, openable=False))
+    good = fw.register(_C("good", 10))
+    assert fw.select() is good
+
+
+def test_query_none_disqualifies(fw):
+    fw.register(_C("shy", 90, queryable=False))
+    good = fw.register(_C("good", 10))
+    assert fw.select() is good
+
+
+def test_include_list(fresh_registry):
+    f = Framework("tfwinc")
+    a, b = f.register(_C("a", 10)), f.register(_C("b", 90))
+    f.select_var.set("a")
+    assert f.select() is a
+    f.close()
+
+
+def test_exclude_list(fresh_registry):
+    f = Framework("tfwexc")
+    a, b = f.register(_C("a", 10)), f.register(_C("b", 90))
+    f.select_var.set("^b")
+    assert f.select() is a
+    f.close()
+
+
+def test_mixed_include_exclude_rejected(fresh_registry):
+    f = Framework("tfwmix")
+    f.register(_C("a", 10))
+    f.select_var.set("a,^b")
+    with pytest.raises(ValueError):
+        f.open()
+
+
+def test_close_calls_components(fresh_registry):
+    f = Framework("tfwcls")
+    c = f.register(_C("a", 10))
+    f.select()
+    f.close()
+    assert c.closed and not f.opened
+
+
+def test_verbose_var_registered(fresh_registry):
+    Framework("tfwverb")
+    assert registry.lookup("otpu_tfwverb_base_verbose") is not None

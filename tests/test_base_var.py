@@ -1,0 +1,126 @@
+"""Var registry tests (config/flag subsystem, SURVEY.md §5.6)."""
+import os
+
+import pytest
+
+from ompi_tpu.base.var import (
+    Pvar,
+    PvarClass,
+    VarScope,
+    VarSource,
+    VarType,
+    registry,
+)
+
+
+def test_register_and_default(fresh_registry):
+    v = registry.register("testfw", "comp", "limit", vtype=VarType.INT, default=42)
+    assert v.name == "otpu_testfw_comp_limit"
+    assert v.value == 42
+    assert v.source is VarSource.DEFAULT
+
+
+def test_size_suffixes(fresh_registry):
+    v = registry.register("testfw", "comp", "eager", vtype=VarType.SIZE, default="64k")
+    assert v.value == 65536
+    v.set("4m")
+    assert v.value == 4 << 20
+    assert v.source is VarSource.API
+
+
+def test_bool_parsing(fresh_registry):
+    v = registry.register("testfw", "comp", "flag", vtype=VarType.BOOL, default="no")
+    assert v.value is False
+    v.set("yes")
+    assert v.value is True
+    with pytest.raises(ValueError):
+        v.set("maybe")
+
+
+def test_env_beats_default(fresh_registry, monkeypatch):
+    monkeypatch.setenv("OTPU_MCA_testfw_comp_envy", "7")
+    v = registry.register("testfw", "comp", "envy", vtype=VarType.INT, default=1)
+    assert v.value == 7
+    assert v.source is VarSource.ENV
+    assert "OTPU_MCA" in v.source_detail
+
+
+def test_cli_beats_env(fresh_registry, monkeypatch):
+    monkeypatch.setenv("OTPU_MCA_testfw_comp_clash", "7")
+    rest = registry.parse_cli(["prog", "--mca", "testfw_comp_clash", "9", "arg"])
+    assert rest == ["prog", "arg"]
+    v = registry.register("testfw", "comp", "clash", vtype=VarType.INT, default=1)
+    assert v.value == 9
+    assert v.source is VarSource.CLI
+
+
+def test_param_file(fresh_registry, tmp_path, monkeypatch):
+    f = tmp_path / "params.conf"
+    f.write_text("# comment\notpu_testfw_comp_filed = 123\n")
+    monkeypatch.setenv("OTPU_PARAM_FILES", str(f))
+    registry._files_loaded = False
+    registry._file.clear()
+    v = registry.register("testfw", "comp", "filed", vtype=VarType.INT, default=0)
+    assert v.value == 123
+    assert v.source is VarSource.FILE
+    assert str(f) in v.source_detail
+
+
+def test_enum_var(fresh_registry):
+    v = registry.register(
+        "testfw", "comp", "mode",
+        enum_values={"eager": 0, "rndv": 1}, default="eager",
+    )
+    v.set("rndv")
+    assert v.value == "rndv"
+    v.set(0)  # by integer value
+    assert v.value == "eager"
+    with pytest.raises(ValueError):
+        v.set("bogus")
+
+
+def test_alias(fresh_registry, monkeypatch):
+    monkeypatch.setenv("OTPU_MCA_oldname", "5")
+    v = registry.register("testfw", "comp", "newname", vtype=VarType.INT,
+                          default=1, aliases=("otpu_oldname",))
+    assert v.value == 5
+    assert registry.lookup("otpu_oldname") is v
+
+
+def test_constant_scope_rejects_set(fresh_registry):
+    v = registry.register("testfw", "comp", "const", vtype=VarType.INT,
+                          default=3, scope=VarScope.CONSTANT)
+    v.set(9)
+    assert v.value == 3
+
+
+def test_reflection(fresh_registry):
+    registry.register("alpha", "x", "a", default="1")
+    registry.register("alpha", "y", "b", default="2")
+    registry.register("beta", "z", "c", default="3")
+    assert len(registry.all_vars("alpha")) == 2
+    names = [v.name for v in registry.all_vars()]
+    assert names == sorted(names)
+
+
+def test_pvar_counter_and_watermark(fresh_registry):
+    c = registry.register_pvar("pml", "base", "bytes_sent",
+                               pclass=PvarClass.COUNTER)
+    c.add(10)
+    c.add(5)
+    assert c.read() == 15
+    c.reset()
+    assert c.read() == 0
+    hw = registry.register_pvar("pml", "base", "max_unexpected",
+                                pclass=PvarClass.HIGHWATERMARK)
+    hw.set(4)
+    hw.set(2)
+    assert hw.read() == 4
+
+
+def test_on_set_callback(fresh_registry):
+    seen = []
+    v = registry.register("testfw", "comp", "cb", vtype=VarType.INT, default=1,
+                          on_set=seen.append)
+    v.set(5)
+    assert seen[-1] == 5
