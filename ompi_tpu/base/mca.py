@@ -161,8 +161,7 @@ class Framework:
                 self.open()
             candidates = []
             for comp in self.available:
-                mod = comp.init_query()
-                if mod is not None:
+                if self._query(comp) is not None:
                     candidates.append((comp.priority, comp))
             candidates.sort(key=lambda t: t[0], reverse=True)
             self.selected = candidates[0][1] if candidates else None
@@ -171,12 +170,21 @@ class Framework:
                                self.selected.name)
             return self.selected
 
+    def _query(self, comp: Component):
+        """init_query with the same failure-is-disqualification policy as open."""
+        try:
+            return comp.init_query()
+        except Exception as exc:
+            _output.output(self.stream, 1, "component %s failed init_query: %s",
+                           comp.name, exc)
+            return None
+
     def select_all(self) -> list[Component]:
         """All available components in descending priority (multi-select fws)."""
         with self._lock:
             if not self.opened:
                 self.open()
-            out = [c for c in self.available if c.init_query() is not None]
+            out = [c for c in self.available if self._query(c) is not None]
             out.sort(key=lambda c: c.priority, reverse=True)
             return out
 

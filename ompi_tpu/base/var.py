@@ -364,6 +364,7 @@ class VarRegistry:
             if n in self._file:
                 val, path = self._file[n]
                 self._set_external(var, val, VarSource.FILE, path)
+                self._maybe_warn(var, path)
         for n in self._resolve_names(var):
             env_name = ENV_PREFIX + n.removeprefix("otpu_")
             if env_name in os.environ:

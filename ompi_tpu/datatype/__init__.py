@@ -1,0 +1,59 @@
+"""Datatype engine: described-layout memory + stateful pack/unpack convertor.
+
+TPU-native re-design of the reference datatype stack
+(``/root/reference/opal/datatype/`` — 8,249 LoC — and ``ompi/datatype/``):
+MPI named types and the full constructor set build a *type map* that is
+flattened and coalesced into elementary segments
+(``opal_datatype_optimize.c`` equivalent); the :class:`Convertor` is the
+stateful pack/unpack iterator with partial-buffer resume and repositioning
+(``opal_convertor.c`` — 780 lines; ``opal_datatype_pack.c`` state machine),
+plus heterogeneous/external32 conversion and checksums.  TPU-first additions:
+``bfloat16``/``float16`` as first-class named types, and device-residency
+flags on the convertor (the analog of ``CONVERTOR_CUDA``,
+``opal_convertor.h:50-57``) so device buffers route to the XLA path instead
+of host pack/unpack.
+"""
+from ompi_tpu.datatype.core import (  # noqa: F401
+    Datatype,
+    BYTE,
+    PACKED,
+    BOOL,
+    INT8,
+    INT16,
+    INT32,
+    INT64,
+    UINT8,
+    UINT16,
+    UINT32,
+    UINT64,
+    FLOAT16,
+    BFLOAT16,
+    FLOAT32,
+    FLOAT64,
+    COMPLEX64,
+    COMPLEX128,
+    FLOAT_INT,
+    DOUBLE_INT,
+    LONG_INT,
+    SHORT_INT,
+    TWO_INT,
+    NAMED_TYPES,
+    from_numpy_dtype,
+    contiguous,
+    vector,
+    hvector,
+    indexed,
+    hindexed,
+    indexed_block,
+    create_struct,
+    subarray,
+    darray,
+    resized,
+    ORDER_C,
+    ORDER_FORTRAN,
+    DISTRIBUTE_BLOCK,
+    DISTRIBUTE_CYCLIC,
+    DISTRIBUTE_NONE,
+    DISTRIBUTE_DFLT_DARG,
+)
+from ompi_tpu.datatype.convertor import Convertor, ConvertorFlags  # noqa: F401

@@ -28,12 +28,19 @@ def fresh_registry():
     from ompi_tpu.base import mca, output, var
 
     saved_vars = dict(var.registry._vars)
+    saved_state = {
+        name: (v._value, v._source, v._source_detail)
+        for name, v in saved_vars.items()
+    }
     saved_alias = dict(var.registry._alias)
     saved_pvars = dict(var.registry._pvars)
     saved_file = dict(var.registry._file)
     saved_loaded = var.registry._files_loaded
     yield var.registry
     var.registry._vars = saved_vars
+    for name, (val, src, detail) in saved_state.items():
+        v = saved_vars[name]
+        v._value, v._source, v._source_detail = val, src, detail
     var.registry._alias = saved_alias
     var.registry._pvars = saved_pvars
     var.registry._file = saved_file
