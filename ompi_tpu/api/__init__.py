@@ -1,0 +1,2 @@
+"""MPI-semantics API layer (``/root/reference/ompi/`` core objects +
+``ompi/mpi/c`` bindings collapsed into Pythonic classes)."""

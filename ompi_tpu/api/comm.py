@@ -1,0 +1,479 @@
+"""Communicators: group + CID + per-comm collective vtable + p2p dispatch.
+
+Re-design of ``/root/reference/ompi/communicator/communicator.h`` /
+``comm.c`` / ``comm_cid.c``: a communicator owns its group, a context id
+agreed across members (``comm_cid.c:53-93``; carries an FT epoch ``:78``),
+and a per-comm collective vtable ``c_coll`` filled by priority vote of the
+coll components (``coll_base_comm_select.c``).  Point-to-point dispatches to
+the selected pml module the way ``MPI_Send`` does
+(``ompi/mpi/c/send.c:93`` → ``MCA_PML_CALL``).  ULFM state (revoked flag,
+failure checks before communication, ``comm_ft.c``) is carried here.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.attributes import AttributeHost
+from ompi_tpu.api.errhandler import ERRORS_ARE_FATAL, Errhandler
+from ompi_tpu.api.errors import ErrorClass, MpiError, RevokedError
+from ompi_tpu.api.group import Group
+from ompi_tpu.api.info import Info
+from ompi_tpu.api.request import Request, waitall
+from ompi_tpu.api.status import ANY_SOURCE, ANY_TAG, PROC_NULL, Status
+from ompi_tpu.datatype import Datatype, from_numpy_dtype
+
+# collective function slots a coll module can fill (``mca/coll/coll.h``
+# module struct equivalent; *_array are the TPU device-buffer entry points)
+COLL_FUNCTIONS = (
+    "barrier", "bcast", "gather", "gatherv", "scatter", "scatterv",
+    "allgather", "allgatherv", "alltoall", "alltoallv", "alltoallw",
+    "reduce", "allreduce", "reduce_scatter", "reduce_scatter_block",
+    "scan", "exscan",
+    "ibarrier", "ibcast", "igather", "iscatter", "iallgather", "ialltoall",
+    "ireduce", "iallreduce", "ireduce_scatter", "iscan", "iexscan",
+    "allreduce_array", "bcast_array", "allgather_array",
+    "reduce_scatter_array", "alltoall_array", "ppermute_array",
+    "psum_scatter_array", "reduce_array", "gather_array", "scatter_array",
+    "device_barrier",
+    "agree", "iagree",
+    "neighbor_allgather", "neighbor_alltoall",
+)
+
+
+def as_buffer(buf) -> tuple[np.ndarray, int, Datatype]:
+    """Normalize a user buffer to (ndarray, count, datatype).
+
+    Accepts an ndarray (count/type inferred), or an explicit
+    ``(ndarray, count, Datatype)`` triple for derived layouts.
+    """
+    if isinstance(buf, tuple):
+        arr, count, dt = buf
+        return np.asarray(arr), count, dt
+    arr = np.asarray(buf)
+    return arr, arr.size, from_numpy_dtype(arr.dtype)
+
+
+class Comm(AttributeHost):
+    _cid_lock = threading.Lock()
+
+    def __init__(
+        self,
+        group: Group,
+        cid: int,
+        rte,
+        name: str = "",
+        epoch: int = 0,
+        parent: Optional["Comm"] = None,
+        remote_group: Optional[Group] = None,
+    ) -> None:
+        self.group = group
+        self.cid = cid
+        self.epoch = epoch  # FT epoch: revoked CIDs can't be confused on reuse
+        self.rte = rte
+        self.name = name or f"comm#{cid}"
+        self.c_coll: dict[str, Any] = {}
+        self.coll_modules: list = []
+        self.errhandler: Errhandler = ERRORS_ARE_FATAL
+        self.info = Info()
+        self.topo = None          # set by topo framework (cart/graph/dist_graph)
+        self.revoked = False
+        self.freed = False
+        self.remote_group = remote_group  # inter-communicator remote side
+        self.pml = None           # selected pml module (set at selection time)
+        self._rank = group.rank_of(rte.my_world_rank) if rte else 0
+        if parent is not None:
+            self.errhandler = parent.errhandler
+
+    # -- accessors -------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    @property
+    def is_inter(self) -> bool:
+        return self.remote_group is not None
+
+    @property
+    def remote_size(self) -> int:
+        return self.remote_group.size if self.remote_group else 0
+
+    def world_rank(self, rank: int) -> int:
+        return self.group.world_rank(rank)
+
+    def as_rank(self, rank: int) -> "Comm":
+        """Conductor-model facade: this communicator acting as ``rank``.
+
+        In the device-world (single-controller) model the one process hosts
+        every rank; p2p issued through ``as_rank(i)`` carries i as the
+        source — the in-process analog of ``mpirun --oversubscribe`` rank
+        multiplexing.  Shares all communicator state with self.
+        """
+        import copy
+
+        if not 0 <= rank < self.size:
+            raise MpiError(ErrorClass.ERR_RANK, f"invalid rank {rank}")
+        view = copy.copy(self)
+        view._rank = rank
+        return view
+
+    def get_name(self) -> str:
+        return self.name
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def set_errhandler(self, eh: Errhandler) -> None:
+        self.errhandler = eh
+
+    def _check_state(self, peer: Optional[int] = None) -> None:
+        if self.freed:
+            raise MpiError(ErrorClass.ERR_COMM, "communicator was freed")
+        if self.revoked:
+            self._err(RevokedError(f"{self.name} revoked"))
+        if peer is not None and peer not in (ANY_SOURCE, PROC_NULL):
+            if not 0 <= peer < (self.remote_size if self.is_inter else self.size):
+                raise MpiError(ErrorClass.ERR_RANK, f"invalid rank {peer}")
+            # ULFM early liveness check (send.c:84)
+            from ompi_tpu.ft import state as ft_state
+
+            if ft_state.is_failed(self.world_rank(peer)):
+                from ompi_tpu.api.errors import ProcFailedError
+
+                self._err(ProcFailedError(
+                    f"peer {peer} has failed", (peer,)))
+
+    def _err(self, error: MpiError) -> None:
+        self.errhandler.invoke(self, error)
+        raise error  # ERRORS_RETURN handler already raised; fatal aborts
+
+    # -- coll dispatch ---------------------------------------------------
+    def _coll(self, name: str):
+        fn = self.c_coll.get(name)
+        if fn is None:
+            raise MpiError(
+                ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                f"no coll component provides '{name}' on {self.name}")
+        return fn
+
+    # blocking host collectives (numpy buffers) -------------------------
+    def barrier(self) -> None:
+        self._check_state()
+        self._coll("barrier")(self)
+
+    def bcast(self, buf, root: int = 0):
+        self._check_state()
+        return self._coll("bcast")(self, buf, root)
+
+    def reduce(self, sendbuf, op: op_mod.Op = op_mod.SUM, root: int = 0):
+        self._check_state()
+        return self._coll("reduce")(self, sendbuf, op, root)
+
+    def allreduce(self, sendbuf, op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("allreduce")(self, sendbuf, op)
+
+    def gather(self, sendbuf, root: int = 0):
+        self._check_state()
+        return self._coll("gather")(self, sendbuf, root)
+
+    def gatherv(self, sendbuf, root: int = 0):
+        self._check_state()
+        return self._coll("gatherv")(self, sendbuf, root)
+
+    def scatter(self, sendbuf, root: int = 0):
+        self._check_state()
+        return self._coll("scatter")(self, sendbuf, root)
+
+    def scatterv(self, sendbufs, root: int = 0):
+        self._check_state()
+        return self._coll("scatterv")(self, sendbufs, root)
+
+    def allgather(self, sendbuf):
+        self._check_state()
+        return self._coll("allgather")(self, sendbuf)
+
+    def allgatherv(self, sendbuf):
+        self._check_state()
+        return self._coll("allgatherv")(self, sendbuf)
+
+    def alltoall(self, sendbuf):
+        self._check_state()
+        return self._coll("alltoall")(self, sendbuf)
+
+    def alltoallv(self, sendbufs):
+        self._check_state()
+        return self._coll("alltoallv")(self, sendbufs)
+
+    def reduce_scatter(self, sendbuf, recvcounts=None,
+                       op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("reduce_scatter")(self, sendbuf, recvcounts, op)
+
+    def scan(self, sendbuf, op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("scan")(self, sendbuf, op)
+
+    def exscan(self, sendbuf, op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("exscan")(self, sendbuf, op)
+
+    # nonblocking variants ----------------------------------------------
+    def ibarrier(self) -> Request:
+        self._check_state()
+        return self._coll("ibarrier")(self)
+
+    def ibcast(self, buf, root: int = 0) -> Request:
+        self._check_state()
+        return self._coll("ibcast")(self, buf, root)
+
+    def iallreduce(self, sendbuf, op: op_mod.Op = op_mod.SUM) -> Request:
+        self._check_state()
+        return self._coll("iallreduce")(self, sendbuf, op)
+
+    def iallgather(self, sendbuf) -> Request:
+        self._check_state()
+        return self._coll("iallgather")(self, sendbuf)
+
+    def ialltoall(self, sendbuf) -> Request:
+        self._check_state()
+        return self._coll("ialltoall")(self, sendbuf)
+
+    def ireduce(self, sendbuf, op: op_mod.Op = op_mod.SUM,
+                root: int = 0) -> Request:
+        self._check_state()
+        return self._coll("ireduce")(self, sendbuf, op, root)
+
+    # device-array collectives (jax.Array over the ICI mesh) ------------
+    def allreduce_array(self, x, op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("allreduce_array")(self, x, op)
+
+    def bcast_array(self, x, root: int = 0):
+        self._check_state()
+        return self._coll("bcast_array")(self, x, root)
+
+    def allgather_array(self, x):
+        self._check_state()
+        return self._coll("allgather_array")(self, x)
+
+    def reduce_scatter_array(self, x, op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("reduce_scatter_array")(self, x, op)
+
+    def alltoall_array(self, x):
+        self._check_state()
+        return self._coll("alltoall_array")(self, x)
+
+    def ppermute_array(self, x, perm: Sequence[tuple]):
+        self._check_state()
+        return self._coll("ppermute_array")(self, x, perm)
+
+    # -- p2p dispatch (→ selected pml, like MCA_PML_CALL) ---------------
+    def send(self, buf, dest: int, tag: int = 0) -> None:
+        self._check_state(dest)
+        if dest == PROC_NULL:
+            return
+        self.pml.send(self, buf, dest, tag)
+
+    def recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Status:
+        self._check_state(source)
+        if source == PROC_NULL:
+            return Status(source=PROC_NULL, tag=ANY_TAG)
+        return self.pml.recv(self, buf, source, tag)
+
+    def isend(self, buf, dest: int, tag: int = 0) -> Request:
+        self._check_state(dest)
+        return self.pml.isend(self, buf, dest, tag)
+
+    def irecv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
+        self._check_state(source)
+        return self.pml.irecv(self, buf, source, tag)
+
+    def sendrecv(self, sendbuf, dest: int, recvbuf, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG) -> Status:
+        self._check_state(dest)
+        sreq = self.isend(sendbuf, dest, sendtag) if dest != PROC_NULL else None
+        st = self.recv(recvbuf, source, recvtag)
+        if sreq is not None:
+            sreq.wait()
+        return st
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Status:
+        self._check_state(source)
+        return self.pml.probe(self, source, tag, blocking=True)
+
+    def iprobe(self, source: int = ANY_SOURCE,
+               tag: int = ANY_TAG) -> tuple[bool, Optional[Status]]:
+        self._check_state(source)
+        return self.pml.probe(self, source, tag, blocking=False)
+
+    def mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        self._check_state(source)
+        return self.pml.mprobe(self, source, tag, blocking=True)
+
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        self._check_state(source)
+        return self.pml.mprobe(self, source, tag, blocking=False)
+
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        import pickle
+
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        hdr = np.array([payload.size], dtype=np.int64)
+        self.send(hdr, dest, tag)
+        self.send(payload, dest, tag)
+
+    def recv_obj(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Any:
+        import pickle
+
+        hdr = np.zeros(1, dtype=np.int64)
+        st = self.recv(hdr, source, tag)
+        payload = np.zeros(int(hdr[0]), dtype=np.uint8)
+        self.recv(payload, st.source, tag)
+        return pickle.loads(payload.tobytes())
+
+    # -- management ------------------------------------------------------
+    def _next_cid(self) -> int:
+        """Agree on the next free CID across members (``comm_cid.c:53``).
+
+        The reference runs a multi-round allreduce agreement; here each
+        member proposes its local next-free id and the group takes the MAX —
+        one allreduce round over the parent (FT epoch rides along).
+        """
+        from ompi_tpu.runtime import init as rt
+
+        local = rt.next_local_cid()
+        if self.rte is not None and self.rte.is_device_world:
+            # conductor model: every co-located rank proposes the same id
+            proposal = np.full((self.size, 1), local, dtype=np.int64)
+        else:
+            proposal = np.array([local], dtype=np.int64)
+        agreed = self.allreduce(proposal, op_mod.MAX)
+        cid = int(np.asarray(agreed).ravel()[0])
+        rt.reserve_cid(cid)
+        return cid
+
+    def dup(self) -> "Comm":
+        self._check_state()
+        newcomm = Comm(self.group, self._next_cid(), self.rte,
+                       name=f"{self.name}~dup", epoch=self.epoch, parent=self)
+        self._attrs_copy_to(newcomm)
+        newcomm.info = self.info.dup()
+        self._finish_create(newcomm)
+        return newcomm
+
+    def split(self, color, key=0) -> Optional["Comm"]:
+        """``MPI_Comm_split``.
+
+        Multi-process model: each rank passes its (color, key); the table is
+        exchanged with an allgather over the parent.  Device-world
+        (conductor) model: color/key may be scalars or (size,) arrays of
+        per-rank values; the table is local.  Returns the subcommunicator
+        containing this (facade) rank, or None for color < 0 (UNDEFINED).
+        """
+        self._check_state()
+        if self.rte is not None and self.rte.is_device_world:
+            colors = np.broadcast_to(np.asarray(color, np.int64), (self.size,))
+            keys = np.broadcast_to(np.asarray(key, np.int64), (self.size,))
+            table = np.stack([colors, keys,
+                              np.arange(self.size, dtype=np.int64)], 1)
+        else:
+            mine = np.array([color, key, self.rank], dtype=np.int64)
+            table = np.asarray(self.allgather(mine)).reshape(self.size, 3)
+        # one CID per distinct non-negative color, allocated in sorted order
+        # so every member observes the same assignment (comm_cid.c agreement)
+        distinct = sorted({int(c) for c, _, _ in table if c >= 0})
+        cids = {c: self._next_cid() for c in distinct}
+        my_color = int(table[self.rank, 0])
+        if my_color < 0:  # MPI_UNDEFINED
+            return None
+        members = sorted((int(k), int(r)) for c, k, r in table
+                         if c == my_color)
+        ranks = [self.group.world_rank(r) for _, r in members]
+        newcomm = Comm(Group(ranks), cids[my_color], self.rte,
+                       name=f"{self.name}~split", parent=self)
+        self._finish_create(newcomm)
+        return newcomm
+
+    def split_type(self, split_type: str = "shared", key: int = 0) -> "Comm":
+        """``MPI_Comm_split_type``: 'shared' = same host/ICI domain."""
+        color = self.rte.locality_color(split_type)
+        return self.split(color, key)
+
+    def create(self, group: Group) -> Optional["Comm"]:
+        self._check_state()
+        cid = self._next_cid()
+        if group.rank_of(self.rte.my_world_rank) < 0:
+            return None
+        newcomm = Comm(group, cid, self.rte, name=f"{self.name}~create",
+                       parent=self)
+        self._finish_create(newcomm)
+        return newcomm
+
+    def create_group(self, group: Group, tag: int = 0) -> Optional["Comm"]:
+        """Non-collective over the parent: only group members participate."""
+        if group.rank_of(self.rte.my_world_rank) < 0:
+            return None
+        from ompi_tpu.runtime import init as rt
+
+        cid = rt.next_local_cid()
+        rt.reserve_cid(cid)
+        newcomm = Comm(group, cid, self.rte,
+                       name=f"{self.name}~create_group", parent=self)
+        self._finish_create(newcomm)
+        return newcomm
+
+    def _finish_create(self, newcomm: "Comm") -> None:
+        from ompi_tpu.mca.coll.base import comm_select
+
+        newcomm.pml = self.pml
+        comm_select(newcomm)
+
+    def free(self) -> None:
+        self._attrs_delete_all()
+        for mod in self.coll_modules:
+            close = getattr(mod, "comm_unquery", None)
+            if close is not None:
+                close(self)
+        self.freed = True
+
+    def abort(self, errorcode: int = 1) -> None:
+        from ompi_tpu.runtime import init as rt
+
+        rt.abort(self, errorcode)
+
+    # -- ULFM FT API (``ompi/mpiext/ftmpi``) ----------------------------
+    def revoke(self) -> None:
+        from ompi_tpu.ft import revoke as ft_revoke
+
+        ft_revoke.revoke(self)
+
+    def shrink(self) -> "Comm":
+        from ompi_tpu.ft import shrink as ft_shrink
+
+        return ft_shrink.shrink(self)
+
+    def agree(self, flag: int) -> int:
+        self._check_state()
+        return self._coll("agree")(self, flag)
+
+    def get_failed(self) -> Group:
+        from ompi_tpu.ft import state as ft_state
+
+        failed = [r for r in self.group.world_ranks if ft_state.is_failed(r)]
+        return Group(failed)
+
+    def is_revoked(self) -> bool:
+        return self.revoked
+
+    def __repr__(self) -> str:
+        return (f"Comm({self.name}, cid={self.cid}, rank={self.rank}/"
+                f"{self.size})")

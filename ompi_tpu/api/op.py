@@ -1,0 +1,141 @@
+"""Reduction operators (``ompi/op/op.c`` + ``ompi/mca/op/`` framework).
+
+Named MPI ops with host kernels (numpy — the VPU-analog of the reference's
+AVX op component, ``ompi/mca/op/avx/op_avx_functions.c``) and their XLA
+lowerings for the device collective path (``coll/xla``): each op carries the
+jax reduction it lowers to inside ``shard_map`` (SUM → ``lax.psum``; MIN/MAX
+→ ``lax.pmin``/``pmax``; others → all_gather + local fold).  User-defined ops
+(``MPI_Op_create``) carry a commute flag that the coll decision ladder
+consults (non-commutative ops are excluded from ring/Rabenseifner, reference
+``coll_tuned_decision_fixed.c:77-80``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ompi_tpu.api.errors import ErrorClass, MpiError
+
+
+class Op:
+    def __init__(
+        self,
+        name: str,
+        fn: Optional[Callable] = None,
+        commute: bool = True,
+        jax_reduce: Optional[str] = None,
+        builtin: bool = False,
+    ) -> None:
+        self.name = name
+        self._fn = fn
+        self.commute = commute
+        self.jax_reduce = jax_reduce  # "psum" | "pmax" | "pmin" | None
+        self.builtin = builtin
+
+    def __call__(self, invec, inoutvec, datatype=None):
+        """inoutvec = invec (op) inoutvec — MPI argument order."""
+        if self._fn is None:
+            raise MpiError(ErrorClass.ERR_OP, f"{self.name} not callable")
+        return self._fn(invec, inoutvec, datatype)
+
+    def reduce_arrays(self, a: np.ndarray, b: np.ndarray,
+                      datatype=None) -> np.ndarray:
+        """Pure reduction of two operand arrays (coll algorithm library use)."""
+        out = b.copy()
+        self(a, out, datatype)
+        return out
+
+    def __repr__(self) -> str:
+        return f"Op({self.name}, commute={self.commute})"
+
+
+def _elementwise(np_fn):
+    def fn(invec, inoutvec, datatype=None):
+        inoutvec[...] = np_fn(invec, inoutvec)
+    return fn
+
+
+def _logical(np_fn):
+    def fn(invec, inoutvec, datatype=None):
+        inoutvec[...] = np_fn(invec.astype(bool), inoutvec.astype(bool)) \
+            .astype(inoutvec.dtype)
+    return fn
+
+
+def _loc_op(extremum):
+    """MAXLOC/MINLOC on pair-type structured arrays (fields 'v' and 'i')."""
+    def fn(invec, inoutvec, datatype=None):
+        if invec.dtype.fields is None or "v" not in invec.dtype.fields:
+            raise MpiError(ErrorClass.ERR_OP,
+                           "MINLOC/MAXLOC need a pair datatype")
+        a_v, b_v = invec["v"], inoutvec["v"]
+        if extremum == "max":
+            take_a = (a_v > b_v) | ((a_v == b_v) & (invec["i"] < inoutvec["i"]))
+        else:
+            take_a = (a_v < b_v) | ((a_v == b_v) & (invec["i"] < inoutvec["i"]))
+        inoutvec["v"] = np.where(take_a, a_v, b_v)
+        inoutvec["i"] = np.where(take_a, invec["i"], inoutvec["i"])
+    return fn
+
+
+def _replace(invec, inoutvec, datatype=None):
+    inoutvec[...] = invec
+
+
+def _no_op(invec, inoutvec, datatype=None):
+    pass
+
+
+SUM = Op("SUM", _elementwise(np.add), True, "psum", builtin=True)
+PROD = Op("PROD", _elementwise(np.multiply), True, None, builtin=True)
+MAX = Op("MAX", _elementwise(np.maximum), True, "pmax", builtin=True)
+MIN = Op("MIN", _elementwise(np.minimum), True, "pmin", builtin=True)
+LAND = Op("LAND", _logical(np.logical_and), True, None, builtin=True)
+LOR = Op("LOR", _logical(np.logical_or), True, None, builtin=True)
+LXOR = Op("LXOR", _logical(np.logical_xor), True, None, builtin=True)
+BAND = Op("BAND", _elementwise(np.bitwise_and), True, None, builtin=True)
+BOR = Op("BOR", _elementwise(np.bitwise_or), True, None, builtin=True)
+BXOR = Op("BXOR", _elementwise(np.bitwise_xor), True, None, builtin=True)
+MAXLOC = Op("MAXLOC", _loc_op("max"), True, None, builtin=True)
+MINLOC = Op("MINLOC", _loc_op("min"), True, None, builtin=True)
+REPLACE = Op("REPLACE", _replace, False, None, builtin=True)
+NO_OP = Op("NO_OP", _no_op, False, None, builtin=True)
+
+BUILTIN_OPS = {
+    op.name: op
+    for op in (SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR,
+               MAXLOC, MINLOC, REPLACE, NO_OP)
+}
+
+
+def create(fn: Callable, commute: bool) -> Op:
+    """``MPI_Op_create``: user function fn(invec, inoutvec, datatype)."""
+    return Op(f"user_{id(fn):x}", fn, commute=commute)
+
+
+def jax_fold(op: Op):
+    """A jax-traceable two-operand fold for device-side reductions.
+
+    Used by coll/xla for ops without a native collective lowering (tree
+    reduction over gathered shards) and by scan/exscan.
+    """
+    import jax.numpy as jnp
+
+    table = {
+        "SUM": jnp.add,
+        "PROD": jnp.multiply,
+        "MAX": jnp.maximum,
+        "MIN": jnp.minimum,
+        "LAND": lambda a, b: (a.astype(bool) & b.astype(bool)).astype(a.dtype),
+        "LOR": lambda a, b: (a.astype(bool) | b.astype(bool)).astype(a.dtype),
+        "LXOR": lambda a, b: (a.astype(bool) ^ b.astype(bool)).astype(a.dtype),
+        "BAND": jnp.bitwise_and,
+        "BOR": jnp.bitwise_or,
+        "BXOR": jnp.bitwise_xor,
+    }
+    fn = table.get(op.name)
+    if fn is None:
+        raise MpiError(ErrorClass.ERR_OP,
+                       f"op {op.name} has no device lowering")
+    return fn

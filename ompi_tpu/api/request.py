@@ -1,0 +1,240 @@
+"""Request lifecycle: test/wait families, completion callbacks, cancellation,
+persistent and generalized requests.
+
+Re-design of ``/root/reference/ompi/request/request.h`` (the
+``ompi_request_wait_completion`` spin at ``request.h:427`` becomes a progress
+-driven wait loop) with the FT-aware completion semantics of ``req_ft.c``
+(pending requests complete in error when a peer dies).
+"""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, Iterable, Optional, Sequence
+
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.status import Status, UNDEFINED
+
+
+class RequestState(enum.Enum):
+    INACTIVE = "inactive"
+    ACTIVE = "active"
+    COMPLETE = "complete"
+    CANCELLED = "cancelled"
+
+
+def _progress() -> int:
+    from ompi_tpu.runtime.progress import progress
+
+    return progress()
+
+
+class Request:
+    """Base request; subclasses drive completion from the progress engine."""
+
+    def __init__(self, persistent: bool = False):
+        self.state = RequestState.INACTIVE if persistent else RequestState.ACTIVE
+        self.persistent = persistent
+        self.status = Status()
+        self.error: Optional[MpiError] = None
+        self._callbacks: list[Callable[["Request"], None]] = []
+        self._lock = threading.Lock()
+
+    # -- completion ------------------------------------------------------
+    def on_complete(self, cb: Callable[["Request"], None]) -> None:
+        fire = False
+        with self._lock:
+            if self.state in (RequestState.COMPLETE, RequestState.CANCELLED):
+                fire = True
+            else:
+                self._callbacks.append(cb)
+        if fire:
+            cb(self)
+
+    def complete(self, error: Optional[MpiError] = None) -> None:
+        with self._lock:
+            if self.state is RequestState.COMPLETE:
+                return
+            self.state = RequestState.COMPLETE
+            self.error = error
+            if error is not None:
+                self.status.error = error.error_class
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    @property
+    def complete_flag(self) -> bool:
+        return self.state in (RequestState.COMPLETE, RequestState.CANCELLED)
+
+    # -- MPI operations --------------------------------------------------
+    def test(self) -> tuple[bool, Optional[Status]]:
+        if not self.complete_flag:
+            _progress()
+        if self.complete_flag:
+            self._raise_if_error()
+            return True, self.status
+        return False, None
+
+    def wait(self, timeout: Optional[float] = None) -> Status:
+        """Spin in the progress engine until complete (``request.h:427``)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while not self.complete_flag:
+            made = _progress()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("request wait timed out")
+            if made == 0:
+                spins += 1
+                if spins > 1000:
+                    time.sleep(50e-6)  # adaptive yield, opal_progress-style
+            else:
+                spins = 0
+        self._raise_if_error()
+        return self.status
+
+    def cancel(self) -> None:
+        with self._lock:
+            if self.state is RequestState.ACTIVE and self._try_cancel():
+                self.state = RequestState.CANCELLED
+                self.status.set_cancelled(True)
+
+    def _try_cancel(self) -> bool:  # subclass hook
+        return False
+
+    def start(self) -> None:
+        """Restart a persistent request (``MPI_Start``)."""
+        if not self.persistent:
+            raise MpiError(ErrorClass.ERR_REQUEST, "not a persistent request")
+        if self.state is RequestState.ACTIVE:
+            raise MpiError(ErrorClass.ERR_REQUEST, "already active")
+        self.state = RequestState.ACTIVE
+        self.status = Status()
+        self.error = None
+        self._start()
+
+    def _start(self) -> None:  # subclass hook
+        raise MpiError(ErrorClass.ERR_REQUEST, "not startable")
+
+    def free(self) -> None:
+        self.state = RequestState.INACTIVE
+
+    def _raise_if_error(self) -> None:
+        if self.error is not None:
+            raise self.error
+
+
+class CompletedRequest(Request):
+    """Immediately-complete request (empty ops, trivial sends)."""
+
+    def __init__(self, status: Optional[Status] = None):
+        super().__init__()
+        if status is not None:
+            self.status = status
+        self.complete()
+
+
+class GeneralizedRequest(Request):
+    """``MPI_Grequest_start``: user-driven completion with query/free/cancel."""
+
+    def __init__(self, query_fn=None, free_fn=None, cancel_fn=None):
+        super().__init__()
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._cancel_fn = cancel_fn
+
+    def grequest_complete(self) -> None:
+        if self._query_fn is not None:
+            self._query_fn(self.status)
+        self.complete()
+
+    def _try_cancel(self) -> bool:
+        if self._cancel_fn is not None:
+            self._cancel_fn(False)
+            return True
+        return False
+
+    def free(self) -> None:
+        if self._free_fn is not None:
+            self._free_fn()
+        super().free()
+
+
+# -- wait/test families (``ompi/request/req_wait.c`` / ``req_test.c``) ----
+
+def waitall(requests: Sequence[Request],
+            timeout: Optional[float] = None) -> list[Status]:
+    errs = []
+    stats = []
+    for r in requests:
+        try:
+            stats.append(r.wait(timeout))
+        except MpiError as e:
+            errs.append(e)
+            stats.append(r.status)
+    if errs:
+        raise MpiError(ErrorClass.ERR_IN_STATUS, f"{len(errs)} request(s) failed")
+    return stats
+
+
+def waitany(requests: Sequence[Request]) -> tuple[int, Status]:
+    if not requests or all(r.state is RequestState.INACTIVE for r in requests):
+        return UNDEFINED, Status()
+    spins = 0
+    while True:
+        for i, r in enumerate(requests):
+            if r.complete_flag:
+                r._raise_if_error()
+                return i, r.status
+        made = _progress()
+        spins = spins + 1 if made == 0 else 0
+        if spins > 1000:
+            time.sleep(50e-6)
+
+
+def waitsome(requests: Sequence[Request]) -> tuple[list[int], list[Status]]:
+    idx, _ = waitany(requests)
+    if idx == UNDEFINED:
+        return [], []
+    out, stats = [], []
+    for i, r in enumerate(requests):
+        if r.complete_flag:
+            r._raise_if_error()
+            out.append(i)
+            stats.append(r.status)
+    return out, stats
+
+
+def testall(requests: Sequence[Request]) -> tuple[bool, Optional[list[Status]]]:
+    _progress()
+    if all(r.complete_flag for r in requests):
+        for r in requests:
+            r._raise_if_error()
+        return True, [r.status for r in requests]
+    return False, None
+
+
+def testany(requests: Sequence[Request]) -> tuple[bool, int, Optional[Status]]:
+    _progress()
+    for i, r in enumerate(requests):
+        if r.complete_flag:
+            r._raise_if_error()
+            return True, i, r.status
+    return False, UNDEFINED, None
+
+
+def testsome(requests: Sequence[Request]) -> tuple[list[int], list[Status]]:
+    _progress()
+    out, stats = [], []
+    for i, r in enumerate(requests):
+        if r.complete_flag:
+            r._raise_if_error()
+            out.append(i)
+            stats.append(r.status)
+    return out, stats
+
+
+def start_all(requests: Iterable[Request]) -> None:
+    for r in requests:
+        r.start()

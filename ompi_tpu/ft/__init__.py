@@ -1,0 +1,3 @@
+"""ULFM-style fault tolerance (``/root/reference/ompi/communicator/ft/`` +
+``ompi/mpiext/ftmpi/``): failure state, heartbeat detector, propagation,
+revoke/shrink/agree.  See SURVEY.md §3.5/§5.3."""

@@ -1,0 +1,46 @@
+"""Global failure state: the set of world ranks known dead.
+
+Equivalent of the reference's proc-failure bookkeeping
+(``ompi/proc/proc.c`` + ``ompi/communicator/ft/comm_ft.c``): the detector
+(``comm_ft_detector.c``) and the propagator feed this set; API-level
+liveness checks (``ompi/mpi/c/send.c:84``) read it.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+_lock = threading.Lock()
+_failed: set[int] = set()
+_listeners: list[Callable[[int], None]] = []
+
+
+def mark_failed(world_rank: int) -> None:
+    with _lock:
+        if world_rank in _failed:
+            return
+        _failed.add(world_rank)
+        listeners = list(_listeners)
+    for cb in listeners:
+        cb(world_rank)
+
+
+def is_failed(world_rank: int) -> bool:
+    return world_rank in _failed
+
+
+def failed_ranks() -> frozenset:
+    with _lock:
+        return frozenset(_failed)
+
+
+def on_failure(cb: Callable[[int], None]) -> None:
+    """Register a callback fired once per newly-detected failure."""
+    with _lock:
+        _listeners.append(cb)
+
+
+def reset_for_testing() -> None:
+    with _lock:
+        _failed.clear()
+        _listeners.clear()

@@ -1,0 +1,7 @@
+"""accelerator — device-memory framework.
+
+Equivalent of the reference CUDA glue (``/root/reference/opal/mca/common/
+cuda/common_cuda.c`` — dlopen'd driver table, ``opal_cuda_check_bufs``
+residency test) re-designed for TPU: residency checks on ``jax.Array``,
+HBM/host staging, and pinned-host allocation for the BTL bounce path.
+"""

@@ -1,0 +1,76 @@
+"""accelerator/jax — TPU HBM residency + staging.
+
+The ``opal_cuda_check_bufs`` analog (``common_cuda.c``): tells the datatype
+engine, the pml, and the coll decision path whether a buffer lives in device
+HBM (→ XLA collective path, DEVICE convertor flag) or host memory (→ host
+pack/unpack).  Registration of device memory is implicit in jax.Array
+ownership; ``register``/``deregister`` keep an interval-tree bookkeeping of
+exposed host regions for the RMA path (rcache equivalent).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.base.containers import IntervalTree
+from ompi_tpu.base.mca import Component
+
+_rcache = IntervalTree()
+
+
+def is_device_array(x: Any) -> bool:
+    """True if x is a jax.Array whose committed home is an accelerator."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        return False
+    # Any jax.Array takes the XLA collective path — CPU-backed jax Arrays
+    # included (virtual-device meshes in tests): the mesh is what matters,
+    # not the platform.
+    return isinstance(x, jax.Array)
+
+
+def to_host(x) -> np.ndarray:
+    """Stage a device array to host memory (D2H)."""
+    return np.asarray(x)
+
+
+def from_host(arr: np.ndarray, sharding=None):
+    """Stage host memory to device (H2D), optionally sharded."""
+    import jax
+
+    return jax.device_put(arr, sharding)
+
+
+def register(buf: np.ndarray, key: Any = None):
+    """Expose a host region (RMA window registration)."""
+    addr = buf.__array_interface__["data"][0]
+    _rcache.insert(addr, addr + buf.nbytes, key or buf)
+    return addr
+
+
+def deregister(buf: np.ndarray) -> None:
+    addr = buf.__array_interface__["data"][0]
+    _rcache.delete(addr, addr + buf.nbytes)
+
+
+def lookup(addr: int, nbytes: int):
+    hit = _rcache.find_containing(addr, addr + nbytes)
+    return None if hit is None else hit[2]
+
+
+class JaxAcceleratorComponent(Component):
+    name = "jax"
+    priority = 50
+
+    def open(self) -> bool:
+        try:
+            import jax  # noqa: F401
+
+            return True
+        except ImportError:  # pragma: no cover
+            return False
+
+
+COMPONENT = JaxAcceleratorComponent()

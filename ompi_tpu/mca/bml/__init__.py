@@ -1,0 +1,3 @@
+"""bml — BTL multiplexer (``/root/reference/ompi/mca/bml/`` r2): builds
+per-peer endpoint lists of usable BTLs ordered by latency/bandwidth."""
+from ompi_tpu.mca.bml.r2 import Bml  # noqa: F401

@@ -1,0 +1,56 @@
+"""bml/r2 equivalent: per-peer BTL endpoint selection.
+
+``/root/reference/ompi/mca/bml/r2/bml_r2.c`` builds, for every peer, the
+list of BTLs that can reach it, ordered for latency (eager sends) and
+striped by bandwidth (large transfers).  Here: query every available btl
+component for reachability at add_procs time; the lowest-latency endpoint
+serves eager traffic, the full list serves striping.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ompi_tpu.base import mca
+from ompi_tpu.mca.btl.base import Endpoint, Frag
+
+
+class Bml:
+    def __init__(self, rte, recv_cb: Callable[[Frag], None]) -> None:
+        self.rte = rte
+        self._endpoints: dict[int, list[Endpoint]] = {}
+        fw = mca.framework("btl", "byte transfer layer", multi_select=True)
+        self.btls = fw.select_all()
+        for btl in self.btls:
+            btl.set_recv_callback(recv_cb)
+            from ompi_tpu.runtime import progress as prog
+
+            prog.register(btl.progress)
+
+    def add_proc(self, world_rank: int) -> list[Endpoint]:
+        eps = []
+        for btl in self.btls:
+            ep = btl.reachable(world_rank, self.rte)
+            if ep is not None:
+                eps.append(ep)
+        eps.sort(key=lambda e: (e.btl.latency, -e.btl.bandwidth))
+        self._endpoints[world_rank] = eps
+        return eps
+
+    def endpoint(self, world_rank: int) -> Optional[Endpoint]:
+        """Lowest-latency endpoint for the peer (eager path)."""
+        eps = self._endpoints.get(world_rank)
+        if eps is None:
+            eps = self.add_proc(world_rank)
+        return eps[0] if eps else None
+
+    def endpoints(self, world_rank: int) -> list[Endpoint]:
+        eps = self._endpoints.get(world_rank)
+        if eps is None:
+            eps = self.add_proc(world_rank)
+        return eps
+
+    def finalize(self) -> None:
+        from ompi_tpu.runtime import progress as prog
+
+        for btl in self.btls:
+            prog.unregister(btl.progress)

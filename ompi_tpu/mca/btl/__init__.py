@@ -1,0 +1,8 @@
+"""btl — Byte Transfer Layer framework (``/root/reference/opal/mca/btl/``).
+
+The lowest-level transport abstraction: active-message send, RDMA put/get,
+remote atomics (``btl.h:878,949,987,1029``), with eager/rendezvous/max-send
+size limits (``btl.h:1162-1180``).  Components: ``self`` (in-process
+loopback — which in the device-world SPMD model reaches *every* rank),
+``sm`` (shared memory), ``tcp`` (DCN analog).
+"""

@@ -1,0 +1,83 @@
+"""BTL interface: fragments, endpoints, module contract.
+
+Mirrors the module struct of ``/root/reference/opal/mca/btl/btl.h:1158`` —
+``btl_send``/``btl_sendi`` active messages, ``btl_put``/``btl_get`` RMA,
+``btl_register_mem`` — with the descriptor machinery collapsed to a
+:class:`Frag` dataclass (Python owns the memory; the native core provides
+zero-copy paths for sm).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ompi_tpu.base.mca import Component
+
+# fragment kinds (pml protocol headers ride in ``kind`` + ``meta``)
+MATCH = "match"          # eager: full payload, match on arrival
+RNDV = "rndv"            # rendezvous first fragment: header + head of data
+ACK = "ack"              # receiver matched an rndv: pull the rest
+FRAG = "frag"            # rndv continuation fragment
+RGET = "rget"            # RDMA-get protocol: sender exposes, receiver pulls
+CTL = "ctl"              # control (FT heartbeats, monitoring, osc)
+
+
+@dataclass
+class Frag:
+    """One wire fragment. ``data`` is bytes; ``meta`` is a small dict that
+    must stay picklable (it crosses process boundaries on tcp/sm)."""
+
+    cid: int
+    src: int              # world rank of sender
+    dst: int              # world rank of receiver
+    tag: int
+    seq: int
+    kind: str = MATCH
+    data: bytes = b""
+    total_len: int = 0    # full message length (rndv)
+    offset: int = 0       # stream offset of this fragment (FRAG)
+    meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class Endpoint:
+    """Per-peer connection state for one BTL."""
+
+    btl: "Btl"
+    world_rank: int
+    addr: Any = None
+
+
+class Btl(Component):
+    """Base BTL module/component (collapsed, like coll components)."""
+
+    # perf limits (btl.h:1162-1180); subclasses override
+    eager_limit: int = 64 * 1024
+    rndv_eager_limit: int = 64 * 1024
+    max_send_size: int = 128 * 1024
+    latency: int = 100        # ordering key for bml (btl.h btl_latency)
+    bandwidth: int = 100
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._recv_cb: Optional[Callable[[Frag], None]] = None
+
+    def set_recv_callback(self, cb: Callable[[Frag], None]) -> None:
+        """The pml registers its frag-delivery callback here."""
+        self._recv_cb = cb
+
+    def reachable(self, world_rank: int, rte) -> Optional[Endpoint]:
+        """Return an endpoint if this BTL can reach the peer, else None."""
+        return None
+
+    def send(self, ep: Endpoint, frag: Frag) -> None:
+        raise NotImplementedError
+
+    def put(self, ep: Endpoint, local: memoryview, remote_key: Any) -> None:
+        raise NotImplementedError("this BTL has no RDMA put")
+
+    def get(self, ep: Endpoint, local: memoryview, remote_key: Any) -> None:
+        raise NotImplementedError("this BTL has no RDMA get")
+
+    def progress(self) -> int:
+        return 0

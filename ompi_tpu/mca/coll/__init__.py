@@ -1,0 +1,11 @@
+"""coll — collectives framework (``/root/reference/ompi/mca/coll/``).
+
+Components compete per-communicator by priority
+(``coll_base_comm_select.c:96``); each fills the subset of the per-comm
+vtable it implements, highest priority winning per function.  Components:
+``xla`` (★ the north star: device buffers → XLA collectives over the ICI
+mesh), ``conductor`` (host buffers in the device-world model), ``basic``
+(linear algorithms over pml), ``tuned`` (decision ladder), ``libnbc``
+(nonblocking schedules), ``han`` (hierarchical), ``self_coll`` (size-1),
+``ftagree`` (ULFM agreement), ``sync``, ``monitoring``, ``inter``.
+"""

@@ -1,0 +1,70 @@
+"""coll/base: per-communicator component selection.
+
+Re-design of ``/root/reference/ompi/mca/coll/base/coll_base_comm_select.c``:
+query every available component for this communicator (``:341``), keep those
+answering with priority >= 0 (``:412``), sort ascending (``:451``), then fill
+the per-comm vtable ``c_coll`` in priority order so the highest-priority
+provider of each individual function wins (the reference's
+``COPY(module, comm, func)`` loop).  The algorithm library itself
+(ring / recursive-doubling / Rabenseifner menus) lives in
+``ompi_tpu.mca.coll.algorithms``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ompi_tpu.base import mca
+from ompi_tpu.base.var import VarType, registry
+
+from ompi_tpu.api.comm import COLL_FUNCTIONS
+
+
+def coll_framework() -> mca.Framework:
+    return mca.framework("coll", "collective operations", multi_select=True)
+
+
+def comm_select(comm) -> None:
+    """Fill ``comm.c_coll`` by priority vote across coll components."""
+    fw = coll_framework()
+    scored = []
+    for comp in fw.select_all():
+        query = getattr(comp, "comm_query", None)
+        if query is None:
+            continue
+        try:
+            res = query(comm)
+        except Exception as exc:
+            from ompi_tpu.base import output as _o
+
+            _o.output(fw.stream, 1, "coll %s comm_query failed: %s",
+                      comp.name, exc)
+            res = None
+        if res is None:
+            continue
+        priority, module = res
+        if priority < 0:
+            continue
+        scored.append((priority, comp.name, module))
+    # ascending sort; later (higher-priority) modules overwrite earlier ones
+    scored.sort(key=lambda t: (t[0], t[1]))
+    comm.c_coll = {}
+    comm.coll_modules = [m for _, _, m in scored]
+    for _, _, module in scored:
+        enable = getattr(module, "comm_enable", None)
+        if enable is not None:
+            enable(comm)
+        for fname in COLL_FUNCTIONS:
+            fn = getattr(module, fname, None)
+            if fn is not None:
+                comm.c_coll[fname] = fn
+    if not comm.c_coll:
+        from ompi_tpu.base.output import show_help
+
+        show_help("help-coll", "none-available", comm=comm.name)
+
+
+from ompi_tpu.base.output import register_help as _rh
+
+_rh("help-coll", "none-available",
+    "No collective component is available for communicator {comm}; "
+    "collective operations on it will fail.")

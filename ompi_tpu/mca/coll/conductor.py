@@ -1,0 +1,191 @@
+"""coll/conductor — host-buffer collectives for the device-world model.
+
+In the single-controller SPMD world every rank's host contribution already
+lives in this process, so host collectives are direct computations — the
+honest TPU-native counterpart of running message-passing algorithms between
+co-located ranks.  Data model: the leading axis of ``sendbuf`` indexes ranks
+(``sendbuf[i]`` is rank i's contribution), matching the single-controller
+convention of ``jax.pmap``.  Message-passing algorithm menus (ring,
+recursive-doubling, Rabenseifner — ``coll_base_allreduce.c:53-1245``) are
+exercised in the multi-process model via coll/basic and coll/tuned.
+
+Device buffers (jax.Array) passed to the *host* entry points are detected
+via the accelerator framework and forwarded to the coll/xla module — the
+interposition pattern of ``coll/cuda`` (``coll_cuda_allreduce.c:44-69``),
+except the collective runs *on* device instead of staging to host.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.request import CompletedRequest
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+
+
+def _fold(op: op_mod.Op, stack: np.ndarray) -> np.ndarray:
+    """Reduce over the leading (rank) axis with an MPI op."""
+    acc = np.array(stack[0], copy=True)
+    for i in range(1, stack.shape[0]):
+        op(stack[i], acc)
+    return acc
+
+
+class ConductorModule:
+    def __init__(self, comm):
+        pass
+
+    def _is_device(self, x) -> bool:
+        from ompi_tpu.mca.accelerator.jax_acc import is_device_array
+
+        return is_device_array(x)
+
+    # -- blocking host collectives --------------------------------------
+    def barrier(self, comm) -> None:
+        fn = comm.c_coll.get("device_barrier")
+        if fn is not None:
+            fn(comm)
+
+    def bcast(self, comm, buf, root=0):
+        if self._is_device(buf):
+            return comm.c_coll["bcast_array"](comm, buf, root)
+        return np.asarray(buf)
+
+    def reduce(self, comm, sendbuf, op, root=0):
+        if self._is_device(sendbuf):
+            return comm.c_coll["reduce_array"](comm, sendbuf, op, root)
+        return _fold(op, self._stack(comm, sendbuf))
+
+    def allreduce(self, comm, sendbuf, op):
+        if self._is_device(sendbuf):
+            return comm.c_coll["allreduce_array"](comm, sendbuf, op)
+        return _fold(op, self._stack(comm, sendbuf))
+
+    def gather(self, comm, sendbuf, root=0):
+        if self._is_device(sendbuf):
+            return comm.c_coll["gather_array"](comm, sendbuf, root)
+        return np.array(self._stack(comm, sendbuf), copy=True)
+
+    def gatherv(self, comm, sendbuf, root=0):
+        return [np.asarray(b) for b in sendbuf]
+
+    def scatter(self, comm, sendbuf, root=0):
+        if self._is_device(sendbuf):
+            return comm.c_coll["scatter_array"](comm, sendbuf, root)
+        return np.array(self._stack(comm, sendbuf), copy=True)
+
+    def scatterv(self, comm, sendbufs, root=0):
+        return [np.asarray(b) for b in sendbufs]
+
+    def allgather(self, comm, sendbuf):
+        if self._is_device(sendbuf):
+            return comm.c_coll["allgather_array"](comm, sendbuf)
+        return np.array(self._stack(comm, sendbuf), copy=True)
+
+    def allgatherv(self, comm, sendbuf):
+        return [np.asarray(b) for b in sendbuf]
+
+    def alltoall(self, comm, sendbuf):
+        if self._is_device(sendbuf):
+            return comm.c_coll["alltoall_array"](comm, sendbuf)
+        stack = self._stack(comm, sendbuf)
+        if stack.ndim < 2 or stack.shape[1] != comm.size:
+            raise ValueError("alltoall needs shape (size, size, ...)")
+        return np.array(np.swapaxes(stack, 0, 1), copy=True)
+
+    def alltoallv(self, comm, sendbufs):
+        n = comm.size
+        return [[np.asarray(sendbufs[j][i]) for j in range(n)]
+                for i in range(n)]
+
+    def reduce_scatter(self, comm, sendbuf, recvcounts, op):
+        if self._is_device(sendbuf):
+            return comm.c_coll["reduce_scatter_array"](comm, sendbuf, op)
+        stack = self._stack(comm, sendbuf)
+        total = _fold(op, stack)
+        n = comm.size
+        if recvcounts is None:
+            return np.array(np.split(total, n), copy=True)
+        out, off = [], 0
+        for c in recvcounts:
+            out.append(np.array(total[off:off + c], copy=True))
+            off += c
+        return out
+
+    def scan(self, comm, sendbuf, op):
+        stack = self._stack(comm, sendbuf)
+        out = np.array(stack, copy=True)
+        for i in range(1, out.shape[0]):
+            op(out[i - 1], out[i])
+        return out
+
+    def exscan(self, comm, sendbuf, op):
+        inc = self.scan(comm, sendbuf, op)
+        out = np.zeros_like(inc)
+        out[1:] = inc[:-1]
+        return out
+
+    # nonblocking: host computation is immediate in conductor mode -------
+    def ibarrier(self, comm):
+        self.barrier(comm)
+        return CompletedRequest()
+
+    def ibcast(self, comm, buf, root=0):
+        r = CompletedRequest()
+        r.result = self.bcast(comm, buf, root)
+        return r
+
+    def iallreduce(self, comm, sendbuf, op):
+        r = CompletedRequest()
+        r.result = self.allreduce(comm, sendbuf, op)
+        return r
+
+    def iallgather(self, comm, sendbuf):
+        r = CompletedRequest()
+        r.result = self.allgather(comm, sendbuf)
+        return r
+
+    def ialltoall(self, comm, sendbuf):
+        r = CompletedRequest()
+        r.result = self.alltoall(comm, sendbuf)
+        return r
+
+    def ireduce(self, comm, sendbuf, op, root=0):
+        r = CompletedRequest()
+        r.result = self.reduce(comm, sendbuf, op, root)
+        return r
+
+    def agree(self, comm, flag: int) -> int:
+        # single controller: agreement over live ranks is local (bitwise AND)
+        flags = np.atleast_1d(np.asarray(flag, dtype=np.int64))
+        return int(np.bitwise_and.reduce(flags))
+
+    # helpers ------------------------------------------------------------
+    def _stack(self, comm, sendbuf) -> np.ndarray:
+        arr = np.asarray(sendbuf)
+        if arr.ndim == 0 or arr.shape[0] != comm.size:
+            raise ValueError(
+                f"conductor collectives need a leading rank axis of size "
+                f"{comm.size}; got shape {arr.shape}")
+        return arr
+
+
+class ConductorComponent(Component):
+    name = "conductor"
+    priority = 40
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=40,
+            help="Selection priority of coll/conductor")
+
+    def comm_query(self, comm):
+        if comm.rte is None or not comm.rte.is_device_world:
+            return None
+        if comm.size == 1:
+            return None  # self_coll handles it
+        return self._prio.value, ConductorModule(comm)
+
+
+COMPONENT = ConductorComponent()

@@ -1,0 +1,295 @@
+"""coll/xla ★ — device-buffer collectives lowering to XLA over the ICI mesh.
+
+The north star (BASELINE.json): MPI_Allreduce / Bcast / Allgather /
+Reduce_scatter / Alltoall on TPU-resident buffers lower to ``lax.psum`` /
+``ppermute`` / ``all_gather`` / ``psum_scatter`` / ``all_to_all`` inside
+``shard_map`` on the communicator's mesh — compiler-scheduled collectives,
+no progress engine, no staging.  Slots into the coll framework the way
+``coll/cuda``/``coll/hcoll`` do (``/root/reference/ompi/mca/coll/cuda/
+coll_cuda_allreduce.c:30-69`` stages D2H→coll→H2D; here the collective runs
+ON device instead).
+
+Data model (single-controller SPMD): a communicator of size N over an
+N-device mesh; device arrays carry a leading rank axis of global size N
+sharded over the mesh axis (``x[i]`` lives on device-rank i's HBM).
+Compiled programs are cached per (function, op, shape, dtype, args) — the
+trace-time analog of the MCA-selection-at-runtime the reference does per
+call (SURVEY.md §7 hard part #1).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+from ompi_tpu.runtime import spc
+
+
+class XlaCollModule:
+    def __init__(self, comm, devices, axis_name: str = "mpi") -> None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self.devices = list(devices)
+        self.axis = axis_name
+        self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        self.n = len(self.devices)
+        self._cache: dict = {}
+        self._lock = threading.Lock()
+        self._P = P
+        self._sharded = NamedSharding(self.mesh, P(axis_name))
+        self._replicated = NamedSharding(self.mesh, P())
+
+    # -- helpers ---------------------------------------------------------
+    def _check(self, comm, x):
+        import jax
+
+        if not isinstance(x, jax.Array):
+            x = self.make_world_array(x)
+        if x.shape[0] != self.n:
+            raise MpiError(
+                ErrorClass.ERR_BUFFER,
+                f"device collective needs leading rank axis {self.n}, "
+                f"got shape {x.shape}")
+        spc.record("device_collectives")
+        spc.record("device_bytes", x.nbytes)
+        return x
+
+    def make_world_array(self, host_stack):
+        """Place a (size, ...) host stack so row i lives on device-rank i."""
+        import jax
+
+        arr = np.asarray(host_stack)
+        if arr.ndim == 0 or arr.shape[0] != self.n:
+            raise MpiError(
+                ErrorClass.ERR_BUFFER,
+                f"world array needs leading rank axis {self.n}, got shape "
+                f"{arr.shape}")
+        return jax.device_put(arr, self._sharded)
+
+    def _compiled(self, key, builder):
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is None:
+                fn = builder()
+                self._cache[key] = fn
+        return fn
+
+    def _shard_map(self, fn, in_specs, out_specs, check_vma: bool = False):
+        # check_vma off by default: several collective results (all_gather,
+        # gather+fold) are replicated in ways jax 0.9's static varying-mesh-
+        # axes checker cannot infer; correctness is covered by tests/test_coll.
+        import jax
+        from jax import shard_map
+
+        return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_vma))
+
+    def _reduce_in_shard(self, op: op_mod.Op):
+        """Per-shard reduction body: native collective or gather+fold."""
+        import jax
+
+        ax = self.axis
+        if op.jax_reduce == "psum":
+            return lambda t: jax.lax.psum(t, ax)
+        if op.jax_reduce == "pmax":
+            return lambda t: jax.lax.pmax(t, ax)
+        if op.jax_reduce == "pmin":
+            return lambda t: jax.lax.pmin(t, ax)
+        fold = op_mod.jax_fold(op)
+
+        def body(t):
+            gathered = jax.lax.all_gather(t, ax)  # (n, *S)
+            acc = gathered[0]
+            for i in range(1, self.n):
+                acc = fold(gathered[i], acc)
+            return acc
+
+        return body
+
+    # -- collective slots ------------------------------------------------
+    def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        x = self._check(comm, x)
+        P = self._P
+        key = ("allreduce", op.name, x.shape, str(x.dtype))
+        body = self._reduce_in_shard(op)
+        # gather+fold lowerings produce replicated values the static checker
+        # can't infer; native psum/pmax/pmin pass the check
+        fn = self._compiled(key, lambda: self._shard_map(
+            lambda t: body(t[0]), P(self.axis), P()))
+        return fn(x)
+
+    def reduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM, root: int = 0):
+        # on a mesh the reduced value is replicated; root semantics are moot
+        return self.allreduce_array(comm, x, op)
+
+    def bcast_array(self, comm, x, root: int = 0):
+        """Binomial-tree broadcast: log2(n) ppermute rounds over ICI.
+
+        XLA's CollectivePermute disallows one-to-many pairs, so the tree is
+        explicit — the device-native shape of the reference's binomial bcast
+        (``coll_base_bcast.c`` binomial algorithm), each round doubling the
+        set of devices holding root's data.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        x = self._check(comm, x)
+        P = self._P
+        n, ax = self.n, self.axis
+        key = ("bcast", root, x.shape, str(x.dtype))
+
+        def body(t):  # t: (1, *S)
+            me = jax.lax.axis_index(ax)
+            rel = (me - root) % n
+            cur = t
+            k = 1
+            while k < n:
+                perm = [((root + i) % n, (root + i + k) % n)
+                        for i in range(min(k, n - k))]
+                recvd = jax.lax.ppermute(cur, ax, perm)
+                newly = (rel >= k) & (rel < 2 * k)
+                cur = jnp.where(newly, recvd, cur)
+                k *= 2
+            return cur
+
+        fn = self._compiled(key, lambda: self._shard_map(
+            body, P(self.axis), P(self.axis), check_vma=False))
+        return fn(x)
+
+    def allgather_array(self, comm, x):
+        import jax
+
+        x = self._check(comm, x)
+        P = self._P
+        key = ("allgather", x.shape, str(x.dtype))
+        fn = self._compiled(key, lambda: self._shard_map(
+            lambda t: jax.lax.all_gather(t[0], self.axis),
+            P(self.axis), P()))
+        return fn(x)
+
+    def gather_array(self, comm, x, root: int = 0):
+        return self.allgather_array(comm, x)
+
+    def reduce_scatter_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        """Each rank contributes (n, *S); rank i receives the reduced block i.
+
+        Result: global (n, *S) sharded over the rank axis.
+        """
+        import jax
+
+        x = self._check(comm, x)
+        if x.ndim < 2 or x.shape[1] != self.n:
+            raise MpiError(ErrorClass.ERR_BUFFER,
+                           f"reduce_scatter needs shape (n, n, ...), got "
+                           f"{x.shape}")
+        P = self._P
+        key = ("reduce_scatter", op.name, x.shape, str(x.dtype))
+        if op.jax_reduce == "psum":
+            def body(t):  # t: (1, n, *S)
+                return jax.lax.psum_scatter(
+                    t[0], self.axis, scatter_dimension=0, tiled=False)[None]
+        else:
+            fold = op_mod.jax_fold(op)
+            reduce_body = self._reduce_in_shard(op)
+
+            def body(t):
+                full = reduce_body(t[0])          # (n, *S) reduced
+                i = jax.lax.axis_index(self.axis)
+                return jax.lax.dynamic_index_in_dim(full, i, 0)
+
+        fn = self._compiled(key, lambda: self._shard_map(
+            body, P(self.axis), P(self.axis)))
+        return fn(x)
+
+    def psum_scatter_array(self, comm, x):
+        return self.reduce_scatter_array(comm, x, op_mod.SUM)
+
+    def alltoall_array(self, comm, x):
+        """x[i, j] moves to result[j, i] (rank j receives x[:, j])."""
+        import jax
+        import jax.numpy as jnp
+
+        x = self._check(comm, x)
+        if x.ndim < 2 or x.shape[1] != self.n:
+            raise MpiError(ErrorClass.ERR_BUFFER,
+                           f"alltoall needs shape (n, n, ...), got {x.shape}")
+        P = self._P
+        key = ("alltoall", x.shape, str(x.dtype))
+
+        def body(t):  # (1, n, *S)
+            y = jax.lax.all_to_all(t, self.axis, split_axis=1, concat_axis=0)
+            return jnp.swapaxes(y, 0, 1)  # (1, n, *S): row = my received blocks
+
+        fn = self._compiled(key, lambda: self._shard_map(
+            body, P(self.axis), P(self.axis)))
+        return fn(x)
+
+    def ppermute_array(self, comm, x, perm):
+        import jax
+
+        x = self._check(comm, x)
+        P = self._P
+        perm = tuple((int(s), int(d)) for s, d in perm)
+        key = ("ppermute", perm, x.shape, str(x.dtype))
+        fn = self._compiled(key, lambda: self._shard_map(
+            lambda t: jax.lax.ppermute(t, self.axis, perm),
+            P(self.axis), P(self.axis)))
+        return fn(x)
+
+    def scatter_array(self, comm, x, root: int = 0):
+        """Root's (n, *S) blocks land one per device-rank (a resharding:
+        block i moves root→device i over ICI, XLA schedules the moves)."""
+        import jax
+
+        x = self._check(comm, x)
+        return jax.device_put(x, self._sharded)
+
+    def device_barrier(self, comm) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        key = ("barrier",)
+        P = self._P
+        fn = self._compiled(key, lambda: self._shard_map(
+            lambda t: jax.lax.psum(t, self.axis),
+            P(self.axis), P()))
+        tok = self.make_world_array(np.zeros((self.n, 1), np.float32))
+        jax.block_until_ready(fn(tok))
+
+    def barrier(self, comm) -> None:
+        self.device_barrier(comm)
+
+
+class XlaCollComponent(Component):
+    name = "xla"
+    priority = 90
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=90,
+            help="Selection priority of coll/xla (device collectives)")
+        self._axis = self.register_var(
+            "axis_name", default="mpi",
+            help="Mesh axis name used for coll/xla collective programs")
+
+    def comm_query(self, comm):
+        rte = comm.rte
+        if rte is None or not rte.is_device_world:
+            return None
+        try:
+            devices = [rte.device_of(r) for r in comm.group.world_ranks]
+        except Exception:
+            return None
+        if not devices or any(d is None for d in devices):
+            return None
+        return self._prio.value, XlaCollModule(comm, devices,
+                                               self._axis.value)
+
+
+COMPONENT = XlaCollComponent()

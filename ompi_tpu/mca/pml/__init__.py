@@ -1,0 +1,5 @@
+"""pml — point-to-point messaging layer framework
+(``/root/reference/ompi/mca/pml/pml.h:108,498``).  Components: ``ob1`` (the
+default matching/protocol engine over BTLs), ``monitoring`` (interposition),
+``v`` (message-logging FT interposition).
+"""

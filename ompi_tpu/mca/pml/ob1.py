@@ -1,0 +1,371 @@
+"""pml/ob1 — the default matching & protocol engine over BTLs.
+
+Re-design of ``/root/reference/ompi/mca/pml/ob1/``: MPI matching by
+(comm, src, tag) with sender sequence numbers, unexpected-message and
+out-of-order queues (``pml_ob1_recvfrag.c:293,831,923``; ooo held by seq,
+``:106-147`` — Python's unbounded ints remove the 16-bit rollover dance),
+and the eager / rendezvous (RNDV/ACK/FRAG) protocol ladder selected by the
+BTL's size limits (``pml_ob1_sendreq.h:375-401``).  The send fast path
+(``pml_ob1_isend.c:281`` ``send_inline``) is the eager branch.
+
+Matching state is keyed by (cid, receiver world rank) so a single process
+can host every rank of the device-world ("conductor") model — the TPU
+equivalent of ``mpirun --oversubscribe`` over btl/self.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.request import Request
+from ompi_tpu.api.status import ANY_SOURCE, ANY_TAG, Status
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+from ompi_tpu.datatype import Convertor
+from ompi_tpu.mca.bml import Bml
+from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RNDV, Frag
+from ompi_tpu.runtime import spc
+
+
+class SendRequest(Request):
+    def __init__(self, pml, comm, buf, dest: int, tag: int):
+        super().__init__()
+        from ompi_tpu.api.comm import as_buffer
+
+        self.pml = pml
+        self.comm = comm
+        arr, count, dt = as_buffer(buf)
+        self.convertor = Convertor(dt, count, arr)
+        self.nbytes = self.convertor.packed_size
+        self.dest = dest
+        self.tag = tag
+        self.req_id = next(pml._req_counter)
+        self.acked = False
+
+
+class RecvRequest(Request):
+    def __init__(self, pml, comm, buf, source: int, tag: int):
+        super().__init__()
+        from ompi_tpu.api.comm import as_buffer
+
+        self.pml = pml
+        self.comm = comm
+        arr, count, dt = as_buffer(buf)
+        self.convertor = Convertor(dt, count, arr)
+        self.capacity = self.convertor.packed_size
+        self.source = source            # comm rank or ANY_SOURCE
+        self.tag = tag
+        self.req_id = next(pml._req_counter)
+        self.received = 0
+        self.total = None               # known after match
+        self.matched_src = None
+
+    def matches(self, frag: Frag, comm_src: int) -> bool:
+        if self.source != ANY_SOURCE and self.source != comm_src:
+            return False
+        if self.tag == ANY_TAG:
+            return frag.tag >= 0        # wildcards never match internal tags
+        return self.tag == frag.tag
+
+    def _try_cancel(self) -> bool:
+        return self.pml._cancel_recv(self)
+
+
+class Message:
+    """``MPI_Mprobe`` matched-message handle."""
+
+    def __init__(self, pml, comm, frag: Frag, status: Status):
+        self._pml = pml
+        self._comm = comm
+        self._frag = frag
+        self.status = status
+
+    def recv(self, buf) -> Status:
+        req = RecvRequest(self._pml, self._comm, buf,
+                          self.status.source, self.status.tag)
+        self._pml._deliver_to_request(req, self._frag)
+        return req.wait()
+
+
+class _MatchState:
+    """Per-(cid, receiver) matching queues."""
+
+    __slots__ = ("posted", "unexpected", "expected_seq", "ooo")
+
+    def __init__(self) -> None:
+        self.posted: list[RecvRequest] = []
+        self.unexpected: list[Frag] = []
+        self.expected_seq: dict[int, int] = {}   # src world rank -> next seq
+        self.ooo: dict[int, dict[int, Frag]] = {}
+
+
+class Ob1Pml:
+    """The pml module (one per process)."""
+
+    def __init__(self, component: "Ob1Component", rte) -> None:
+        self.component = component
+        self.rte = rte
+        self._lock = threading.RLock()
+        self._match: dict[tuple[int, int], _MatchState] = {}
+        self._seq: dict[tuple[int, int, int], itertools.count] = {}
+        self._req_counter = itertools.count(1)
+        self._send_reqs: dict[int, SendRequest] = {}
+        self._recv_reqs: dict[int, RecvRequest] = {}
+        self.bml = Bml(rte, self._recv_frag)
+
+    # -- framework hooks -------------------------------------------------
+    def add_comm(self, comm) -> None:
+        with self._lock:
+            for r in comm.group.world_ranks:
+                self._match.setdefault((comm.cid, r), _MatchState())
+
+    def finalize(self) -> None:
+        self.bml.finalize()
+
+    # -- send path (pml_ob1_isend.c:233) --------------------------------
+    def isend(self, comm, buf, dest: int, tag: int) -> Request:
+        spc.record("isend")
+        req = SendRequest(self, comm, buf, dest, tag)
+        dst_world = (comm.remote_group if comm.is_inter
+                     else comm.group).world_rank(dest)
+        src_world = comm.world_rank(comm.rank)
+        ep = self.bml.endpoint(dst_world)
+        if ep is None:
+            raise MpiError(ErrorClass.ERR_INTERN,
+                           f"no transport reaches world rank {dst_world}")
+        seq = next(self._seq.setdefault(
+            (comm.cid, src_world, dst_world), itertools.count()))
+        spc.record("bytes_sent", req.nbytes)
+        if req.nbytes <= ep.btl.eager_limit:
+            # eager: single MATCH fragment, complete immediately
+            frag = Frag(comm.cid, src_world, dst_world, tag, seq, MATCH,
+                        req.convertor.pack(), total_len=req.nbytes)
+            ep.btl.send(ep, frag)
+            req.complete()
+        else:
+            # rendezvous: RNDV head now, stream on ACK
+            head = req.convertor.pack(ep.btl.rndv_eager_limit)
+            self._send_reqs[req.req_id] = req
+            frag = Frag(comm.cid, src_world, dst_world, tag, seq, RNDV,
+                        head, total_len=req.nbytes,
+                        meta={"req_id": req.req_id})
+            ep.btl.send(ep, frag)
+        return req
+
+    def send(self, comm, buf, dest: int, tag: int) -> None:
+        spc.record("send")
+        self.isend(comm, buf, dest, tag).wait()
+
+    def _stream_rest(self, req: SendRequest, ack: Frag) -> None:
+        """Receiver matched our RNDV: push remaining FRAGs (RPUT analog)."""
+        dst_world, peer_req = ack.src, ack.meta["peer_req"]
+        ep = self.bml.endpoint(dst_world)
+        while not req.convertor.finished:
+            off = req.convertor.position
+            data = req.convertor.pack(ep.btl.max_send_size)
+            ep.btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
+                                 -1, 0, FRAG, data, total_len=req.nbytes,
+                                 offset=off, meta={"req_id": peer_req}))
+        self._send_reqs.pop(req.req_id, None)
+        req.complete()
+
+    # -- recv path -------------------------------------------------------
+    def irecv(self, comm, buf, source: int, tag: int) -> Request:
+        spc.record("irecv")
+        req = RecvRequest(self, comm, buf, source, tag)
+        dst_world = comm.world_rank(comm.rank)
+        key = (comm.cid, dst_world)
+        with self._lock:
+            st = self._match.setdefault(key, _MatchState())
+            # check the unexpected queue first (arrival order)
+            for i, frag in enumerate(st.unexpected):
+                comm_src = comm.group.rank_of(frag.src)
+                if req.matches(frag, comm_src):
+                    st.unexpected.pop(i)
+                    self._deliver_to_request(req, frag)
+                    return req
+            st.posted.append(req)
+        return req
+
+    def recv(self, comm, buf, source: int, tag: int) -> Status:
+        spc.record("recv")
+        return self.irecv(comm, buf, source, tag).wait()
+
+    def probe(self, comm, source: int, tag: int, blocking: bool):
+        spc.record("probe" if blocking else "iprobe")
+        from ompi_tpu.runtime.progress import progress
+
+        probe_req = RecvRequest(self, comm, np.empty(0, np.uint8), source, tag)
+        dst_world = comm.world_rank(comm.rank)
+        key = (comm.cid, dst_world)
+        while True:
+            with self._lock:
+                st = self._match.setdefault(key, _MatchState())
+                for frag in st.unexpected:
+                    comm_src = comm.group.rank_of(frag.src)
+                    if probe_req.matches(frag, comm_src):
+                        status = Status(source=comm_src, tag=frag.tag,
+                                        _nbytes=frag.total_len or len(frag.data))
+                        return status if blocking else (True, status)
+            if not blocking:
+                progress()
+                with self._lock:
+                    st = self._match.setdefault(key, _MatchState())
+                    for frag in st.unexpected:
+                        comm_src = comm.group.rank_of(frag.src)
+                        if probe_req.matches(frag, comm_src):
+                            status = Status(
+                                source=comm_src, tag=frag.tag,
+                                _nbytes=frag.total_len or len(frag.data))
+                            return True, status
+                return False, None
+            progress()
+
+    def mprobe(self, comm, source: int, tag: int, blocking: bool):
+        from ompi_tpu.runtime.progress import progress
+
+        probe_req = RecvRequest(self, comm, np.empty(0, np.uint8), source, tag)
+        dst_world = comm.world_rank(comm.rank)
+        key = (comm.cid, dst_world)
+        while True:
+            with self._lock:
+                st = self._match.setdefault(key, _MatchState())
+                for i, frag in enumerate(st.unexpected):
+                    comm_src = comm.group.rank_of(frag.src)
+                    if probe_req.matches(frag, comm_src):
+                        st.unexpected.pop(i)
+                        status = Status(source=comm_src, tag=frag.tag,
+                                        _nbytes=frag.total_len or len(frag.data))
+                        return Message(self, comm, frag, status) if blocking \
+                            else (True, Message(self, comm, frag, status))
+            if not blocking:
+                return False, None
+            progress()
+
+    def _cancel_recv(self, req: RecvRequest) -> bool:
+        with self._lock:
+            for st in self._match.values():
+                if req in st.posted:
+                    st.posted.remove(req)
+                    return True
+        return False
+
+    # -- fragment delivery (pml_ob1_recvfrag.c:450) ----------------------
+    def _recv_frag(self, frag: Frag) -> None:
+        if frag.kind == ACK:
+            req = self._send_reqs.get(frag.meta["req_id"])
+            if req is not None:
+                self._stream_rest(req, frag)
+            return
+        if frag.kind == FRAG:
+            self._recv_data_frag(frag)
+            return
+        if frag.kind == CTL:
+            handler = _ctl_handlers.get(frag.meta.get("proto"))
+            if handler is not None:
+                handler(frag)
+            return
+        key = (frag.cid, frag.dst)
+        with self._lock:
+            st = self._match.setdefault(key, _MatchState())
+            expected = st.expected_seq.get(frag.src, 0)
+            if frag.seq != expected:
+                # out-of-order arrival: hold by seq (recvfrag.c:106-147)
+                spc.record("out_of_sequence_msgs")
+                st.ooo.setdefault(frag.src, {})[frag.seq] = frag
+                return
+            self._match_one(st, frag)
+            st.expected_seq[frag.src] = expected + 1
+            # drain any now-in-order held frags
+            held = st.ooo.get(frag.src, {})
+            nxt = st.expected_seq[frag.src]
+            while nxt in held:
+                self._match_one(st, held.pop(nxt))
+                nxt += 1
+                st.expected_seq[frag.src] = nxt
+
+    def _match_one(self, st: _MatchState, frag: Frag) -> None:
+        """Match one in-sequence frag against posted recvs (recvfrag.c:831)."""
+        comm = None
+        for i, req in enumerate(st.posted):
+            comm_src = req.comm.group.rank_of(frag.src)
+            if req.matches(frag, comm_src):
+                st.posted.pop(i)
+                spc.record("matched_msgs")
+                self._deliver_to_request(req, frag)
+                return
+        spc.record("unexpected_msgs")
+        st.unexpected.append(frag)
+
+    def _deliver_to_request(self, req: RecvRequest, frag: Frag) -> None:
+        comm_src = req.comm.group.rank_of(frag.src)
+        req.matched_src = frag.src
+        req.total = frag.total_len or len(frag.data)
+        req.status.source = comm_src
+        req.status.tag = frag.tag
+        error = None
+        if req.total > req.capacity:
+            error = MpiError(ErrorClass.ERR_TRUNCATE,
+                             f"message of {req.total} bytes into "
+                             f"{req.capacity}-byte buffer")
+            req.total = req.capacity  # deliver what fits, like the reference
+        n = req.convertor.unpack(frag.data[:max(0, req.capacity)])
+        req.received += n
+        req.status._nbytes = min(req.total, req.received) if error else req.total
+        spc.record("bytes_received", n)
+        if frag.kind == RNDV and error is None:
+            # register for FRAG continuation and ACK the sender
+            self._recv_reqs[req.req_id] = req
+            ep = self.bml.endpoint(frag.src)
+            ep.btl.send(ep, Frag(frag.cid, frag.dst, frag.src, -1, 0, ACK,
+                                 meta={"req_id": frag.meta["req_id"],
+                                       "peer_req": req.req_id}))
+            if req.received >= req.total:
+                self._recv_reqs.pop(req.req_id, None)
+                req.status._nbytes = req.received
+                req.complete()
+            return
+        if error is not None or req.received >= req.total:
+            req.status._nbytes = req.received
+            req.complete(error)
+
+    def _recv_data_frag(self, frag: Frag) -> None:
+        req = self._recv_reqs.get(frag.meta["req_id"])
+        if req is None:
+            return
+        req.convertor.set_position(min(frag.offset, req.capacity))
+        n = req.convertor.unpack(frag.data)
+        req.received += n
+        spc.record("bytes_received", n)
+        if req.received >= min(req.total, req.capacity):
+            self._recv_reqs.pop(frag.meta["req_id"], None)
+            req.status._nbytes = req.received
+            req.complete()
+
+
+# control-message protocol handlers (osc / ft register here)
+_ctl_handlers: dict[str, callable] = {}
+
+
+def register_ctl_handler(proto: str, handler) -> None:
+    _ctl_handlers[proto] = handler
+
+
+class Ob1Component(Component):
+    name = "ob1"
+    priority = 20
+
+    def register_vars(self, fw) -> None:
+        self.register_var("priority", vtype=VarType.INT, default=20,
+                          help="Selection priority of pml/ob1")
+
+    def get_module(self, rte) -> Ob1Pml:
+        self._module = Ob1Pml(self, rte)
+        return self._module
+
+
+COMPONENT = Ob1Component()

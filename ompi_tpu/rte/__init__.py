@@ -1,0 +1,11 @@
+"""Runtime environment (RTE): launch, wire-up, coordination.
+
+The PMIx/PRRTE-equivalent layer (``/root/reference/ompi/runtime/ompi_rte.c``
++ external OpenPMIx): process naming, modex KV exchange, fences, event bus,
+spawn.  Two first-class process models:
+
+- **device-world** (TPU-native SPMD): one controller process, ranks are the
+  devices of a ``jax.sharding.Mesh``; collectives are XLA programs over ICI.
+- **multi-process**: classic MPI ranks launched by ``tpurun``, wired up
+  through the coordination service (``ompi_tpu.rte.coord``).
+"""

@@ -1,0 +1,135 @@
+"""RTE adapters: the process-model abstraction under the runtime.
+
+Equivalent of the PMIx client surface used by the reference
+(``ompi/runtime/ompi_rte.c:568`` ``PMIx_Init``; modex put/get; fences;
+events): an Rte provides identity (rank/size), the wire-up KV space, barriers
+outside MPI, locality, and — TPU-native — the device mesh that the coll/xla
+component compiles against.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+
+class Rte:
+    """Interface. ``my_world_rank``/``world_size`` are process identity."""
+
+    my_world_rank: int = 0
+    world_size: int = 1
+    is_device_world: bool = False
+
+    def modex_put(self, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def modex_get(self, rank: int, key: str) -> Any:
+        raise NotImplementedError
+
+    def fence(self) -> None:
+        """Out-of-band barrier + modex publication (``PMIx_Fence``)."""
+        raise NotImplementedError
+
+    def locality_color(self, split_type: str) -> int:
+        return 0  # single host / single slice
+
+    def event_notify(self, event: str, payload: Any) -> None:
+        pass
+
+    def finalize(self) -> None:
+        pass
+
+    # device resources ---------------------------------------------------
+    @property
+    def mesh(self):
+        return None
+
+    def device_of(self, world_rank: int):
+        return None
+
+
+class DeviceWorldRte(Rte):
+    """TPU-native SPMD world: ranks = devices of a 1-D mesh in one process.
+
+    The controller drives all ranks ("conductor" model): host p2p between
+    device-ranks runs through the in-process matching engine, device
+    collectives compile to one XLA program over the ICI mesh axis.  This is
+    the analog of `mpirun -n N --oversubscribe` on one node (every BTL is
+    btl/self-reachable) but with the ranks being real accelerator devices.
+    """
+
+    is_device_world = True
+
+    def __init__(self, devices=None, axis_name: str = "world") -> None:
+        import jax
+
+        if devices is None:
+            devices = jax.devices()
+            if len(devices) == 1 and devices[0].platform != "cpu":
+                pass  # single real chip: world of 1 device-rank
+        self.devices = list(devices)
+        self.axis_name = axis_name
+        self.world_size = len(self.devices)
+        self.my_world_rank = 0  # the conductor acts for every rank
+        from jax.sharding import Mesh
+
+        self._mesh = Mesh(np.array(self.devices), (axis_name,))
+        self._kv: dict[tuple[int, str], Any] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def device_of(self, world_rank: int):
+        return self.devices[world_rank]
+
+    def modex_put(self, key: str, value: Any, rank: Optional[int] = None) -> None:
+        with self._lock:
+            self._kv[(self.my_world_rank if rank is None else rank, key)] = value
+
+    def modex_get(self, rank: int, key: str) -> Any:
+        with self._lock:
+            return self._kv.get((rank, key))
+
+    def fence(self) -> None:
+        pass  # single process: nothing to synchronize out-of-band
+
+    def locality_color(self, split_type: str) -> int:
+        return 0
+
+
+class SingletonRte(Rte):
+    """Size-1 world with no devices (COMM_SELF-only / pure host usage)."""
+
+    def __init__(self) -> None:
+        self._kv: dict[tuple[int, str], Any] = {}
+
+    def modex_put(self, key: str, value: Any) -> None:
+        self._kv[(0, key)] = value
+
+    def modex_get(self, rank: int, key: str) -> Any:
+        return self._kv.get((rank, key))
+
+    def fence(self) -> None:
+        pass
+
+
+def detect() -> Rte:
+    """Pick the RTE for this process (``ompi_rte_init`` equivalent).
+
+    Launched under ``tpurun`` (OTPU_RANK/OTPU_NPROCS in env) → the
+    multi-process ProcRte (``ompi_tpu.rte.proc``).  Otherwise the
+    device-world SPMD model over local jax devices.
+    """
+    if "OTPU_RANK" in os.environ and "OTPU_NPROCS" in os.environ:
+        from ompi_tpu.rte.proc import ProcRte
+
+        return ProcRte()
+    try:
+        return DeviceWorldRte()
+    except Exception:
+        return SingletonRte()

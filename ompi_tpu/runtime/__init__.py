@@ -1,0 +1,4 @@
+"""Runtime: init/finalize state machine, progress engine, RTE adapters, SPC.
+
+Equivalent of ``/root/reference/ompi/runtime/`` + ``opal/runtime/``.
+"""

@@ -1,0 +1,200 @@
+"""Init/finalize state machine (``ompi/runtime/ompi_mpi_init.c:391`` flow).
+
+Order mirrors the reference: base/var init → RTE init (PMIx equivalent) →
+pml selection → modex fence → world/self construction → per-comm coll
+selection (``ompi_mpi_init.c:449-962``).
+"""
+from __future__ import annotations
+
+import atexit
+import enum
+import sys
+import threading
+from typing import Optional
+
+from ompi_tpu.base import mca
+from ompi_tpu.base.containers import Bitmap
+from ompi_tpu.base.var import VarType, mark_runtime_initialized, registry
+
+
+class State(enum.IntEnum):
+    NOT_INITIALIZED = 0
+    INIT_STARTED = 1
+    INIT_COMPLETED = 2
+    FINALIZE_STARTED = 3
+    FINALIZE_COMPLETED = 4
+
+
+_lock = threading.RLock()
+_state = State.NOT_INITIALIZED
+_world = None
+_self = None
+_rte = None
+_cid_map = Bitmap(64)
+_cid_lock = threading.Lock()
+
+
+def initialized() -> bool:
+    return _state in (State.INIT_STARTED, State.INIT_COMPLETED)
+
+
+def finalized() -> bool:
+    return _state >= State.FINALIZE_STARTED
+
+
+def get_rte():
+    return _rte
+
+
+# -- CID space ----------------------------------------------------------
+
+def next_local_cid() -> int:
+    with _cid_lock:
+        return _cid_map.find_and_set_first_unset()
+
+
+def reserve_cid(cid: int) -> None:
+    with _cid_lock:
+        _cid_map.set(cid)
+
+
+def release_cid(cid: int) -> None:
+    with _cid_lock:
+        _cid_map.clear(cid)
+
+
+# -- init / finalize ----------------------------------------------------
+
+def init(devices=None, rte=None, argv: Optional[list] = None):
+    """Initialize the runtime; idempotent (returns COMM_WORLD)."""
+    global _state, _world, _self, _rte
+    with _lock:
+        if _state is State.INIT_COMPLETED:
+            return _world
+        if _state is State.FINALIZE_STARTED or _state is State.FINALIZE_COMPLETED:
+            raise RuntimeError("cannot re-init after finalize")
+        _state = State.INIT_STARTED
+
+        if argv:
+            registry.parse_cli(argv)
+
+        # RTE wire-up (ompi_mpi_init.c:516 → PMIx_Init equivalent)
+        from ompi_tpu.rte import base as rte_base
+
+        if rte is not None:
+            _rte = rte
+        elif devices is not None:
+            _rte = rte_base.DeviceWorldRte(devices)
+        else:
+            _rte = rte_base.detect()
+
+        # SPC counters
+        from ompi_tpu.runtime import spc
+
+        spc.init()
+
+        # pml selection (ompi_mpi_init.c:630)
+        pml_fw = mca.framework("pml", "point-to-point messaging layer")
+        pml_comp = pml_fw.select()
+        if pml_comp is None:
+            raise RuntimeError("no pml component available")
+        pml_module = pml_comp.get_module(_rte)
+
+        # modex exchange of endpoints (ompi_mpi_init.c:682-701)
+        _rte.fence()
+
+        # world/self communicators (ompi_mpi_init.c:779)
+        from ompi_tpu.api.comm import Comm
+        from ompi_tpu.api.group import Group
+
+        world_group = Group(range(_rte.world_size))
+        _world = Comm(world_group, cid=0, rte=_rte, name="COMM_WORLD")
+        reserve_cid(0)
+        my = _rte.my_world_rank
+        _self = Comm(Group([my]), cid=1, rte=_rte, name="COMM_SELF")
+        reserve_cid(1)
+        _world.pml = pml_module
+        _self.pml = pml_module
+        pml_module.add_comm(_world)
+        pml_module.add_comm(_self)
+
+        # per-comm coll selection (ompi_mpi_init.c:956,962)
+        from ompi_tpu.mca.coll.base import comm_select
+
+        comm_select(_world)
+        comm_select(_self)
+
+        mark_runtime_initialized(True)
+        _state = State.INIT_COMPLETED
+        atexit.register(_atexit_finalize)
+        return _world
+
+
+def comm_world():
+    if _world is None:
+        init()
+    return _world
+
+
+def comm_self():
+    if _self is None:
+        init()
+    return _self
+
+
+# Upper-case aliases used by the lazy top-level API
+def COMM_WORLD():  # pragma: no cover - thin alias
+    return comm_world()
+
+
+def COMM_SELF():  # pragma: no cover - thin alias
+    return comm_self()
+
+
+def finalize() -> None:
+    global _state, _world, _self, _rte
+    with _lock:
+        if _state is not State.INIT_COMPLETED:
+            return
+        _state = State.FINALIZE_STARTED
+        try:
+            if _world is not None and _world.pml is not None:
+                fin = getattr(_world.pml, "finalize", None)
+                if fin is not None:
+                    fin()
+            if _rte is not None:
+                _rte.finalize()
+            mca.close_all()
+        finally:
+            from ompi_tpu.runtime import progress
+
+            progress.reset_for_testing()
+            mark_runtime_initialized(False)
+            _world = _self = _rte = None
+            with _cid_lock:
+                _cid_map.clear_all()
+            _state = State.FINALIZE_COMPLETED
+
+
+def _atexit_finalize() -> None:
+    try:
+        finalize()
+    except Exception:
+        pass
+
+
+def reset_for_testing() -> None:
+    """Full teardown allowing re-init (tests only)."""
+    global _state
+    finalize()
+    with _lock:
+        _state = State.NOT_INITIALIZED
+
+
+def abort(obj, errorcode: int = 1) -> None:
+    """``MPI_Abort``: tear down the job."""
+    print(f"[ompi_tpu] MPI_Abort on {obj!r} with code {errorcode}",
+          file=sys.stderr, flush=True)
+    if _rte is not None:
+        _rte.event_notify("abort", {"code": errorcode})
+    sys.exit(errorcode)

@@ -1,0 +1,87 @@
+"""Central progress engine — THE hot loop of the host-side runtime.
+
+Re-design of ``/root/reference/opal/runtime/opal_progress.c``: registered
+callbacks are polled by :func:`progress` (``opal_progress.c:216,224``);
+low-priority callbacks run every 8th call (``:227``); components register via
+:func:`register` / :func:`unregister` (``:414``).  On the ICI path XLA
+schedules collectives itself and needs no progress engine — this loop serves
+the host-side stack: BTL polling (tcp/sm), rendezvous pipelines, nonblocking
+collective schedules (libnbc equivalent), FT heartbeats, RMA passive targets.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+_LOW_PRIORITY_CADENCE = 8  # opal_progress.c:227
+
+_lock = threading.RLock()
+_callbacks: list[Callable[[], int]] = []
+_lp_callbacks: list[Callable[[], int]] = []
+_counter = 0
+_in_progress = threading.local()
+
+
+def register(cb: Callable[[], int], low_priority: bool = False) -> None:
+    """Register a callback returning the number of events it progressed."""
+    with _lock:
+        target = _lp_callbacks if low_priority else _callbacks
+        if cb not in target:
+            target.append(cb)
+
+
+def unregister(cb: Callable[[], int]) -> None:
+    with _lock:
+        for target in (_callbacks, _lp_callbacks):
+            if cb in target:
+                target.remove(cb)
+
+
+def progress() -> int:
+    """Poll all registered callbacks once; returns events progressed."""
+    global _counter
+    if getattr(_in_progress, "active", False):
+        return 0  # no recursive progress (callbacks may wait internally)
+    _in_progress.active = True
+    try:
+        with _lock:
+            cbs = list(_callbacks)
+            _counter += 1
+            if _counter % _LOW_PRIORITY_CADENCE == 0:
+                cbs += _lp_callbacks
+        events = 0
+        for cb in cbs:
+            try:
+                events += cb()
+            except Exception:
+                # a broken progress callback must not kill the loop; it is
+                # removed and reported once
+                unregister(cb)
+                from ompi_tpu.base.output import show_help
+
+                import traceback
+
+                show_help("help-progress", "callback-failed",
+                          detail=traceback.format_exc(limit=3))
+        return events
+    finally:
+        _in_progress.active = False
+
+
+def callback_count() -> int:
+    with _lock:
+        return len(_callbacks) + len(_lp_callbacks)
+
+
+def reset_for_testing() -> None:
+    global _counter
+    with _lock:
+        _callbacks.clear()
+        _lp_callbacks.clear()
+        _counter = 0
+
+
+from ompi_tpu.base.output import register_help as _rh
+
+_rh("help-progress", "callback-failed",
+    "A progress callback raised and was unregistered:\n{detail}")

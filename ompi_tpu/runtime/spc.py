@@ -1,0 +1,39 @@
+"""Software performance counters (``ompi/runtime/ompi_spc.c`` — inline
+counters bumped in the bindings, exported as MPI_T-style pvars)."""
+from __future__ import annotations
+
+from ompi_tpu.base.var import PvarClass, registry
+
+_COUNTERS = (
+    "send", "isend", "recv", "irecv", "sendrecv", "probe", "iprobe",
+    "bcast", "reduce", "allreduce", "gather", "scatter", "allgather",
+    "alltoall", "reduce_scatter", "scan", "exscan", "barrier",
+    "ibcast", "iallreduce", "ibarrier",
+    "bytes_sent", "bytes_received", "bytes_packed", "bytes_unpacked",
+    "unexpected_msgs", "out_of_sequence_msgs", "matched_msgs",
+    "device_collectives", "device_bytes",
+)
+
+_pvars = {}
+
+
+def init() -> None:
+    for name in _COUNTERS:
+        _pvars[name] = registry.register_pvar(
+            "runtime", "spc", name, pclass=PvarClass.COUNTER,
+            help=f"SPC counter: number/volume of {name}")
+
+
+def record(name: str, value: float = 1) -> None:
+    pv = _pvars.get(name)
+    if pv is not None:
+        pv.add(value)
+
+
+def read(name: str) -> float:
+    pv = _pvars.get(name)
+    return 0 if pv is None else pv.read()
+
+
+def counters() -> dict:
+    return {k: v.read() for k, v in _pvars.items()}

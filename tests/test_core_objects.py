@@ -1,0 +1,195 @@
+"""Core object tests: group/op/info/status/attributes/request (SURVEY §2.2)."""
+import numpy as np
+import pytest
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.group import GROUP_EMPTY, IDENT, SIMILAR, UNEQUAL, Group
+from ompi_tpu.api.info import Info
+from ompi_tpu.api.request import (
+    CompletedRequest,
+    GeneralizedRequest,
+    Request,
+    waitall,
+    waitany,
+)
+from ompi_tpu.api.request import testall as req_testall
+from ompi_tpu.api.request import testany as req_testany
+from ompi_tpu.api.status import UNDEFINED, Status
+from ompi_tpu.api import attributes as attr
+from ompi_tpu.datatype import FLOAT_INT, FLOAT32, contiguous
+
+
+# -- Group ---------------------------------------------------------------
+
+def test_group_basics():
+    g = Group([4, 2, 7])
+    assert g.size == 3
+    assert g.rank_of(7) == 2
+    assert g.rank_of(5) == UNDEFINED
+    assert g.world_rank(0) == 4
+
+
+def test_group_set_ops():
+    a, b = Group([0, 1, 2, 3]), Group([2, 3, 4])
+    assert a.union(b).world_ranks == (0, 1, 2, 3, 4)
+    assert a.intersection(b).world_ranks == (2, 3)
+    assert a.difference(b).world_ranks == (0, 1)
+    assert a.incl([3, 1]).world_ranks == (3, 1)
+    assert a.excl([0, 2]).world_ranks == (1, 3)
+
+
+def test_group_ranges():
+    g = Group(list(range(10)))
+    assert g.range_incl([(0, 8, 2)]).world_ranks == (0, 2, 4, 6, 8)
+    assert g.range_excl([(0, 8, 2)]).world_ranks == (1, 3, 5, 7, 9)
+
+
+def test_group_translate_compare():
+    a, b = Group([5, 6, 7]), Group([7, 6, 5])
+    assert a.translate_ranks([0, 2], b) == [2, 0]
+    assert a.compare(b) == SIMILAR
+    assert a.compare(Group([5, 6, 7])) == IDENT
+    assert a.compare(Group([5, 6])) == UNEQUAL
+    assert GROUP_EMPTY.size == 0
+
+
+def test_group_duplicate_ranks_rejected():
+    with pytest.raises(MpiError):
+        Group([1, 1])
+
+
+# -- Op ------------------------------------------------------------------
+
+def test_builtin_ops():
+    a = np.array([1, 5, 3], np.int64)
+    b = np.array([4, 2, 6], np.int64)
+    assert list(op_mod.SUM.reduce_arrays(a, b)) == [5, 7, 9]
+    assert list(op_mod.MAX.reduce_arrays(a, b)) == [4, 5, 6]
+    assert list(op_mod.MIN.reduce_arrays(a, b)) == [1, 2, 3]
+    assert list(op_mod.PROD.reduce_arrays(a, b)) == [4, 10, 18]
+    assert list(op_mod.BXOR.reduce_arrays(a, b)) == [5, 7, 5]
+    assert list(op_mod.LAND.reduce_arrays(np.array([1, 0]), np.array([1, 1]))) \
+        == [1, 0]
+
+
+def test_maxloc_minloc():
+    dt = np.dtype([("v", np.float32), ("i", np.int32)], align=True)
+    a = np.array([(3.0, 0), (1.0, 0)], dtype=dt)
+    b = np.array([(3.0, 1), (2.0, 1)], dtype=dt)
+    r = op_mod.MAXLOC.reduce_arrays(a, b)
+    assert r["v"].tolist() == [3.0, 2.0]
+    assert r["i"].tolist() == [0, 1]  # tie → lower index
+    r2 = op_mod.MINLOC.reduce_arrays(a, b)
+    assert r2["v"].tolist() == [3.0, 1.0]
+    assert r2["i"].tolist() == [0, 0]
+
+
+def test_user_op_and_commutativity():
+    def fn(invec, inoutvec, dt):
+        inoutvec[...] = invec * 2 + inoutvec
+
+    op = op_mod.create(fn, commute=False)
+    assert not op.commute
+    out = op.reduce_arrays(np.array([1, 2]), np.array([10, 20]))
+    assert out.tolist() == [12, 24]
+
+
+def test_jax_fold_rejects_unloweratable():
+    with pytest.raises(MpiError):
+        op_mod.jax_fold(op_mod.MAXLOC)
+
+
+# -- Info / Status / attributes -----------------------------------------
+
+def test_info():
+    i = Info()
+    i.set("key", "val")
+    assert i.get("key") == "val"
+    assert i.get_nkeys() == 1
+    assert i.get_nthkey(0) == "key"
+    d = i.dup()
+    i.delete("key")
+    assert d.get("key") == "val"
+    with pytest.raises(KeyError):
+        i.delete("missing")
+
+
+def test_status_count_semantics():
+    dt = contiguous(4, FLOAT32)
+    st = Status(_nbytes=32)
+    assert st.get_count(dt) == 2
+    st2 = Status(_nbytes=30)
+    assert st2.get_count(dt) == UNDEFINED
+    assert st2.get_elements(dt) == 7
+
+
+class _Obj(attr.AttributeHost):
+    def __repr__(self):
+        return "_Obj"
+
+
+def test_attributes_copy_delete():
+    deleted = []
+    kv = attr.keyval_create(
+        copy_fn=lambda o, k, e, v: (True, v + 1),
+        delete_fn=lambda o, k, v, e: deleted.append(v))
+    a, b = _Obj(), _Obj()
+    a.attr_put(kv, 41)
+    assert a.attr_get(kv) == (True, 41)
+    a._attrs_copy_to(b)
+    assert b.attr_get(kv) == (True, 42)
+    a.attr_delete(kv)
+    assert deleted == [41]
+    assert a.attr_get(kv) == (False, None)
+    attr.keyval_free(kv)
+    with pytest.raises(KeyError):
+        b.attr_put(kv, 0)
+
+
+# -- Request -------------------------------------------------------------
+
+def test_request_complete_and_wait():
+    r = Request()
+    assert not r.complete_flag
+    r.complete()
+    assert r.wait() is r.status
+    done, st = r.test()
+    assert done
+
+
+def test_request_error_propagates():
+    r = Request()
+    r.complete(MpiError(ErrorClass.ERR_TRUNCATE, "too big"))
+    with pytest.raises(MpiError) as ei:
+        r.wait()
+    assert ei.value.error_class is ErrorClass.ERR_TRUNCATE
+
+
+def test_request_callbacks_fire_once():
+    seen = []
+    r = Request()
+    r.on_complete(lambda req: seen.append(1))
+    r.complete()
+    r.on_complete(lambda req: seen.append(2))  # late registration fires now
+    r.complete()  # idempotent
+    assert seen == [1, 2]
+
+
+def test_waitall_testany():
+    rs = [CompletedRequest(), CompletedRequest()]
+    assert len(waitall(rs)) == 2
+    ok, idx, st = req_testany(rs)
+    assert ok and idx == 0
+    ok, stats = req_testall(rs)
+    assert ok and len(stats) == 2
+    i, st = waitany(rs)
+    assert i == 0
+
+
+def test_generalized_request():
+    r = GeneralizedRequest(query_fn=lambda st: st.set_elements(FLOAT32, 3))
+    assert not r.complete_flag
+    r.grequest_complete()
+    st = r.wait()
+    assert st.get_count(FLOAT32) == 3
