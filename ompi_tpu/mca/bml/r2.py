@@ -19,9 +19,27 @@ class Bml:
         self.rte = rte
         self._endpoints: dict[int, list[Endpoint]] = {}
         fw = mca.framework("btl", "byte transfer layer", multi_select=True)
-        self.btls = fw.select_all()
-        for btl in self.btls:
+        self.btls = []
+        for btl in fw.select_all():
             btl.set_recv_callback(recv_cb)
+            setup = getattr(btl, "setup", None)
+            if setup is not None:
+                try:
+                    if setup(rte) is False:
+                        continue  # transport not usable in this process model
+                except Exception as exc:
+                    from ompi_tpu.base import output as _o
+
+                    _o.output(fw.stream, 1, "btl %s setup failed: %s",
+                              btl.name, exc)
+                    close = getattr(btl, "close", None)
+                    if close is not None:
+                        try:
+                            close()  # release partially-acquired resources
+                        except Exception:
+                            pass
+                    continue
+            self.btls.append(btl)
             from ompi_tpu.runtime import progress as prog
 
             prog.register(btl.progress)
@@ -50,6 +68,8 @@ class Bml:
         return eps
 
     def finalize(self) -> None:
+        # resource release itself happens in each component's close() via
+        # the framework close lifecycle (mca.close_all in runtime finalize)
         from ompi_tpu.runtime import progress as prog
 
         for btl in self.btls:

@@ -1,0 +1,195 @@
+"""btl/tcp — socket transport, the DCN analog.
+
+Re-design of ``/root/reference/opal/mca/btl/tcp/`` (5,117 LoC): a listening
+socket per process whose address is published through the modex
+(``btl_tcp_addr``), lazy connects on first send with a rank handshake,
+length-prefixed pickled fragments, and nonblocking IO drained from the
+central progress engine (the reference polls through libevent from
+``opal_progress``).  Eager/rendezvous thresholds are MCA vars like the
+reference's ``btl_tcp_eager_limit`` family (``btl.h:1162-1165``).
+"""
+from __future__ import annotations
+
+import errno
+import pickle
+import selectors
+import socket
+import struct
+import time
+from typing import Optional
+
+from ompi_tpu.base.var import VarType
+from ompi_tpu.mca.btl.base import Btl, Endpoint, Frag
+
+_LEN = struct.Struct("!I")
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket, rank: Optional[int] = None):
+        self.sock = sock
+        self.rank = rank
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+
+
+class TcpBtl(Btl):
+    name = "tcp"
+    priority = 10
+    eager_limit = 64 * 1024
+    rndv_eager_limit = 64 * 1024
+    max_send_size = 128 * 1024
+    latency = 100
+    bandwidth = 100
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._rte = None
+        self._listener: Optional[socket.socket] = None
+        self._sel = selectors.DefaultSelector()
+        self._by_rank: dict[int, _Conn] = {}
+
+    def register_vars(self, fw) -> None:
+        self.register_var(
+            "eager_limit", vtype=VarType.SIZE, default="64k",
+            help="Max eager message size over tcp",
+            on_set=lambda v: setattr(self, "eager_limit", v))
+        self.register_var(
+            "max_send_size", vtype=VarType.SIZE, default="128k",
+            help="Max fragment size for rendezvous streaming over tcp",
+            on_set=lambda v: setattr(self, "max_send_size", v))
+
+    # -- lifecycle -------------------------------------------------------
+    def setup(self, rte) -> bool:
+        """Listen + publish our address (pre-fence), multi-process only."""
+        if rte.is_device_world or rte.world_size <= 1:
+            return False
+        if not hasattr(rte, "modex_put"):
+            return False
+        self._rte = rte
+        self._listener = socket.create_server(("127.0.0.1", 0), backlog=64)
+        self._listener.setblocking(False)
+        self._sel.register(self._listener, selectors.EVENT_READ, "listener")
+        rte.modex_put("btl_tcp_addr", self._listener.getsockname())
+        return True
+
+    def reachable(self, world_rank: int, rte) -> Optional[Endpoint]:
+        if self._rte is None or world_rank == rte.my_world_rank:
+            return None
+        return Endpoint(self, world_rank)
+
+    # -- send path -------------------------------------------------------
+    def _connect(self, rank: int) -> _Conn:
+        conn = self._by_rank.get(rank)
+        if conn is not None:
+            return conn
+        addr = self._rte.modex_get(rank, "btl_tcp_addr")
+        if addr is None:
+            raise ConnectionError(f"no tcp address for rank {rank}")
+        sock = socket.create_connection(tuple(addr), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(sock, rank)
+        # handshake: tell the peer who we are
+        hello = pickle.dumps({"rank": self._rte.my_world_rank})
+        sock.sendall(_LEN.pack(len(hello)) + hello)
+        sock.setblocking(False)
+        self._sel.register(sock, selectors.EVENT_READ, conn)
+        self._by_rank[rank] = conn
+        return conn
+
+    def send(self, ep: Endpoint, frag: Frag) -> None:
+        conn = self._connect(ep.world_rank)
+        payload = pickle.dumps(frag)
+        conn.outbuf += _LEN.pack(len(payload)) + payload
+        self._flush(conn)
+
+    def _flush(self, conn: _Conn) -> None:
+        while conn.outbuf:
+            try:
+                n = conn.sock.send(conn.outbuf)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            if n == 0:
+                return
+            del conn.outbuf[:n]
+
+    # -- progress --------------------------------------------------------
+    def progress(self) -> int:
+        events = 0
+        try:
+            ready = self._sel.select(timeout=0)
+        except OSError:
+            return 0
+        for key, _ in ready:
+            if key.data == "listener":
+                try:
+                    sock, _ = self._listener.accept()
+                except OSError:
+                    continue
+                sock.setblocking(False)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                conn = _Conn(sock)
+                self._sel.register(sock, selectors.EVENT_READ, conn)
+                continue
+            conn: _Conn = key.data
+            try:
+                data = conn.sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                try:
+                    self._sel.unregister(conn.sock)
+                    conn.sock.close()
+                except (OSError, KeyError):
+                    pass
+                if conn.rank is not None:
+                    self._by_rank.pop(conn.rank, None)
+                continue
+            conn.inbuf += data
+            events += self._drain(conn)
+        for conn in list(self._by_rank.values()):
+            if conn.outbuf:
+                self._flush(conn)
+        return events
+
+    def _drain(self, conn: _Conn) -> int:
+        events = 0
+        while True:
+            if len(conn.inbuf) < _LEN.size:
+                return events
+            (n,) = _LEN.unpack(conn.inbuf[:_LEN.size])
+            if len(conn.inbuf) < _LEN.size + n:
+                return events
+            payload = bytes(conn.inbuf[_LEN.size:_LEN.size + n])
+            del conn.inbuf[:_LEN.size + n]
+            obj = pickle.loads(payload)
+            if isinstance(obj, dict) and "rank" in obj and conn.rank is None:
+                conn.rank = obj["rank"]
+                # keep at most one conn per rank (cross-connect resolution)
+                self._by_rank.setdefault(conn.rank, conn)
+                continue
+            if self._recv_cb is not None:
+                self._recv_cb(obj)
+                events += 1
+
+    def close(self) -> None:
+        for conn in list(self._by_rank.values()):
+            try:
+                self._sel.unregister(conn.sock)
+                conn.sock.close()
+            except (OSError, KeyError):
+                pass
+        self._by_rank.clear()
+        if self._listener is not None:
+            try:
+                self._sel.unregister(self._listener)
+                self._listener.close()
+            except (OSError, KeyError):
+                pass
+            self._listener = None
+
+
+COMPONENT = TcpBtl()

@@ -25,9 +25,15 @@ from ompi_tpu.base.var import VarType
 
 
 def _fold(op: op_mod.Op, stack: np.ndarray) -> np.ndarray:
-    """Reduce over the leading (rank) axis with an MPI op."""
-    acc = np.array(stack[0], copy=True)
-    for i in range(1, stack.shape[0]):
+    """Reduce over the leading (rank) axis with an MPI op.
+
+    Folds right-to-left: with the op convention inout = in (op) inout this
+    yields b0 (op) (b1 (op) (... bn-1)), preserving rank order for
+    non-commutative user ops.
+    """
+    n = stack.shape[0]
+    acc = np.array(stack[n - 1], copy=True)
+    for i in range(n - 2, -1, -1):
         op(stack[i], acc)
     return acc
 

@@ -1,0 +1,200 @@
+"""Coordination service — the PMIx/PRRTE-equivalent wire-up server.
+
+Plays the role OpenPMIx plays for the reference (``ompi/runtime/
+ompi_rte.c:568`` ``PMIx_Init``; ``PMIx_Fence`` modex at
+``ompi_mpi_init.c:682-701``; PMIx events for ULFM): a small TCP server owned
+by the launcher (``tpurun``) providing the job KV space (modex), fences,
+pub/sub events (failure notification rides here), and job control (abort).
+Protocol: length-prefixed pickle frames (trusted within one job, like PMIx's
+unix-socket wire protocol).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Optional
+
+_LEN = struct.Struct("!I")
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("coordination peer closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 16, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("coordination peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class CoordServer:
+    """Job-wide KV + fence + event service (runs inside the launcher)."""
+
+    def __init__(self, nprocs: int, host: str = "127.0.0.1", port: int = 0):
+        self.nprocs = nprocs
+        self._kv: dict[tuple, Any] = {}
+        self._kv_cond = threading.Condition()
+        self._fence_count: dict[str, int] = {}
+        self._fence_gen: dict[str, int] = {}
+        self._fence_cond = threading.Condition()
+        self._events: list[tuple[int, str, Any]] = []
+        self._event_seq = 0
+        self._event_cond = threading.Condition()
+        self._aborted: Optional[int] = None
+        self._srv = socket.create_server((host, port))
+        self.addr = self._srv.getsockname()
+        self._threads: list[threading.Thread] = []
+        self._accepting = True
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- server internals ------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._accepting:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = _recv_frame(conn)
+                op = req["op"]
+                if op == "put":
+                    with self._kv_cond:
+                        self._kv[(req["rank"], req["key"])] = req["value"]
+                        self._kv_cond.notify_all()
+                    _send_frame(conn, {"ok": True})
+                elif op == "get":
+                    deadline = time.monotonic() + req.get("timeout", 60.0)
+                    with self._kv_cond:
+                        while (req["rank"], req["key"]) not in self._kv:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0 or not req.get("wait", True):
+                                break
+                            self._kv_cond.wait(min(remaining, 1.0))
+                        val = self._kv.get((req["rank"], req["key"]))
+                    _send_frame(conn, {"ok": True, "value": val})
+                elif op == "fence":
+                    fid = req["id"]
+                    with self._fence_cond:
+                        self._fence_count[fid] = self._fence_count.get(fid, 0) + 1
+                        if self._fence_count[fid] >= self.nprocs:
+                            self._fence_count[fid] = 0
+                            self._fence_gen[fid] = self._fence_gen.get(fid, 0) + 1
+                            self._fence_cond.notify_all()
+                            gen = self._fence_gen[fid]
+                        else:
+                            gen = self._fence_gen.get(fid, 0)
+                            while self._fence_gen.get(fid, 0) == gen:
+                                self._fence_cond.wait(1.0)
+                                if self._aborted is not None:
+                                    break
+                    _send_frame(conn, {"ok": True})
+                elif op == "event_pub":
+                    with self._event_cond:
+                        self._event_seq += 1
+                        self._events.append(
+                            (self._event_seq, req["name"], req["payload"]))
+                        self._event_cond.notify_all()
+                    _send_frame(conn, {"ok": True})
+                elif op == "event_poll":
+                    since = req["since"]
+                    with self._event_cond:
+                        out = [e for e in self._events if e[0] > since]
+                    _send_frame(conn, {"ok": True, "events": out})
+                elif op == "abort":
+                    self._aborted = req.get("code", 1)
+                    with self._fence_cond:
+                        self._fence_cond.notify_all()
+                    _send_frame(conn, {"ok": True})
+                elif op == "ping":
+                    _send_frame(conn, {"ok": True, "nprocs": self.nprocs,
+                                       "aborted": self._aborted})
+                else:
+                    _send_frame(conn, {"ok": False, "error": f"bad op {op}"})
+        except (ConnectionError, OSError):
+            return
+
+    @property
+    def aborted(self) -> Optional[int]:
+        return self._aborted
+
+    def close(self) -> None:
+        self._accepting = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class CoordClient:
+    """Per-process client (the PMIx client analog)."""
+
+    def __init__(self, addr: Optional[tuple] = None):
+        if addr is None:
+            spec = os.environ["OTPU_COORD"]
+            host, port = spec.rsplit(":", 1)
+            addr = (host, int(port))
+        self._sock = socket.create_connection(addr, timeout=120)
+        self._lock = threading.Lock()
+        self._event_since = 0
+
+    def _rpc(self, **req) -> dict:
+        with self._lock:
+            _send_frame(self._sock, req)
+            resp = _recv_frame(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"coordination error: {resp.get('error')}")
+        return resp
+
+    def put(self, rank: int, key: str, value: Any) -> None:
+        self._rpc(op="put", rank=rank, key=key, value=value)
+
+    def get(self, rank: int, key: str, wait: bool = True,
+            timeout: float = 60.0) -> Any:
+        return self._rpc(op="get", rank=rank, key=key, wait=wait,
+                         timeout=timeout)["value"]
+
+    def fence(self, fence_id: str = "default") -> None:
+        self._rpc(op="fence", id=fence_id)
+
+    def event_publish(self, name: str, payload: Any) -> None:
+        self._rpc(op="event_pub", name=name, payload=payload)
+
+    def event_poll(self) -> list[tuple[int, str, Any]]:
+        resp = self._rpc(op="event_poll", since=self._event_since)
+        events = resp["events"]
+        if events:
+            self._event_since = events[-1][0]
+        return events
+
+    def abort(self, code: int = 1) -> None:
+        self._rpc(op="abort", code=code)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

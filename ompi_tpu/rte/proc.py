@@ -1,0 +1,52 @@
+"""ProcRte — the multi-process RTE (one MPI rank per OS process).
+
+The classic Open MPI process model: ``tpurun`` launches N processes, each
+connecting back to the coordination service for identity, modex, and fences
+(the ``PMIx_Init`` path of ``ompi_rte.c:528-568``).  Device resources in
+this model are per-process (multi-controller JAX: each process owns its
+local TPU chips; cross-process device collectives ride DCN via
+``jax.distributed`` — wired in the parallel layer).
+"""
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Optional
+
+from ompi_tpu.rte.base import Rte
+from ompi_tpu.rte.coord import CoordClient
+
+
+class ProcRte(Rte):
+    is_device_world = False
+
+    def __init__(self) -> None:
+        self.my_world_rank = int(os.environ["OTPU_RANK"])
+        self.world_size = int(os.environ["OTPU_NPROCS"])
+        self.client = CoordClient()
+        self._hostname = socket.gethostname()
+        self.modex_put("hostname", self._hostname)
+        self._fence_counter = 0
+
+    def modex_put(self, key: str, value: Any) -> None:
+        self.client.put(self.my_world_rank, key, value)
+
+    def modex_get(self, rank: int, key: str, wait: bool = True) -> Any:
+        return self.client.get(rank, key, wait=wait)
+
+    def fence(self) -> None:
+        self._fence_counter += 1
+        self.client.fence(f"f{self._fence_counter}")
+
+    def locality_color(self, split_type: str) -> int:
+        # 'shared' → same host (the sm/ICI domain)
+        return abs(hash(self._hostname)) % (1 << 30)
+
+    def event_notify(self, event: str, payload: Any) -> None:
+        self.client.event_publish(event, payload)
+
+    def event_poll(self):
+        return self.client.event_poll()
+
+    def finalize(self) -> None:
+        self.client.close()

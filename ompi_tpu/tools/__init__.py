@@ -1,0 +1,2 @@
+"""Tools: tpurun launcher (mpirun equivalent), otpu_info (ompi_info
+equivalent), otpu_sync clock-offset tool (mpisync equivalent)."""

@@ -1,0 +1,113 @@
+"""tpurun — the mpirun-equivalent launcher.
+
+The reference's ``mpirun`` is a symlink to PRRTE's ``prte``
+(``ompi/tools/mpirun/Makefile.am:3-7``): it launches processes and gives
+them a PMIx server.  tpurun does the same for one host: starts the
+coordination service (``ompi_tpu.rte.coord.CoordServer``), spawns N ranks
+with identity in the environment, streams their output with rank prefixes,
+and tears the job down on first failure (mpirun's kill-job-on-abort
+behavior).  Multi-host launch composes this with any remote executor (ssh,
+k8s, slurm) pointing OTPU_COORD at rank 0's server.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurun", description="Launch an ompi_tpu multi-process job")
+    ap.add_argument("-n", "-np", type=int, default=1, dest="nprocs")
+    ap.add_argument("--mca", action="append", nargs=2, default=[],
+                    metavar=("NAME", "VALUE"),
+                    help="Set an MCA variable for all ranks")
+    ap.add_argument("--tag-output", action="store_true", default=True)
+    ap.add_argument("--coord-port", type=int, default=0)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+
+    from ompi_tpu.rte.coord import CoordServer
+
+    server = CoordServer(args.nprocs, port=args.coord_port)
+    host, port = server.addr
+
+    env_base = dict(os.environ)
+    env_base["OTPU_NPROCS"] = str(args.nprocs)
+    env_base["OTPU_COORD"] = f"{host}:{port}"
+    for name, value in args.mca:
+        key = name if name.startswith("otpu_") else name
+        env_base["OTPU_MCA_" + key.removeprefix("otpu_")] = value
+
+    procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
+
+    def _pump(rank: int, stream) -> None:
+        for line in iter(stream.readline, b""):
+            sys.stdout.write(f"[{rank}] {line.decode(errors='replace')}")
+            sys.stdout.flush()
+
+    for rank in range(args.nprocs):
+        env = dict(env_base)
+        env["OTPU_RANK"] = str(rank)
+        try:
+            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+        except OSError as exc:
+            print(f"tpurun: cannot launch {cmd[0]!r}: {exc}", file=sys.stderr)
+            for q in procs:
+                q.kill()
+            server.close()
+            return 127
+        procs.append(p)
+        t = threading.Thread(target=_pump, args=(rank, p.stdout), daemon=True)
+        t.start()
+        pumps.append(t)
+
+    exit_code = 0
+    try:
+        while True:
+            alive = [p for p in procs if p.poll() is None]
+            failed = [p for p in procs
+                      if p.poll() is not None and p.returncode != 0]
+            if server.aborted is not None:
+                exit_code = server.aborted
+                break
+            if failed:
+                exit_code = failed[0].returncode
+                break
+            if not alive:
+                break
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        exit_code = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                if exit_code:
+                    p.kill()  # job teardown on failure, like mpirun
+                else:
+                    p.wait()
+        for p in procs:
+            p.wait()
+        for t in pumps:
+            t.join(timeout=2)
+        server.close()
+    if exit_code:
+        print(f"tpurun: job terminated with exit code {exit_code}",
+              file=sys.stderr)
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""Multi-process integration: tpurun + coordination service + btl/sm+tcp +
+coll/basic — the ``mpirun -n N`` smoke tests of SURVEY §4."""
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _tpurun(n, args, timeout=120, extra_env=None):
+    env = dict(os.environ)
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_mp_ring():
+    r = _tpurun(4, [sys.executable, str(REPO / "examples" / "ring.py")])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "token now 0" in r.stdout
+
+
+def test_mp_connectivity_sm_and_tcp_only():
+    r = _tpurun(4, [sys.executable, str(REPO / "examples" / "connectivity.py")])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "connectivity OK: 4 ranks" in r.stdout
+    # force the tcp path (exclude shared memory)
+    r2 = _tpurun(3, ["--mca", "btl", "^sm",
+                     sys.executable, str(REPO / "examples" / "connectivity.py")])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "connectivity OK: 3 ranks" in r2.stdout
+
+
+def test_mp_collectives_and_split(tmp_path):
+    script = tmp_path / "coll.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        w = ompi_tpu.init()
+        r = w.rank
+        assert w.allreduce(np.array([float(r + 1)]))[0] == 10.0
+        g = w.allgather(np.array([r * 10]))
+        assert g.ravel().tolist() == [0, 10, 20, 30]
+        assert w.scan(np.array([1]))[0] == r + 1
+        assert w.exscan(np.array([1]))[0] == r
+        a2a = w.alltoall(np.arange(4, dtype=np.int64) + 100 * r)
+        assert a2a.ravel().tolist() == [r, 100 + r, 200 + r, 300 + r], a2a
+        b = w.bcast(np.array([7.5]) if r == 2 else np.zeros(1), root=2)
+        assert b[0] == 7.5
+        sub = w.split(color=r % 2, key=-r)
+        assert sub.size == 2
+        # key=-r reverses rank order inside each color
+        assert sub.rank == (1 if r < 2 else 0)
+        rs = w.reduce_scatter(np.ones(8, np.float32))
+        assert rs.tolist() == [4.0, 4.0]
+        w.barrier()
+        if r == 0:
+            print("MP COLLECTIVES OK")
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(4, [sys.executable, str(script)], timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MP COLLECTIVES OK" in r.stdout
+
+
+def test_mp_rendezvous_large_message(tmp_path):
+    script = tmp_path / "big.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        w = ompi_tpu.init()
+        n = 1 << 18  # 2MB float64 >> sm/tcp eager limits -> RNDV path
+        if w.rank == 0:
+            data = np.arange(n, dtype=np.float64)
+            w.send(data, dest=1, tag=5)
+        elif w.rank == 1:
+            buf = np.zeros(n, np.float64)
+            st = w.recv(buf, source=0, tag=5)
+            assert st._nbytes == n * 8
+            assert buf[0] == 0 and buf[-1] == n - 1
+            assert np.all(buf == np.arange(n))
+            print("RNDV OK")
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(2, [sys.executable, str(script)], timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RNDV OK" in r.stdout
+
+
+def test_tpurun_failure_teardown(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text(textwrap.dedent("""
+        import sys, time, os
+        if int(os.environ["OTPU_RANK"]) == 1:
+            sys.exit(3)
+        time.sleep(30)
+    """))
+    r = _tpurun(3, [sys.executable, str(script)], timeout=60)
+    assert r.returncode == 3
+    assert "terminated with exit code 3" in r.stderr
