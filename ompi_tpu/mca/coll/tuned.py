@@ -1,0 +1,273 @@
+"""coll/tuned — the decision layer picking algorithms from the menu.
+
+Re-design of ``/root/reference/ompi/mca/coll/tuned/``: *fixed rules* =
+hardcoded (commutativity, comm_size, message_size) ladders per collective
+(``coll_tuned_decision_fixed.c:55-124`` — thresholds there are Ethernet/IB-
+derived; the ladders here are re-derived for the host/DCN path of a TPU
+deployment and keep the same structure and the same non-commutative
+exclusions ``:77-80``), *dynamic rules* = a runtime-loaded rule file
+(``coll_tuned_component.c:232-236``), and per-collective force-MCA-vars
+(``otpu_coll_tuned_<coll>_algorithm``) overriding both.
+
+Priority 30 — above coll/basic (10) so the tuned ladders own the host
+collectives on multi-process communicators, below coll/xla (90) which owns
+the device-array path.
+
+Dynamic rule file format (one rule per line, first match wins)::
+
+    # coll  max_comm_size  max_bytes  algorithm  [segsize]
+    allreduce  8  4096  recursive_doubling
+    allreduce  0  0     ring            # 0 = unbounded
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+from ompi_tpu.mca.coll import algorithms as algs
+from ompi_tpu.mca.coll.basic import BasicCollModule
+
+_MENUS = {
+    "allreduce": algs.ALLREDUCE,
+    "bcast": algs.BCAST,
+    "reduce": algs.REDUCE,
+    "allgather": algs.ALLGATHER,
+    "alltoall": algs.ALLTOALL,
+    "barrier": algs.BARRIER,
+    "reduce_scatter": algs.REDUCE_SCATTER,
+    "gather": algs.GATHER,
+    "scatter": algs.SCATTER,
+}
+
+
+def _nbytes(buf) -> int:
+    return np.asarray(buf).nbytes
+
+
+class TunedModule:
+    """Per-communicator module: ladder dispatch over the algorithm menu."""
+
+    def __init__(self, component: "TunedCollComponent"):
+        self._c = component
+        self._basic = BasicCollModule()
+
+    # -- decision machinery ---------------------------------------------
+    def _pick(self, coll: str, comm_size: int, nbytes: int,
+              default: str) -> str:
+        forced = self._c.force_var(coll)
+        if forced:
+            return forced
+        for (rcoll, max_size, max_bytes, alg, _seg) in self._c.rules:
+            if rcoll != coll:
+                continue
+            if max_size and comm_size > max_size:
+                continue
+            if max_bytes and nbytes > max_bytes:
+                continue
+            return alg
+        return default
+
+    def _run(self, coll: str, alg: str, *args, **kw):
+        menu = _MENUS[coll]
+        fn = menu.get(alg)
+        if fn is None:
+            from ompi_tpu.base.output import show_help
+
+            show_help("help-coll-tuned", "unknown-algorithm",
+                      coll=coll, alg=alg, known=", ".join(sorted(menu)))
+            fn = next(iter(menu.values()))
+        return fn(*args, **kw)
+
+    # -- fixed ladders (decision_fixed.c shape, TPU-host re-derivation) --
+    def allreduce(self, comm, sendbuf, op=op_mod.SUM):
+        nbytes = _nbytes(sendbuf)
+        if not op.commute:
+            # ring/Rabenseifner reorder operands -> excluded (:77-80)
+            alg = "nonoverlapping" if comm.size <= 4 else "recursive_doubling"
+        elif nbytes < 4096:
+            alg = "recursive_doubling"
+        elif nbytes < (512 << 10):
+            alg = "rabenseifner"
+        elif nbytes < (4 << 20):
+            alg = "ring"
+        else:
+            alg = "ring_segmented"
+        alg = self._pick("allreduce", comm.size, nbytes, alg)
+        if alg == "ring_segmented":
+            return algs.allreduce_ring_segmented(
+                comm, sendbuf, op, segsize=self._c.segsize("allreduce"))
+        return self._run("allreduce", alg, comm, sendbuf, op)
+
+    def bcast(self, comm, buf, root=0):
+        nbytes = _nbytes(buf)
+        if nbytes < 2048 or comm.size <= 4:
+            alg = "binomial"
+        elif nbytes < (1 << 20):
+            alg = "scatter_allgather"
+        else:
+            alg = "chain"
+        alg = self._pick("bcast", comm.size, nbytes, alg)
+        if alg == "chain":
+            return algs.bcast_chain(comm, buf, root,
+                                    segsize=self._c.segsize("bcast"))
+        return self._run("bcast", alg, comm, buf, root)
+
+    def reduce(self, comm, sendbuf, op=op_mod.SUM, root=0):
+        nbytes = _nbytes(sendbuf)
+        if not op.commute:
+            # binomial reorders; pipeline and linear are rank-ordered
+            alg = "linear" if nbytes < (64 << 10) else "pipeline"
+        elif nbytes < (64 << 10):
+            alg = "binomial"
+        else:
+            alg = "pipeline"
+        alg = self._pick("reduce", comm.size, nbytes, alg)
+        if alg == "pipeline":
+            return algs.reduce_pipeline(comm, sendbuf, op, root,
+                                        segsize=self._c.segsize("reduce"))
+        return self._run("reduce", alg, comm, sendbuf, op, root)
+
+    def allgather(self, comm, sendbuf):
+        nbytes = _nbytes(sendbuf)
+        if comm.size <= 2:
+            alg = "linear"
+        elif nbytes < 1024:
+            alg = "bruck"
+        elif nbytes < (512 << 10):
+            alg = "recursive_doubling"   # falls back to bruck for non-pof2
+        else:
+            alg = "neighbor"             # falls back to ring for odd sizes
+        alg = self._pick("allgather", comm.size, nbytes, alg)
+        return self._run("allgather", alg, comm, sendbuf)
+
+    def alltoall(self, comm, sendbuf):
+        stack = np.asarray(sendbuf)
+        per_block = stack.nbytes // max(1, stack.shape[0] if stack.ndim else 1)
+        if comm.size <= 2:
+            alg = "linear"
+        elif per_block < 256:
+            alg = "bruck"
+        else:
+            alg = "pairwise"
+        alg = self._pick("alltoall", comm.size, int(per_block), alg)
+        return self._run("alltoall", alg, comm, sendbuf)
+
+    def barrier(self, comm):
+        alg = "recursive_doubling" if not (comm.size & (comm.size - 1)) \
+            else "bruck"
+        alg = self._pick("barrier", comm.size, 0, alg)
+        return self._run("barrier", alg, comm)
+
+    def reduce_scatter(self, comm, sendbuf, recvcounts=None, op=op_mod.SUM):
+        nbytes = _nbytes(sendbuf)
+        if not op.commute:
+            alg = "basic"                # reduce+scatter keeps rank order
+        elif nbytes < (64 << 10):
+            alg = "recursive_halving"
+        else:
+            alg = "ring"
+        alg = self._pick("reduce_scatter", comm.size, nbytes, alg)
+        return self._run("reduce_scatter", alg, comm, sendbuf, recvcounts, op)
+
+    def gather(self, comm, sendbuf, root=0):
+        nbytes = _nbytes(sendbuf)
+        alg = "binomial" if nbytes < (64 << 10) else "linear"
+        alg = self._pick("gather", comm.size, nbytes, alg)
+        return self._run("gather", alg, comm, sendbuf, root)
+
+    def scatter(self, comm, sendbuf, root=0):
+        nbytes = _nbytes(sendbuf)
+        alg = "binomial" if nbytes < (64 << 10) else "linear"
+        alg = self._pick("scatter", comm.size, nbytes, alg)
+        return self._run("scatter", alg, comm, sendbuf, root)
+
+
+class TunedCollComponent(Component):
+    name = "tuned"
+    priority = 30
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=30,
+            help="Selection priority of coll/tuned")
+        self._rules_file = self.register_var(
+            "dynamic_rules_filename", vtype=VarType.STRING, default="",
+            help="Path to a dynamic decision-rule file "
+                 "(coll_tuned_component.c:232 equivalent)")
+        self._force: dict[str, object] = {}
+        self._seg: dict[str, object] = {}
+        for coll, menu in _MENUS.items():
+            self._force[coll] = self.register_var(
+                f"{coll}_algorithm", vtype=VarType.STRING, default="",
+                help=f"Force a {coll} algorithm: one of "
+                     f"{', '.join(sorted(menu))} (empty = decision ladder)")
+        for coll, default in (("allreduce", 1 << 20), ("bcast", 1 << 17),
+                              ("reduce", 1 << 17)):
+            self._seg[coll] = self.register_var(
+                f"{coll}_segsize", vtype=VarType.INT, default=default,
+                help=f"Segment size in bytes for segmented {coll} algorithms")
+        self.rules: list[tuple] = []
+
+    def open(self) -> bool:
+        self.rules = []
+        path = (self._rules_file.value or "").strip()
+        if path:
+            try:
+                self.rules = _load_rules(path)
+            except OSError as exc:
+                from ompi_tpu.base.output import show_help
+
+                show_help("help-coll-tuned", "bad-rules-file",
+                          path=path, error=str(exc))
+        return True
+
+    def force_var(self, coll: str) -> str:
+        v = self._force.get(coll)
+        return (v.value or "").strip() if v is not None else ""
+
+    def segsize(self, coll: str) -> int:
+        v = self._seg.get(coll)
+        return int(v.value) if v is not None else 1 << 20
+
+    def comm_query(self, comm):
+        if comm.rte is not None and comm.rte.is_device_world:
+            return None   # conductor/xla own the device world
+        if comm.size == 1:
+            return None
+        return self._prio.value, TunedModule(self)
+
+
+def _load_rules(path: str) -> list[tuple]:
+    rules = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) not in (4, 5):
+                raise OSError(f"line {lineno}: expected "
+                              "'coll max_size max_bytes alg [segsize]'")
+            coll, max_size, max_bytes, alg = parts[:4]
+            seg = int(parts[4]) if len(parts) == 5 else 0
+            if coll not in _MENUS:
+                raise OSError(f"line {lineno}: unknown collective {coll!r}")
+            if alg not in _MENUS[coll]:
+                raise OSError(f"line {lineno}: unknown {coll} algorithm "
+                              f"{alg!r}")
+            rules.append((coll, int(max_size), int(max_bytes), alg, seg))
+    return rules
+
+
+COMPONENT = TunedCollComponent()
+
+from ompi_tpu.base.output import register_help as _rh
+
+_rh("help-coll-tuned", "unknown-algorithm",
+    "coll/tuned was asked for {coll} algorithm {alg!r} but only knows: "
+    "{known}; using the first available instead.")
+_rh("help-coll-tuned", "bad-rules-file",
+    "coll/tuned could not load the dynamic rules file {path!r}: {error}. "
+    "Falling back to the fixed decision ladder.")

@@ -43,11 +43,18 @@ def main(argv=None) -> int:
     host, port = server.addr
 
     env_base = dict(os.environ)
+    # Ranks must be able to import ompi_tpu no matter how tpurun itself was
+    # found (installed, -m from the repo, …).  Appended, not prepended: the
+    # user's own PYTHONPATH entries keep shadowing rights.
+    import ompi_tpu as _pkg
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__)))
+    env_base["PYTHONPATH"] = (
+        env_base["PYTHONPATH"] + os.pathsep + pkg_root
+        if env_base.get("PYTHONPATH") else pkg_root)
     env_base["OTPU_NPROCS"] = str(args.nprocs)
     env_base["OTPU_COORD"] = f"{host}:{port}"
     for name, value in args.mca:
-        key = name if name.startswith("otpu_") else name
-        env_base["OTPU_MCA_" + key.removeprefix("otpu_")] = value
+        env_base["OTPU_MCA_" + name.removeprefix("otpu_")] = value
 
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []

@@ -1,0 +1,382 @@
+"""Algorithm-library tests: every coll/base menu entry against numpy
+references, run SPMD with one thread per rank over the in-process world
+(the ``mpirun --oversubscribe`` harness of SURVEY §4), plus the tuned
+decision ladder, force vars, and dynamic rule files."""
+import threading
+import traceback
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.mca.coll import algorithms as algs
+
+
+@pytest.fixture(scope="module")
+def world():
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    if w.size != 8:
+        pytest.skip("needs 8 virtual devices")
+    yield w
+    rt.reset_for_testing()
+
+
+@pytest.fixture(scope="module")
+def world5(world):
+    sub = world.create(world.group.incl([0, 1, 2, 3, 4]))
+    assert sub is not None
+    return sub
+
+
+@pytest.fixture(scope="module")
+def world6(world):
+    sub = world.create(world.group.incl([0, 1, 2, 3, 4, 5]))
+    assert sub is not None
+    return sub
+
+
+def spmd(comm, fn, timeout=60):
+    """Run fn(rank_facade, rank) SPMD-style, one thread per rank."""
+    size = comm.size
+    results = [None] * size
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = fn(comm.as_rank(i), i)
+        except Exception:
+            errors.append((i, traceback.format_exc()))
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    alive = [i for i, t in enumerate(threads) if t.is_alive()]
+    assert not alive, f"SPMD deadlock: ranks {alive} still running"
+    assert not errors, "\n".join(f"[rank {i}]\n{tb}" for i, tb in errors)
+    return results
+
+
+def _rank_data(size, n, dtype=np.float64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((size, n)).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+
+
+@pytest.mark.parametrize("alg", sorted(algs.ALLREDUCE))
+@pytest.mark.parametrize("nelem", [1, 7, 1000])
+def test_allreduce_sum(world, alg, nelem):
+    data = _rank_data(8, nelem)
+    out = spmd(world, lambda c, r: algs.ALLREDUCE[alg](c, data[r]))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], data.sum(0), rtol=1e-10)
+
+
+@pytest.mark.parametrize("alg", sorted(algs.ALLREDUCE))
+def test_allreduce_odd_size(world5, alg):
+    data = _rank_data(5, 64, seed=1)
+    out = spmd(world5, lambda c, r: algs.ALLREDUCE[alg](c, data[r]))
+    for r in range(5):
+        np.testing.assert_allclose(out[r], data.sum(0), rtol=1e-10)
+
+
+@pytest.mark.parametrize("alg", ["ring", "rabenseifner"])
+def test_allreduce_max(world, alg):
+    data = _rank_data(8, 33, seed=2)
+    out = spmd(world, lambda c, r: algs.ALLREDUCE[alg](c, data[r], op_mod.MAX))
+    np.testing.assert_allclose(out[0], data.max(0))
+
+
+def _noncommutative_op():
+    """2x2 matrix product over flat (4k,) buffers: associative (as MPI
+    requires of user ops) but order-sensitive in every operand."""
+    def fn(invec, inoutvec, datatype=None):
+        a = invec.reshape(-1, 2, 2)
+        b = inoutvec.reshape(-1, 2, 2)
+        inoutvec[...] = np.matmul(a, b).reshape(inoutvec.shape)
+    return op_mod.create(fn, commute=False)
+
+
+def _matrix_data(nranks, nelem, seed=0):
+    """Near-identity 2x2 matrices so long products stay well-conditioned."""
+    rng = np.random.default_rng(seed)
+    eye = np.tile(np.eye(2).reshape(-1), (nranks, nelem // 4))
+    return eye + 0.1 * rng.standard_normal((nranks, nelem))
+
+
+def _fold_in_rank_order(data, fn):
+    acc = data[-1].copy()
+    for i in range(data.shape[0] - 2, -1, -1):
+        out = acc.copy()
+        fn(data[i], out)
+        acc = out
+    return acc
+
+
+@pytest.mark.parametrize("alg", ["nonoverlapping", "recursive_doubling",
+                                 "linear"])
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_allreduce_noncommutative_order(world, world5, alg, nranks):
+    """Order-safe algorithms must fold operands in rank order."""
+    comm = world if nranks == 8 else world5
+    op = _noncommutative_op()
+    data = _matrix_data(nranks, 8, seed=20)
+    expect = _fold_in_rank_order(data, op)
+    out = spmd(comm, lambda c, r: algs.ALLREDUCE[alg](c, data[r], op))
+    for r in range(nranks):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# bcast
+
+
+@pytest.mark.parametrize("alg", sorted(algs.BCAST))
+@pytest.mark.parametrize("root", [0, 3])
+@pytest.mark.parametrize("nelem", [5, 4096])
+def test_bcast(world, alg, root, nelem):
+    data = np.arange(nelem, dtype=np.float32) * 1.5
+    out = spmd(world, lambda c, r: algs.BCAST[alg](
+        c, data if r == root else np.zeros_like(data), root))
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], data)
+
+
+@pytest.mark.parametrize("alg", sorted(algs.BCAST))
+def test_bcast_odd_size(world5, alg):
+    data = np.arange(100, dtype=np.int64)
+    out = spmd(world5, lambda c, r: algs.BCAST[alg](
+        c, data if r == 2 else np.zeros_like(data), 2))
+    for r in range(5):
+        np.testing.assert_array_equal(out[r], data)
+
+
+# ---------------------------------------------------------------------------
+# reduce
+
+
+@pytest.mark.parametrize("alg", sorted(algs.REDUCE))
+@pytest.mark.parametrize("root", [0, 5])
+def test_reduce(world, alg, root):
+    data = _rank_data(8, 50, seed=3)
+    out = spmd(world, lambda c, r: algs.REDUCE[alg](c, data[r], op_mod.SUM,
+                                                    root))
+    np.testing.assert_allclose(out[root], data.sum(0), rtol=1e-10)
+    for r in range(8):
+        if r != root:
+            assert out[r] is None
+
+
+@pytest.mark.parametrize("alg", ["pipeline", "linear"])
+def test_reduce_noncommutative_order(world, alg):
+    op = _noncommutative_op()
+    data = _matrix_data(8, 4, seed=21)
+    expect = _fold_in_rank_order(data, op)
+    out = spmd(world, lambda c, r: algs.REDUCE[alg](c, data[r], op, 0))
+    np.testing.assert_allclose(out[0], expect, rtol=1e-10)
+
+
+def test_reduce_pipeline_multiseg(world5):
+    """Segmented chain with several segments and a non-zero root."""
+    data = _rank_data(5, 3000, seed=4)
+    out = spmd(world5, lambda c, r: algs.reduce_pipeline(
+        c, data[r], op_mod.SUM, root=3, segsize=4096))
+    np.testing.assert_allclose(out[3], data.sum(0), rtol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+
+
+@pytest.mark.parametrize("alg", sorted(algs.ALLGATHER))
+@pytest.mark.parametrize("nranks", [8, 5, 6])
+def test_allgather(world, world5, world6, alg, nranks):
+    comm = {8: world, 5: world5, 6: world6}[nranks]
+    data = _rank_data(nranks, 9, seed=5)
+    out = spmd(comm, lambda c, r: algs.ALLGATHER[alg](c, data[r]))
+    for r in range(nranks):
+        got = np.asarray(out[r]).reshape(nranks, 9)
+        np.testing.assert_allclose(got, data)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+
+
+@pytest.mark.parametrize("alg", sorted(algs.ALLTOALL))
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_alltoall(world, world5, alg, nranks):
+    comm = world if nranks == 8 else world5
+    data = np.arange(nranks * nranks * 3).reshape(nranks, nranks, 3) \
+        .astype(np.int64)
+    out = spmd(comm, lambda c, r: algs.ALLTOALL[alg](c, data[r]))
+    expect = np.swapaxes(data, 0, 1)   # out[r][s] = data[s][r]
+    for r in range(nranks):
+        np.testing.assert_array_equal(np.asarray(out[r]), expect[r])
+
+
+# ---------------------------------------------------------------------------
+# barrier
+
+
+@pytest.mark.parametrize("alg", sorted(algs.BARRIER))
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_barrier(world, world5, alg, nranks):
+    comm = world if nranks == 8 else world5
+    hits = []
+    lock = threading.Lock()
+
+    def body(c, r):
+        algs.BARRIER[alg](c)
+        with lock:
+            hits.append(r)
+        algs.BARRIER[alg](c)
+        with lock:
+            n = len(hits)
+        # after the second barrier every rank must have logged the first
+        assert n >= nranks
+        algs.BARRIER[alg](c)
+
+    spmd(comm, body)
+    assert sorted(hits) == list(range(nranks))
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter
+
+
+@pytest.mark.parametrize("alg", sorted(algs.REDUCE_SCATTER))
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_reduce_scatter(world, world5, alg, nranks):
+    comm = world if nranks == 8 else world5
+    data = _rank_data(nranks, nranks * 4, seed=6)
+    out = spmd(comm, lambda c, r: algs.REDUCE_SCATTER[alg](c, data[r]))
+    total = data.sum(0)
+    for r in range(nranks):
+        np.testing.assert_allclose(out[r], total[r * 4:(r + 1) * 4],
+                                   rtol=1e-10)
+
+
+def test_reduce_scatter_uneven_counts(world):
+    counts = [1, 2, 3, 4, 5, 6, 7, 8]
+    n = sum(counts)
+    data = _rank_data(8, n, seed=7)
+    out = spmd(world, lambda c, r: algs.reduce_scatter_ring(
+        c, data[r], recvcounts=counts))
+    total = data.sum(0)
+    off = 0
+    for r in range(8):
+        np.testing.assert_allclose(out[r], total[off:off + counts[r]],
+                                   rtol=1e-10)
+        off += counts[r]
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter
+
+
+@pytest.mark.parametrize("alg", sorted(algs.GATHER))
+@pytest.mark.parametrize("nranks,root", [(8, 0), (8, 3), (5, 4)])
+def test_gather(world, world5, alg, nranks, root):
+    comm = world if nranks == 8 else world5
+    data = _rank_data(nranks, 6, seed=8)
+    out = spmd(comm, lambda c, r: algs.GATHER[alg](c, data[r], root))
+    got = np.asarray(out[root]).reshape(nranks, 6)
+    np.testing.assert_allclose(got, data)
+    for r in range(nranks):
+        if r != root:
+            assert out[r] is None
+
+
+@pytest.mark.parametrize("alg", sorted(algs.SCATTER))
+@pytest.mark.parametrize("nranks,root", [(8, 0), (8, 6), (5, 2)])
+def test_scatter(world, world5, alg, nranks, root):
+    comm = world if nranks == 8 else world5
+    data = _rank_data(nranks, 4, seed=9)
+    out = spmd(comm, lambda c, r: algs.SCATTER[alg](
+        c, data if r == root else np.zeros(4, data.dtype), root))
+    for r in range(nranks):
+        np.testing.assert_allclose(np.asarray(out[r]), data[r])
+
+
+# ---------------------------------------------------------------------------
+# tuned decision layer
+
+
+@pytest.fixture()
+def tuned_module(world):
+    from ompi_tpu.base import mca
+    from ompi_tpu.mca.coll.tuned import TunedModule
+
+    fw = mca.framework("coll")
+    fw.open()
+    comp = fw.components["tuned"]
+    return TunedModule(comp), comp
+
+
+def test_tuned_ladder_dispatch(world, tuned_module):
+    mod, _ = tuned_module
+    data = _rank_data(8, 100, seed=10)
+    out = spmd(world, lambda c, r: mod.allreduce(c, data[r]))
+    np.testing.assert_allclose(out[0], data.sum(0), rtol=1e-10)
+    big = _rank_data(8, 200_000, seed=11)   # 1.6MB -> ring branch
+    out = spmd(world, lambda c, r: mod.allreduce(c, big[r]))
+    np.testing.assert_allclose(out[3], big.sum(0), rtol=1e-9)
+
+
+def test_tuned_noncommutative_excluded(world, tuned_module):
+    """Non-commutative ops must route to order-safe algorithms end to end."""
+    mod, _ = tuned_module
+    op = _noncommutative_op()
+    data = _matrix_data(8, 2048, seed=22)
+    expect = _fold_in_rank_order(data, op)
+    out = spmd(world, lambda c, r: mod.allreduce(c, data[r], op))
+    np.testing.assert_allclose(out[0], expect, rtol=1e-9)
+    out = spmd(world, lambda c, r: mod.reduce_scatter(c, data[r], None, op))
+    np.testing.assert_allclose(np.concatenate(out), expect, rtol=1e-9)
+
+
+def test_tuned_force_var(tuned_module, fresh_registry):
+    mod, comp = tuned_module
+    fresh_registry.set("otpu_coll_tuned_allreduce_algorithm", "ring")
+    assert mod._pick("allreduce", 8, 100, "recursive_doubling") == "ring"
+
+
+def test_tuned_dynamic_rules(tuned_module, tmp_path, fresh_registry):
+    mod, comp = tuned_module
+    rules = tmp_path / "rules.conf"
+    rules.write_text(
+        "# comments are fine\n"
+        "allreduce 8 4096 recursive_doubling\n"
+        "allreduce 0 0 ring\n"
+        "bcast 0 0 chain 65536\n")
+    fresh_registry.set("otpu_coll_tuned_dynamic_rules_filename", str(rules))
+    comp.open()
+    try:
+        assert mod._pick("allreduce", 4, 100, "x") == "recursive_doubling"
+        assert mod._pick("allreduce", 64, 100, "x") == "ring"   # size>8
+        assert mod._pick("allreduce", 4, 1 << 20, "x") == "ring"  # bytes>4096
+        assert mod._pick("bcast", 99, 1 << 22, "x") == "chain"
+        assert mod._pick("barrier", 8, 0, "tree") == "tree"     # no rule
+    finally:
+        comp.rules = []
+
+
+def test_tuned_bad_rules_file_falls_back(tuned_module, tmp_path,
+                                         fresh_registry):
+    mod, comp = tuned_module
+    bad = tmp_path / "bad.conf"
+    bad.write_text("allreduce 8 4096 no_such_algorithm\n")
+    fresh_registry.set("otpu_coll_tuned_dynamic_rules_filename", str(bad))
+    comp.open()
+    assert comp.rules == []
+    assert mod._pick("allreduce", 8, 100, "ring") == "ring"
