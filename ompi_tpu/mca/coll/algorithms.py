@@ -55,6 +55,40 @@ def _blocks(total: int, nblocks: int) -> list[tuple[int, int]]:
     return out
 
 
+def _pof2_real_rank(newrank: int, rem: int) -> int:
+    """Real rank behind pof2-participant virtual rank ``newrank`` after the
+    fold phase (odd ranks < 2*rem become newrank rank//2; the rest shift
+    down by rem) — the MPICH/reference non-power-of-2 mapping."""
+    return newrank * 2 + 1 if newrank < rem else newrank + rem
+
+
+def _fold_to_pof2(comm, acc: np.ndarray, op, tag: int, rem: int) -> int:
+    """Pre-phase of the pof2 algorithms: even ranks < 2*rem send their data
+    to the odd neighbor (which folds it, keeping rank order) and sit out.
+    Returns this rank's virtual rank, or -1 if it sits out."""
+    rank = comm.rank
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm.send(acc, dest=rank + 1, tag=tag)
+            return -1
+        other = np.empty_like(acc)
+        comm.recv(other, source=rank - 1, tag=tag)
+        op(other, acc)   # acc = lower-rank (op) acc: rank order kept
+        return rank // 2
+    return rank - rem
+
+
+def _unfold_from_pof2(comm, acc: np.ndarray, tag: int, rem: int) -> None:
+    """Post-phase: odd ranks < 2*rem return the result to the even
+    neighbor that sat out."""
+    rank = comm.rank
+    if rank < 2 * rem:
+        if rank % 2 != 0:
+            comm.send(acc, dest=rank - 1, tag=tag)
+        else:
+            comm.recv(acc, source=rank + 1, tag=tag)
+
+
 def _binomial_tree(rank: int, size: int, root: int):
     """(parent, children) of ``rank`` in the binomial tree rooted at root.
 
@@ -104,25 +138,12 @@ def allreduce_recursive_doubling(comm, sendbuf, op=op_mod.SUM):
         return acc
     pof2 = _pof2_floor(size)
     rem = size - pof2
-
-    # fold extra ranks: even ranks < 2*rem send to the odd neighbor, sit out
-    if rank < 2 * rem:
-        if rank % 2 == 0:
-            comm.send(acc, dest=rank + 1, tag=tag)
-            newrank = -1
-        else:
-            other = np.empty_like(acc)
-            comm.recv(other, source=rank - 1, tag=tag)
-            op(other, acc)  # acc = lower-rank (op) acc: rank order kept
-            newrank = rank // 2
-    else:
-        newrank = rank - rem
+    newrank = _fold_to_pof2(comm, acc, op, tag, rem)
 
     if newrank >= 0:
         mask = 1
         while mask < pof2:
-            newpeer = newrank ^ mask
-            peer = newpeer * 2 + 1 if newpeer < rem else newpeer + rem
+            peer = _pof2_real_rank(newrank ^ mask, rem)
             other = np.empty_like(acc)
             comm.sendrecv(acc, dest=peer, recvbuf=other, source=peer,
                           sendtag=tag, recvtag=tag)
@@ -133,12 +154,7 @@ def allreduce_recursive_doubling(comm, sendbuf, op=op_mod.SUM):
                 acc = other
             mask <<= 1
 
-    # unfold: odd ranks < 2*rem return the result to their even neighbor
-    if rank < 2 * rem:
-        if rank % 2 != 0:
-            comm.send(acc, dest=rank - 1, tag=tag)
-        else:
-            comm.recv(acc, source=rank + 1, tag=tag)
+    _unfold_from_pof2(comm, acc, tag, rem)
     return acc
 
 
@@ -210,18 +226,7 @@ def allreduce_redscat_allgather(comm, sendbuf, op=op_mod.SUM):
     tag = coll_tag(comm)
     acc = np.array(flat, copy=True)
     rem = size - pof2
-
-    if rank < 2 * rem:
-        if rank % 2 == 0:
-            comm.send(acc, dest=rank + 1, tag=tag)
-            newrank = -1
-        else:
-            other = np.empty_like(acc)
-            comm.recv(other, source=rank - 1, tag=tag)
-            op(other, acc)
-            newrank = rank // 2
-    else:
-        newrank = rank - rem
+    newrank = _fold_to_pof2(comm, acc, op, tag, rem)
 
     if newrank >= 0:
         blocks = _blocks(acc.size, pof2)
@@ -235,8 +240,7 @@ def allreduce_redscat_allgather(comm, sendbuf, op=op_mod.SUM):
         mask = pof2 // 2
         while mask > 0:
             mid = (lo + hi) // 2
-            newpeer = newrank ^ mask
-            peer = newpeer * 2 + 1 if newpeer < rem else newpeer + rem
+            peer = _pof2_real_rank(newrank ^ mask, rem)
             if newrank < mid:   # keep low half, trade away high half
                 keep_lo, keep_hi = span(lo, mid)
                 send_lo, send_hi = span(mid, hi)
@@ -255,8 +259,7 @@ def allreduce_redscat_allgather(comm, sendbuf, op=op_mod.SUM):
         # recursive doubling allgather: widen [lo, hi) back to [0, pof2)
         mask = 1
         while mask < pof2:
-            newpeer = newrank ^ mask
-            peer = newpeer * 2 + 1 if newpeer < rem else newpeer + rem
+            peer = _pof2_real_rank(newrank ^ mask, rem)
             width = hi - lo
             if newrank & mask:
                 p_lo, p_hi = lo - width, lo
@@ -271,11 +274,7 @@ def allreduce_redscat_allgather(comm, sendbuf, op=op_mod.SUM):
             lo, hi = min(lo, p_lo), max(hi, p_hi)
             mask <<= 1
 
-    if rank < 2 * rem:
-        if rank % 2 != 0:
-            comm.send(acc, dest=rank - 1, tag=tag)
-        else:
-            comm.recv(acc, source=rank + 1, tag=tag)
+    _unfold_from_pof2(comm, acc, tag, rem)
     return acc.reshape(shape)
 
 
@@ -612,8 +611,7 @@ def barrier_recursive_doubling(comm):
     if newrank >= 0:
         mask = 1
         while mask < pof2:
-            newpeer = newrank ^ mask
-            peer = newpeer * 2 + 1 if newpeer < rem else newpeer + rem
+            peer = _pof2_real_rank(newrank ^ mask, rem)
             comm.sendrecv(token, dest=peer, recvbuf=scratch, source=peer,
                           sendtag=tag, recvtag=tag)
             mask <<= 1

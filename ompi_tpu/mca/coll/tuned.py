@@ -55,21 +55,24 @@ class TunedModule:
 
     # -- decision machinery ---------------------------------------------
     def _pick(self, coll: str, comm_size: int, nbytes: int,
-              default: str) -> str:
+              default: str) -> tuple[str, int]:
+        """(algorithm, rule segsize) — segsize 0 means 'use the MCA var'.
+        ``nbytes`` is the TOTAL payload per rank for every collective
+        (alltoall included), matching the rule file's max_bytes column."""
         forced = self._c.force_var(coll)
         if forced:
-            return forced
-        for (rcoll, max_size, max_bytes, alg, _seg) in self._c.rules:
+            return forced, 0
+        for (rcoll, max_size, max_bytes, alg, seg) in self._c.rules:
             if rcoll != coll:
                 continue
             if max_size and comm_size > max_size:
                 continue
             if max_bytes and nbytes > max_bytes:
                 continue
-            return alg
-        return default
+            return alg, seg
+        return default, 0
 
-    def _run(self, coll: str, alg: str, *args, **kw):
+    def _run(self, coll: str, alg: str, default: str, *args, **kw):
         menu = _MENUS[coll]
         fn = menu.get(alg)
         if fn is None:
@@ -77,7 +80,9 @@ class TunedModule:
 
             show_help("help-coll-tuned", "unknown-algorithm",
                       coll=coll, alg=alg, known=", ".join(sorted(menu)))
-            fn = next(iter(menu.values()))
+            # fall back to the ladder's own default: unlike an arbitrary
+            # menu entry it is always safe for the op at hand
+            fn = menu[default]
         return fn(*args, **kw)
 
     # -- fixed ladders (decision_fixed.c shape, TPU-host re-derivation) --
@@ -85,103 +90,106 @@ class TunedModule:
         nbytes = _nbytes(sendbuf)
         if not op.commute:
             # ring/Rabenseifner reorder operands -> excluded (:77-80)
-            alg = "nonoverlapping" if comm.size <= 4 else "recursive_doubling"
+            default = "nonoverlapping" if comm.size <= 4 \
+                else "recursive_doubling"
         elif nbytes < 4096:
-            alg = "recursive_doubling"
+            default = "recursive_doubling"
         elif nbytes < (512 << 10):
-            alg = "rabenseifner"
+            default = "rabenseifner"
         elif nbytes < (4 << 20):
-            alg = "ring"
+            default = "ring"
         else:
-            alg = "ring_segmented"
-        alg = self._pick("allreduce", comm.size, nbytes, alg)
+            default = "ring_segmented"
+        alg, seg = self._pick("allreduce", comm.size, nbytes, default)
         if alg == "ring_segmented":
             return algs.allreduce_ring_segmented(
-                comm, sendbuf, op, segsize=self._c.segsize("allreduce"))
-        return self._run("allreduce", alg, comm, sendbuf, op)
+                comm, sendbuf, op, segsize=seg or self._c.segsize("allreduce"))
+        return self._run("allreduce", alg, default, comm, sendbuf, op)
 
     def bcast(self, comm, buf, root=0):
         nbytes = _nbytes(buf)
         if nbytes < 2048 or comm.size <= 4:
-            alg = "binomial"
+            default = "binomial"
         elif nbytes < (1 << 20):
-            alg = "scatter_allgather"
+            default = "scatter_allgather"
         else:
-            alg = "chain"
-        alg = self._pick("bcast", comm.size, nbytes, alg)
+            default = "chain"
+        alg, seg = self._pick("bcast", comm.size, nbytes, default)
         if alg == "chain":
             return algs.bcast_chain(comm, buf, root,
-                                    segsize=self._c.segsize("bcast"))
-        return self._run("bcast", alg, comm, buf, root)
+                                    segsize=seg or self._c.segsize("bcast"))
+        return self._run("bcast", alg, default, comm, buf, root)
 
     def reduce(self, comm, sendbuf, op=op_mod.SUM, root=0):
         nbytes = _nbytes(sendbuf)
         if not op.commute:
             # binomial reorders; pipeline and linear are rank-ordered
-            alg = "linear" if nbytes < (64 << 10) else "pipeline"
+            default = "linear" if nbytes < (64 << 10) else "pipeline"
         elif nbytes < (64 << 10):
-            alg = "binomial"
+            default = "binomial"
         else:
-            alg = "pipeline"
-        alg = self._pick("reduce", comm.size, nbytes, alg)
+            default = "pipeline"
+        alg, seg = self._pick("reduce", comm.size, nbytes, default)
         if alg == "pipeline":
             return algs.reduce_pipeline(comm, sendbuf, op, root,
-                                        segsize=self._c.segsize("reduce"))
-        return self._run("reduce", alg, comm, sendbuf, op, root)
+                                        segsize=seg or self._c.segsize("reduce"))
+        return self._run("reduce", alg, default, comm, sendbuf, op, root)
 
     def allgather(self, comm, sendbuf):
         nbytes = _nbytes(sendbuf)
         if comm.size <= 2:
-            alg = "linear"
+            default = "linear"
         elif nbytes < 1024:
-            alg = "bruck"
+            default = "bruck"
         elif nbytes < (512 << 10):
-            alg = "recursive_doubling"   # falls back to bruck for non-pof2
+            default = "recursive_doubling"  # falls to bruck for non-pof2
         else:
-            alg = "neighbor"             # falls back to ring for odd sizes
-        alg = self._pick("allgather", comm.size, nbytes, alg)
-        return self._run("allgather", alg, comm, sendbuf)
+            default = "neighbor"            # falls to ring for odd sizes
+        alg, _ = self._pick("allgather", comm.size, nbytes, default)
+        return self._run("allgather", alg, default, comm, sendbuf)
 
     def alltoall(self, comm, sendbuf):
         stack = np.asarray(sendbuf)
-        per_block = stack.nbytes // max(1, stack.shape[0] if stack.ndim else 1)
+        nbytes = stack.nbytes   # total, like every other collective
+        per_block = nbytes // max(1, stack.shape[0] if stack.ndim else 1)
         if comm.size <= 2:
-            alg = "linear"
+            default = "linear"
         elif per_block < 256:
-            alg = "bruck"
+            default = "bruck"
         else:
-            alg = "pairwise"
-        alg = self._pick("alltoall", comm.size, int(per_block), alg)
-        return self._run("alltoall", alg, comm, sendbuf)
+            default = "pairwise"
+        alg, _ = self._pick("alltoall", comm.size, nbytes, default)
+        return self._run("alltoall", alg, default, comm, sendbuf)
 
     def barrier(self, comm):
-        alg = "recursive_doubling" if not (comm.size & (comm.size - 1)) \
-            else "bruck"
-        alg = self._pick("barrier", comm.size, 0, alg)
-        return self._run("barrier", alg, comm)
+        default = "recursive_doubling" \
+            if not (comm.size & (comm.size - 1)) else "bruck"
+        alg, _ = self._pick("barrier", comm.size, 0, default)
+        return self._run("barrier", alg, default, comm)
 
     def reduce_scatter(self, comm, sendbuf, recvcounts=None, op=op_mod.SUM):
         nbytes = _nbytes(sendbuf)
         if not op.commute:
-            alg = "basic"                # reduce+scatter keeps rank order
+            default = "basic"            # reduce+scatter keeps rank order
         elif nbytes < (64 << 10):
-            alg = "recursive_halving"
+            default = "recursive_halving"
         else:
-            alg = "ring"
-        alg = self._pick("reduce_scatter", comm.size, nbytes, alg)
-        return self._run("reduce_scatter", alg, comm, sendbuf, recvcounts, op)
+            default = "ring"
+        alg, _ = self._pick("reduce_scatter", comm.size, nbytes, default)
+        return self._run("reduce_scatter", alg, default,
+                         comm, sendbuf, recvcounts, op)
 
     def gather(self, comm, sendbuf, root=0):
         nbytes = _nbytes(sendbuf)
-        alg = "binomial" if nbytes < (64 << 10) else "linear"
-        alg = self._pick("gather", comm.size, nbytes, alg)
-        return self._run("gather", alg, comm, sendbuf, root)
+        default = "binomial" if nbytes < (64 << 10) else "linear"
+        alg, _ = self._pick("gather", comm.size, nbytes, default)
+        return self._run("gather", alg, default, comm, sendbuf, root)
 
     def scatter(self, comm, sendbuf, root=0):
         nbytes = _nbytes(sendbuf)
-        alg = "binomial" if nbytes < (64 << 10) else "linear"
-        alg = self._pick("scatter", comm.size, nbytes, alg)
-        return self._run("scatter", alg, comm, sendbuf, root)
+        default = "binomial" if nbytes < (64 << 10) else "linear"
+        alg, _ = self._pick("scatter", comm.size, nbytes, default)
+        return self._run("scatter", alg, default, comm, sendbuf, root)
 
 
 class TunedCollComponent(Component):

@@ -348,7 +348,7 @@ def test_tuned_noncommutative_excluded(world, tuned_module):
 def test_tuned_force_var(tuned_module, fresh_registry):
     mod, comp = tuned_module
     fresh_registry.set("otpu_coll_tuned_allreduce_algorithm", "ring")
-    assert mod._pick("allreduce", 8, 100, "recursive_doubling") == "ring"
+    assert mod._pick("allreduce", 8, 100, "recursive_doubling") == ("ring", 0)
 
 
 def test_tuned_dynamic_rules(tuned_module, tmp_path, fresh_registry):
@@ -362,11 +362,13 @@ def test_tuned_dynamic_rules(tuned_module, tmp_path, fresh_registry):
     fresh_registry.set("otpu_coll_tuned_dynamic_rules_filename", str(rules))
     comp.open()
     try:
-        assert mod._pick("allreduce", 4, 100, "x") == "recursive_doubling"
-        assert mod._pick("allreduce", 64, 100, "x") == "ring"   # size>8
-        assert mod._pick("allreduce", 4, 1 << 20, "x") == "ring"  # bytes>4096
-        assert mod._pick("bcast", 99, 1 << 22, "x") == "chain"
-        assert mod._pick("barrier", 8, 0, "tree") == "tree"     # no rule
+        assert mod._pick("allreduce", 4, 100, "x") == \
+            ("recursive_doubling", 0)
+        assert mod._pick("allreduce", 64, 100, "x") == ("ring", 0)  # size>8
+        assert mod._pick("allreduce", 4, 1 << 20, "x") == ("ring", 0)
+        # the rule's segsize column must reach the segmented algorithm
+        assert mod._pick("bcast", 99, 1 << 22, "x") == ("chain", 65536)
+        assert mod._pick("barrier", 8, 0, "tree") == ("tree", 0)  # no rule
     finally:
         comp.rules = []
 
@@ -379,4 +381,4 @@ def test_tuned_bad_rules_file_falls_back(tuned_module, tmp_path,
     fresh_registry.set("otpu_coll_tuned_dynamic_rules_filename", str(bad))
     comp.open()
     assert comp.rules == []
-    assert mod._pick("allreduce", 8, 100, "ring") == "ring"
+    assert mod._pick("allreduce", 8, 100, "ring") == ("ring", 0)

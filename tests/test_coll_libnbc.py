@@ -1,0 +1,259 @@
+"""Nonblocking-collective schedule tests (coll/libnbc equivalent): every i*
+collective SPMD over the thread-per-rank harness, overlap (request stays
+incomplete until progressed), multiple collectives in flight, and
+selection wiring on multi-process-shaped communicators."""
+import threading
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.request import waitall
+from ompi_tpu.mca.coll.libnbc import LibnbcModule
+
+from test_coll_algorithms import spmd, _rank_data, _noncommutative_op, \
+    _matrix_data, _fold_in_rank_order
+
+
+@pytest.fixture(scope="module")
+def world():
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    if w.size != 8:
+        pytest.skip("needs 8 virtual devices")
+    yield w
+    rt.reset_for_testing()
+
+
+@pytest.fixture(scope="module")
+def world5(world):
+    sub = world.create(world.group.incl([0, 1, 2, 3, 4]))
+    assert sub is not None
+    return sub
+
+
+nbc = LibnbcModule()
+
+
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_ibarrier(world, world5, nranks):
+    comm = world if nranks == 8 else world5
+    spmd(comm, lambda c, r: nbc.ibarrier(c).wait())
+
+
+@pytest.mark.parametrize("nranks,root", [(8, 0), (8, 5), (5, 2)])
+def test_ibcast(world, world5, nranks, root):
+    comm = world if nranks == 8 else world5
+    data = np.arange(300, dtype=np.float64)
+
+    def body(c, r):
+        req = nbc.ibcast(c, data if r == root else np.zeros_like(data), root)
+        req.wait()
+        return req.result
+
+    out = spmd(comm, body)
+    for r in range(nranks):
+        np.testing.assert_array_equal(out[r], data)
+
+
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_iallreduce(world, world5, nranks):
+    comm = world if nranks == 8 else world5
+    data = _rank_data(nranks, 40, seed=30)
+
+    def body(c, r):
+        req = nbc.iallreduce(c, data[r])
+        req.wait()
+        return req.result
+
+    out = spmd(comm, body)
+    for r in range(nranks):
+        np.testing.assert_allclose(out[r], data.sum(0), rtol=1e-10)
+
+
+def test_iallreduce_noncommutative(world):
+    op = _noncommutative_op()
+    data = _matrix_data(8, 8, seed=31)
+    expect = _fold_in_rank_order(data, op)
+
+    def body(c, r):
+        req = nbc.iallreduce(c, data[r], op)
+        req.wait()
+        return req.result
+
+    out = spmd(world, body)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-10)
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_ireduce(world, root):
+    data = _rank_data(8, 25, seed=32)
+
+    def body(c, r):
+        req = nbc.ireduce(c, data[r], op_mod.SUM, root)
+        req.wait()
+        return req.result
+
+    out = spmd(world, body)
+    np.testing.assert_allclose(out[root], data.sum(0), rtol=1e-10)
+    assert all(out[r] is None for r in range(8) if r != root)
+
+
+def test_ireduce_noncommutative(world5):
+    op = _noncommutative_op()
+    data = _matrix_data(5, 4, seed=33)
+    expect = _fold_in_rank_order(data, op)
+
+    def body(c, r):
+        req = nbc.ireduce(c, data[r], op, 1)
+        req.wait()
+        return req.result
+
+    out = spmd(world5, body)
+    np.testing.assert_allclose(out[1], expect, rtol=1e-10)
+
+
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_iallgather(world, world5, nranks):
+    comm = world if nranks == 8 else world5
+    data = _rank_data(nranks, 7, seed=34)
+
+    def body(c, r):
+        req = nbc.iallgather(c, data[r])
+        req.wait()
+        return req.result
+
+    out = spmd(comm, body)
+    for r in range(nranks):
+        np.testing.assert_allclose(np.asarray(out[r]), data)
+
+
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_ialltoall(world, world5, nranks):
+    comm = world if nranks == 8 else world5
+    data = np.arange(nranks * nranks * 2).reshape(nranks, nranks, 2) \
+        .astype(np.int64)
+
+    def body(c, r):
+        req = nbc.ialltoall(c, data[r])
+        req.wait()
+        return req.result
+
+    out = spmd(comm, body)
+    expect = np.swapaxes(data, 0, 1)
+    for r in range(nranks):
+        np.testing.assert_array_equal(np.asarray(out[r]), expect[r])
+
+
+def test_igather_iscatter(world5):
+    data = _rank_data(5, 3, seed=35)
+
+    def gather_body(c, r):
+        req = nbc.igather(c, data[r], 4)
+        req.wait()
+        return req.result
+
+    out = spmd(world5, gather_body)
+    np.testing.assert_allclose(np.asarray(out[4]), data)
+
+    def scatter_body(c, r):
+        req = nbc.iscatter(
+            c, data if r == 4 else np.zeros(3, data.dtype), 4)
+        req.wait()
+        return req.result
+
+    out = spmd(world5, scatter_body)
+    for r in range(5):
+        np.testing.assert_allclose(out[r], data[r])
+
+
+@pytest.mark.parametrize("nranks", [8, 5])
+def test_ireduce_scatter(world, world5, nranks):
+    comm = world if nranks == 8 else world5
+    data = _rank_data(nranks, nranks * 3, seed=36)
+
+    def body(c, r):
+        req = nbc.ireduce_scatter(c, data[r])
+        req.wait()
+        return req.result
+
+    out = spmd(comm, body)
+    total = data.sum(0)
+    for r in range(nranks):
+        np.testing.assert_allclose(out[r], total[r * 3:(r + 1) * 3],
+                                   rtol=1e-10)
+
+
+def test_iscan_iexscan(world):
+    data = _rank_data(8, 10, seed=37)
+
+    def scan_body(c, r):
+        req = nbc.iscan(c, data[r])
+        req.wait()
+        return req.result
+
+    out = spmd(world, scan_body)
+    expect = np.cumsum(data, 0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expect[r], rtol=1e-10)
+
+    def exscan_body(c, r):
+        req = nbc.iexscan(c, data[r])
+        req.wait()
+        return req.result
+
+    out = spmd(world, exscan_body)
+    assert np.all(out[0] == 0)
+    for r in range(1, 8):
+        np.testing.assert_allclose(out[r], expect[r - 1], rtol=1e-10)
+
+
+def test_overlap_multiple_in_flight(world):
+    """Several nonblocking collectives outstanding at once, completed out of
+    issue order — the schedules must not cross-match."""
+    data1 = _rank_data(8, 16, seed=38)
+    data2 = _rank_data(8, 16, seed=39)
+    data3 = np.arange(64, dtype=np.float64)
+
+    def body(c, r):
+        r1 = nbc.iallreduce(c, data1[r])
+        r2 = nbc.iallreduce(c, data2[r], op_mod.MAX)
+        r3 = nbc.ibcast(c, data3 if r == 2 else np.zeros_like(data3), 2)
+        rb = nbc.ibarrier(c)
+        waitall([r3, r1, rb, r2])
+        return r1.result, r2.result, r3.result
+
+    out = spmd(world, body)
+    for r in range(8):
+        s, m, b = out[r]
+        np.testing.assert_allclose(s, data1.sum(0), rtol=1e-10)
+        np.testing.assert_allclose(m, data2.max(0))
+        np.testing.assert_array_equal(b, data3)
+
+
+def test_selection_provides_nonblocking_slots(world5):
+    """libnbc (25) must own the i* slots on non-device comms; tuned (30)
+    the blocking ones.  world5 is carved from the device world, so emulate
+    the multi-process shape by querying components directly."""
+    from ompi_tpu.base import mca
+
+    fw = mca.framework("coll")
+    fw.open()
+    comp = fw.components["libnbc"]
+
+    class FakeRte:
+        is_device_world = False
+
+    class FakeComm:
+        rte = FakeRte()
+        size = 4
+
+    res = comp.comm_query(FakeComm())
+    assert res is not None
+    prio, module = res
+    assert prio == 25
+    assert hasattr(module, "iallreduce") and hasattr(module, "ibarrier")
+    assert not hasattr(module, "allreduce")   # blocking slots left to tuned
