@@ -136,7 +136,7 @@ class Comm(AttributeHost):
     def _check_state(self, peer: Optional[int] = None) -> None:
         if self.freed:
             raise MpiError(ErrorClass.ERR_COMM, "communicator was freed")
-        if self.revoked:
+        if self.is_revoked():
             self._err(RevokedError(f"{self.name} revoked"))
         if peer is not None and peer not in (ANY_SOURCE, PROC_NULL):
             if not 0 <= peer < (self.remote_size if self.is_inter else self.size):
@@ -435,6 +435,10 @@ class Comm(AttributeHost):
         from ompi_tpu.mca.coll.base import comm_select
 
         newcomm.pml = self.pml
+        if newcomm.pml is not None:
+            add = getattr(newcomm.pml, "add_comm", None)
+            if add is not None:
+                add(newcomm)
         comm_select(newcomm)
 
     def free(self) -> None:
@@ -471,7 +475,27 @@ class Comm(AttributeHost):
         failed = [r for r in self.group.world_ranks if ft_state.is_failed(r)]
         return Group(failed)
 
+    def ack_failed(self, num_to_ack: Optional[int] = None) -> int:
+        """``MPIX_Comm_ack_failed``: acknowledge known failures.
+
+        Acknowledged ranks stop tripping ``agree`` into ProcFailedError.
+        Returns the number of failures acknowledged.
+        """
+        from ompi_tpu.ft import state as ft_state
+
+        failed = [r for r in self.group.world_ranks if ft_state.is_failed(r)]
+        if num_to_ack is not None:
+            failed = failed[:num_to_ack]
+        self._acked_failed = frozenset(failed) | getattr(
+            self, "_acked_failed", frozenset())
+        return len(self._acked_failed)
+
     def is_revoked(self) -> bool:
+        if not self.revoked:
+            from ompi_tpu.ft import state as ft_state
+
+            if ft_state.is_comm_revoked(self.cid, self.epoch):
+                self.revoked = True
         return self.revoked
 
     def __repr__(self) -> str:

@@ -1,0 +1,143 @@
+"""Fault-tolerant agreement over the coordination service.
+
+TPU-native stand-in for the reference's ERA consensus
+(``/root/reference/ompi/mca/coll/ftagree/coll_ftagree_earlyreturning.c``):
+where ERA builds a resilient rebalancing tree out of surviving ranks and
+broadcasts the root's decision down it, we lean on the coordination
+service (the PMIx equivalent — already the reliable out-of-band channel
+for failure eventing) as the agreement rendezvous:
+
+1. every live participant publishes its contribution (plus its current
+   failure knowledge) under a per-instance key;
+2. the *coordinator* — the lowest participant it believes alive — gathers
+   contributions from all live participants, reduces them, and publishes
+   one immutable decision under ``(instance, coordinator)``;
+3. everyone adopts the decision of the lowest coordinator that published
+   one; if a coordinator dies before deciding, the next-lowest live rank
+   takes over (ERA's tree-rebalancing equivalent).
+
+Uniformity rests on the failure detector being authoritative (ranks are
+declared dead by the launcher/heartbeat ring only when actually dead —
+the same perfect-detector assumption ULFM's detector makes): decisions
+are immutable per (instance, coordinator) key, and all survivors walk the
+coordinator list in the same ascending order.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable
+
+from ompi_tpu.ft import state as ft_state
+
+
+class AgreementError(RuntimeError):
+    pass
+
+
+def _key(instance: tuple, kind: str) -> str:
+    return f"ftagree:{kind}:" + ":".join(str(x) for x in instance)
+
+
+def agree_kv(
+    rte,
+    instance: tuple,
+    contribution: Any,
+    participants: Iterable[int],
+    combine: Callable[[Any, Any], Any],
+    timeout: float = 60.0,
+    poll: float = 0.02,
+) -> tuple[Any, frozenset]:
+    """One agreement instance; returns (combined value, agreed failed set).
+
+    ``instance`` must be identical on every participant and unique per call
+    (e.g. ``(cid, epoch, seq)``).  ``participants`` are world ranks.
+    Contributions are combined in ascending-rank order, so any associative
+    reduction is deterministic.
+    """
+    participants = sorted(participants)
+    me = rte.my_world_rank
+    ckey = _key(instance, "c")
+    rte.modex_put(ckey, contribution)
+    deadline = time.monotonic() + timeout
+
+    while True:
+        # am I the lowest live participant? then gather, decide, publish
+        live = [r for r in participants if not ft_state.is_failed(r)]
+        if not live:
+            raise AgreementError(f"agreement {instance}: no live participants")
+        coord = live[0]
+        if coord == me:
+            # adopt a lower (now-dead) coordinator's decision if it landed
+            # before it died — decisions are immutable, so republishing an
+            # adopted one under my own key is harmless
+            decision = None
+            for r in participants:
+                if r >= me:
+                    break
+                got = rte.modex_get(r, _key(instance, f"d{r}"), wait=False)
+                if got is not None:
+                    decision = got
+                    break
+            if decision is None:
+                decision = _decide(rte, instance, participants, combine,
+                                   deadline, poll)
+            rte.modex_put(_key(instance, f"d{me}"), decision)
+            return decision
+        # otherwise adopt the decision of the lowest coordinator that
+        # published one (a dead coordinator's decision still counts — it is
+        # immutable and globally visible once published).  Scan ALL
+        # participants, not just lower ranks: if this rank was itself
+        # falsely suspected, a higher-ranked coordinator may have decided.
+        for r in participants:
+            if r == me:
+                continue
+            got = rte.modex_get(r, _key(instance, f"d{r}"), wait=False)
+            if got is not None:
+                return got
+        if time.monotonic() > deadline:
+            raise AgreementError(f"agreement {instance} timed out at rank {me}")
+        # park on the believed coordinator's decision key with ONE
+        # server-side waiting get instead of busy-rescanning n keys every
+        # poll interval (O(n^2) RPC load across the job otherwise); fall
+        # back to the scan when the wait expires or the coordinator changes
+        client = getattr(rte, "client", None)
+        if client is not None:
+            try:
+                got = client.get(coord, _key(instance, f"d{coord}"),
+                                 wait=True, timeout=0.5)
+            except Exception:
+                got = None
+            if got is not None:
+                return got
+        else:
+            time.sleep(poll)
+
+
+def _decide(rte, instance, participants, combine, deadline, poll):
+    """Coordinator side: gather live contributions, reduce, decide."""
+    ckey = _key(instance, "c")
+    values: dict[int, Any] = {}
+    known_failed: set[int] = set()
+    pending = list(participants)
+    while pending:
+        still = []
+        for r in pending:
+            got = rte.modex_get(r, ckey, wait=False)
+            if got is not None:
+                values[r] = got
+            elif ft_state.is_failed(r):
+                known_failed.add(r)
+            else:
+                still.append(r)
+        pending = still
+        if pending:
+            if time.monotonic() > deadline:
+                raise AgreementError(
+                    f"agreement {instance} timed out waiting for {pending}")
+            time.sleep(poll)
+    out = None
+    for r in sorted(values):
+        out = values[r] if out is None else combine(out, values[r])
+    known_failed.update(r for r in participants
+                        if ft_state.is_failed(r))
+    return out, frozenset(known_failed)

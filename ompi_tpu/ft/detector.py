@@ -1,0 +1,113 @@
+"""Heartbeat failure detector — ring of observers.
+
+Re-design of ``/root/reference/ompi/communicator/ft/comm_ft_detector.c``:
+each process emits a periodic heartbeat to one observer arranged in a ring
+(``:29-33``), period η / timeout τ tunables (``:88-89``, defaults 3s/10s).
+TPU-native carrier: instead of RDMA-put heartbeats over the BTL, heartbeats
+are sequence-numbered puts into the coordination-service KV space (the
+job's reliable out-of-band channel); the observer polls its emitter's
+counter and, on a stall past the timeout, reports the failure to the
+propagator.  On emitter death the observer rotates to the next live
+predecessor, exactly as the reference rotates observers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ompi_tpu.base.var import VarType, registry
+from ompi_tpu.ft import state as ft_state
+
+_period_var = registry.register(
+    "ft", None, "detector_period", vtype=VarType.FLOAT, default=3.0,
+    help="Heartbeat emission period in seconds (reference eta=3s)")
+_timeout_var = registry.register(
+    "ft", None, "detector_timeout", vtype=VarType.FLOAT, default=10.0,
+    help="Heartbeat staleness timeout in seconds (reference tau=10s)")
+
+
+class Detector:
+    """Per-process heartbeat emitter + predecessor observer.
+
+    Uses its OWN coordination-service connection: heartbeat emission must
+    not queue behind blocking RPCs (fences, waiting modex gets) on the
+    shared client, or a rank stuck in a long-but-legitimate wait would
+    starve its own heartbeats and be falsely declared dead.
+    """
+
+    def __init__(self, rte) -> None:
+        from ompi_tpu.rte.coord import CoordClient
+
+        self.rte = rte
+        self.client = CoordClient()
+        self.period = float(_period_var.value)
+        self.timeout = float(_timeout_var.value)
+        self._stop = threading.Event()
+        self._seq = 0
+        self._departed: set[int] = set()
+        self._thread = threading.Thread(
+            target=self._run, name="otpu-ft-detector", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Clean shutdown: leave a tombstone so observers see a finalized
+        rank as a clean departure, not a failure (ULFM distinguishes
+        finalized from failed processes)."""
+        self._stop.set()
+        try:
+            self.client.put(self.rte.my_world_rank, "hb_final", True)
+        except Exception:
+            pass
+
+    # -- internals -------------------------------------------------------
+    def _emitter_of(self) -> int:
+        """The rank I observe: nearest live, non-departed predecessor."""
+        n = self.rte.world_size
+        me = self.rte.my_world_rank
+        for d in range(1, n):
+            r = (me - d) % n
+            if not ft_state.is_failed(r) and r not in self._departed:
+                return r
+        return me
+
+    def _run(self) -> None:
+        me = self.rte.my_world_rank
+        last_seq: dict[int, tuple[int, float]] = {}
+        while not self._stop.is_set():
+            now = time.monotonic()
+            # emit my heartbeat
+            self._seq += 1
+            try:
+                self.client.put(me, "hb", self._seq)
+            except Exception:
+                return  # coordination service gone: job is ending
+            # observe my current emitter
+            target = self._emitter_of()
+            if target != me:
+                try:
+                    seen = self.client.get(target, "hb", wait=False)
+                except Exception:
+                    return
+                prev = last_seq.get(target)
+                if prev is None or (seen is not None and seen != prev[0]):
+                    last_seq[target] = (seen, now)
+                elif now - prev[1] > self.timeout:
+                    try:
+                        finalized = self.client.get(target, "hb_final",
+                                                    wait=False)
+                    except Exception:
+                        return
+                    if finalized:
+                        # clean departure (finalize tombstone): rotate past
+                        # it without declaring a failure
+                        self._departed.add(target)
+                    else:
+                        from ompi_tpu.ft import propagator
+
+                        propagator.report_failure(self.rte, target,
+                                                  origin="heartbeat",
+                                                  client=self.client)
+                    last_seq.pop(target, None)
+            self._stop.wait(self.period)

@@ -1,0 +1,131 @@
+"""Failure propagation + FT event delivery.
+
+Re-design of ``/root/reference/ompi/communicator/ft/comm_ft_propagator.c``
+(+ ``comm_ft_reliable_bcast.c``): a detected failure is broadcast reliably
+to every survivor.  The reference builds a resilient binomial-graph overlay
+for the broadcast; TPU-native, the coordination service's event bus (the
+PMIx-event equivalent that ULFM also rides, ``ompi_mpi_init.c:400-402``)
+is the reliable carrier: the reporter publishes one ``proc_failed`` event,
+and every process's poller thread delivers it into the local failure state
+(``ompi_tpu.ft.state``).  Communicator revocation (``comm_ft_revoke.c``)
+rides the same bus as ``comm_revoked`` events.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ompi_tpu.base import output as _output
+from ompi_tpu.ft import state as ft_state
+
+_stream = _output.open_stream("ft")
+
+
+def report_failure(rte, world_rank: int, origin: str = "unknown",
+                   client=None) -> None:
+    """Local detection -> global knowledge: publish + apply locally.
+
+    ``client``: publish over this dedicated coordination connection instead
+    of the shared one (the detector passes its own so a blocked shared
+    client can't stall the report — or the detector's heartbeat loop).
+    """
+    if ft_state.is_failed(world_rank):
+        return
+    _output.output(_stream, 1, "rank %d detected failed (via %s)",
+                   world_rank, origin)
+    ft_state.mark_failed(world_rank)
+    try:
+        if client is not None:
+            client.event_publish("proc_failed",
+                                 {"rank": world_rank, "origin": origin})
+        else:
+            rte.event_notify("proc_failed",
+                             {"rank": world_rank, "origin": origin})
+    except Exception:
+        pass  # coordination service gone: job teardown in progress
+
+
+def report_revoke(rte, cid: int, epoch: int) -> None:
+    ft_state.mark_revoked(cid, epoch)
+    try:
+        rte.event_notify("comm_revoked", {"cid": cid, "epoch": epoch})
+    except Exception:
+        pass
+
+
+class EventPoller:
+    """Background consumer of the job event bus (PMIx event thread analog).
+
+    Owns a dedicated coordination connection: event delivery must work even
+    while the shared client is parked in a long blocking RPC (revocations
+    and failures must reach members "blocked in unrelated operations").
+    """
+
+    def __init__(self, rte, interval: float = 0.1) -> None:
+        from ompi_tpu.rte.coord import CoordClient
+
+        self.rte = rte
+        self.client = CoordClient()
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="otpu-ft-events", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.client.close()
+        except Exception:
+            pass
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events = self.client.event_poll()
+            except Exception:
+                return  # connection torn down: job is ending
+            for _, name, payload in events:
+                self._dispatch(name, payload)
+            self._stop.wait(self.interval)
+
+    def _dispatch(self, name: str, payload) -> None:
+        if name == "proc_failed":
+            rank = int(payload["rank"])
+            if not ft_state.is_failed(rank):
+                _output.output(_stream, 1, "rank %d failed (event from %s)",
+                               rank, payload.get("origin"))
+                ft_state.mark_failed(rank)
+        elif name == "comm_revoked":
+            ft_state.mark_revoked(int(payload["cid"]),
+                                  int(payload.get("epoch", 0)))
+
+
+_poller: Optional[EventPoller] = None
+_detector = None
+
+
+def start(rte, with_detector: bool = False) -> None:
+    """Start the FT runtime (event poller + optional heartbeat ring)."""
+    global _poller, _detector
+    if _poller is None:
+        _poller = EventPoller(rte)
+        _poller.start()
+    if with_detector and _detector is None:
+        from ompi_tpu.ft.detector import Detector
+
+        _detector = Detector(rte)
+        _detector.start()
+
+
+def stop() -> None:
+    global _poller, _detector
+    if _poller is not None:
+        _poller.stop()
+        _poller = None
+    if _detector is not None:
+        _detector.stop()
+        _detector = None

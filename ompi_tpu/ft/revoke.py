@@ -1,0 +1,18 @@
+"""``MPIX_Comm_revoke`` (``/root/reference/ompi/communicator/ft/
+comm_ft_revoke.c`` + ``ompi/mpiext/ftmpi/c/comm_revoke.c``).
+
+Revocation is non-collective: any member may revoke; every other member
+must learn of it even while blocked in unrelated operations.  The carrier
+is the job event bus (the reference uses a resilient broadcast overlay +
+PMIx events); the revoked (cid, epoch) lands in the global FT state that
+every communicator's ``_check_state`` consults, so in-progress and future
+operations on the revoked communicator raise ``RevokedError`` uniformly.
+"""
+from __future__ import annotations
+
+from ompi_tpu.ft import propagator
+
+
+def revoke(comm) -> None:
+    comm.revoked = True
+    propagator.report_revoke(comm.rte, comm.cid, comm.epoch)

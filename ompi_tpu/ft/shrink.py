@@ -1,0 +1,66 @@
+"""``MPIX_Comm_shrink`` (``/root/reference/ompi/communicator/ft/comm_ft.c``
+``ompi_comm_shrink_internal``).
+
+The reference shrinks in three steps: (1) agree on the failed-rank set via
+the ftagree consensus, (2) build the survivor group, (3) allocate a fresh
+CID with a bumped FT epoch so the new communicator cannot be confused with
+the revoked/damaged parent (``comm_cid.c:73-78``).  Same shape here, with
+the agreement riding the coordination service
+(:mod:`ompi_tpu.ft.agreement`): survivors agree on (union of failed sets,
+max of proposed CIDs) in a single instance, then construct the shrunken
+communicator locally.
+"""
+from __future__ import annotations
+
+from ompi_tpu.api.group import Group
+from ompi_tpu.ft import state as ft_state
+
+
+def shrink(comm):
+    from ompi_tpu.api.comm import Comm
+    from ompi_tpu.runtime import init as rt
+
+    members = list(comm.group.world_ranks)
+
+    if comm.rte is None or comm.rte.is_device_world:
+        # single-controller model: failure knowledge is already uniform
+        survivors = [r for r in members if not ft_state.is_failed(r)]
+        cid = rt.next_local_cid()
+    else:
+        from ompi_tpu.ft.agreement import agree_kv
+
+        seq = comm._ft_seq = getattr(comm, "_ft_seq", 0) + 1
+        proposed = rt.next_local_cid()
+
+        def combine(a, b):
+            return (a[0] | b[0], max(a[1], b[1]))
+
+        live = [r for r in members if not ft_state.is_failed(r)]
+        (failed_bits, cid), agreed_failed = agree_kv(
+            comm.rte,
+            ("shrink", comm.cid, comm.epoch, seq),
+            (_bits(members, ft_state.failed_ranks()), proposed),
+            live,
+            combine,
+        )
+        dead = {r for r in agreed_failed} | _unbits(members, failed_bits)
+        survivors = [r for r in members if r not in dead]
+
+    rt.reserve_cid(cid)
+    newcomm = Comm(Group(survivors), cid, comm.rte,
+                   name=f"{comm.name}~shrink", epoch=comm.epoch + 1,
+                   parent=comm)
+    comm._finish_create(newcomm)
+    return newcomm
+
+
+def _bits(members, failed) -> int:
+    out = 0
+    for i, r in enumerate(members):
+        if r in failed:
+            out |= 1 << i
+    return out
+
+
+def _unbits(members, bits: int) -> set:
+    return {r for i, r in enumerate(members) if bits >> i & 1}

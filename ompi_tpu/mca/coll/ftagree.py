@@ -1,0 +1,83 @@
+"""coll/ftagree — ULFM agreement collective.
+
+Re-design of ``/root/reference/ompi/mca/coll/ftagree/``: provides the
+``agree``/``iagree`` slots of the per-comm vtable with a fault-tolerant
+consensus (the ERA algorithm, ``coll_ftagree_earlyreturning.c``), selected
+at priority above the non-FT fallbacks so agreement keeps working across
+failures.  The consensus itself rides the coordination service
+(:mod:`ompi_tpu.ft.agreement`).
+
+ULFM semantics (``ompi/mpiext/ftmpi/c/comm_agree.c``): the int flag is
+bitwise-ANDed across all live participants; the call is uniform; if a
+group member failed and has not been acknowledged via
+``Comm.ack_failed``, every survivor raises ``ProcFailedError`` (carrying
+the agreed flag) after agreeing — agreement on the error itself.
+"""
+from __future__ import annotations
+
+from ompi_tpu.api.request import CompletedRequest
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+from ompi_tpu.ft import state as ft_state
+
+
+class FtAgreeModule:
+    def agree(self, comm, flag: int) -> int:
+        from ompi_tpu.api.errors import ProcFailedError
+        from ompi_tpu.ft.agreement import agree_kv
+
+        members = list(comm.group.world_ranks)
+        live = [r for r in members if not ft_state.is_failed(r)]
+        seq = comm._agree_seq = getattr(comm, "_agree_seq", 0) + 1
+        # each participant contributes (flag, its failure knowledge, whether
+        # it has group failures it hasn't acknowledged): the AND/union/OR
+        # over contributions makes the failed-set AND the error outcome part
+        # of the uniform decision (comm_agree.c group-fault sync) — all
+        # survivors raise ProcFailedError or none do, never a mix
+        acked = getattr(comm, "_acked_failed", frozenset())
+        my_unacked = any(r in ft_state.failed_ranks() and r not in acked
+                         for r in members)
+        (agreed_flag, agreed_failed, any_unacked), _ = agree_kv(
+            comm.rte,
+            ("agree", comm.cid, comm.epoch, seq),
+            (int(flag), frozenset(ft_state.failed_ranks()), my_unacked),
+            live,
+            lambda a, b: (a[0] & b[0], a[1] | b[1], a[2] or b[2]),
+        )
+        if any_unacked:
+            in_group_failed = [r for r in members if r in agreed_failed]
+            err = ProcFailedError(
+                f"agreement completed but ranks {in_group_failed} failed "
+                f"without all survivors acknowledging",
+                tuple(comm.group.rank_of(r) for r in in_group_failed))
+            err.flag = agreed_flag
+            comm._err(err)  # route through the communicator errhandler
+        return agreed_flag
+
+    def iagree(self, comm, flag: int):
+        r = CompletedRequest()
+        r.result = self.agree(comm, flag)
+        return r
+
+
+class FtAgreeComponent(Component):
+    name = "ftagree"
+    priority = 30
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=30,
+            help="Selection priority of coll/ftagree")
+
+    def comm_query(self, comm):
+        # the consensus needs the out-of-band KV service: multi-process only
+        if comm.rte is None or comm.rte.is_device_world:
+            return None
+        if getattr(comm.rte, "client", None) is None:
+            return None
+        if comm.size == 1:
+            return None
+        return self._prio.value, FtAgreeModule()
+
+
+COMPONENT = FtAgreeComponent()

@@ -50,13 +50,14 @@ class CoordServer:
         self.nprocs = nprocs
         self._kv: dict[tuple, Any] = {}
         self._kv_cond = threading.Condition()
-        self._fence_count: dict[str, int] = {}
+        self._fence_ranks: dict[str, set] = {}
         self._fence_gen: dict[str, int] = {}
         self._fence_cond = threading.Condition()
         self._events: list[tuple[int, str, Any]] = []
         self._event_seq = 0
         self._event_cond = threading.Condition()
         self._aborted: Optional[int] = None
+        self._failed: set[int] = set()
         self._srv = socket.create_server((host, port))
         self.addr = self._srv.getsockname()
         self._threads: list[threading.Thread] = []
@@ -99,25 +100,29 @@ class CoordServer:
                 elif op == "fence":
                     fid = req["id"]
                     with self._fence_cond:
-                        self._fence_count[fid] = self._fence_count.get(fid, 0) + 1
-                        if self._fence_count[fid] >= self.nprocs:
-                            self._fence_count[fid] = 0
-                            self._fence_gen[fid] = self._fence_gen.get(fid, 0) + 1
-                            self._fence_cond.notify_all()
-                            gen = self._fence_gen[fid]
+                        # per-rank contribution tracking: a fence completes
+                        # when every rank has either arrived or died — a
+                        # dead rank's earlier arrival must not release the
+                        # fence while a live survivor is still outside it
+                        arrived = self._fence_ranks.setdefault(fid, set())
+                        arrived.add(req.get("rank", -1))
+                        if self._fence_satisfied(fid):
+                            self._complete_fence(fid)
                         else:
                             gen = self._fence_gen.get(fid, 0)
                             while self._fence_gen.get(fid, 0) == gen:
                                 self._fence_cond.wait(1.0)
                                 if self._aborted is not None:
                                     break
+                                # a failure may have lowered the bar
+                                if self._fence_satisfied(fid):
+                                    self._complete_fence(fid)
+                                    break
                     _send_frame(conn, {"ok": True})
                 elif op == "event_pub":
-                    with self._event_cond:
-                        self._event_seq += 1
-                        self._events.append(
-                            (self._event_seq, req["name"], req["payload"]))
-                        self._event_cond.notify_all()
+                    # routed through publish() so in-band failure reports
+                    # (heartbeat detector) also update fence bookkeeping
+                    self.publish(req["name"], req["payload"])
                     _send_frame(conn, {"ok": True})
                 elif op == "event_poll":
                     since = req["since"]
@@ -136,6 +141,32 @@ class CoordServer:
                     _send_frame(conn, {"ok": False, "error": f"bad op {op}"})
         except (ConnectionError, OSError):
             return
+
+    def _fence_satisfied(self, fid: str) -> bool:
+        # caller holds _fence_cond
+        arrived = self._fence_ranks.get(fid, set())
+        return all(r in arrived or r in self._failed
+                   for r in range(self.nprocs))
+
+    def _complete_fence(self, fid: str) -> None:
+        # caller holds _fence_cond
+        self._fence_ranks[fid] = set()
+        self._fence_gen[fid] = self._fence_gen.get(fid, 0) + 1
+        self._fence_cond.notify_all()
+
+    def publish(self, name: str, payload: Any) -> None:
+        """Server-side event injection (launcher-detected failures)."""
+        if name == "proc_failed":
+            with self._fence_cond:
+                self._failed.add(int(payload["rank"]))
+                # a pending fence may now be satisfiable by the survivors
+                for fid in list(self._fence_ranks):
+                    if self._fence_ranks[fid] and self._fence_satisfied(fid):
+                        self._complete_fence(fid)
+        with self._event_cond:
+            self._event_seq += 1
+            self._events.append((self._event_seq, name, payload))
+            self._event_cond.notify_all()
 
     @property
     def aborted(self) -> Optional[int]:
@@ -177,8 +208,15 @@ class CoordClient:
         return self._rpc(op="get", rank=rank, key=key, wait=wait,
                          timeout=timeout)["value"]
 
-    def fence(self, fence_id: str = "default") -> None:
-        self._rpc(op="fence", id=fence_id)
+    def fence(self, fence_id: str, *, rank: int) -> None:
+        """Enter a named fence as ``rank``.
+
+        ``rank`` is mandatory: the server's completion rule is per-rank
+        arrival-or-death, so an anonymous contribution can never satisfy it.
+        """
+        if rank < 0:
+            raise ValueError("fence requires the caller's world rank")
+        self._rpc(op="fence", id=fence_id, rank=rank)
 
     def event_publish(self, name: str, payload: Any) -> None:
         self._rpc(op="event_pub", name=name, payload=payload)

@@ -36,7 +36,7 @@ class ProcRte(Rte):
 
     def fence(self) -> None:
         self._fence_counter += 1
-        self.client.fence(f"f{self._fence_counter}")
+        self.client.fence(f"f{self._fence_counter}", rank=self.my_world_rank)
 
     def locality_color(self, split_type: str) -> int:
         # 'shared' → same host (the sm/ICI domain)

@@ -124,6 +124,20 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
         comm_select(_world)
         comm_select(_self)
 
+        # ULFM FT runtime: event poller + optional heartbeat ring
+        # (PMIX_ERR_PROC_ABORTED handler registration, ompi_mpi_init.c:400-402)
+        _ft_enable = registry.register(
+            "ft", None, "enable", vtype=VarType.BOOL, default=True,
+            help="Start the FT event poller (failure/revocation delivery)")
+        _ft_detector = registry.register(
+            "ft", None, "detector", vtype=VarType.BOOL, default=False,
+            help="Start the heartbeat ring failure detector")
+        if not _rte.is_device_world and getattr(_rte, "client", None) is not None:
+            if _ft_enable.value:
+                from ompi_tpu.ft import propagator
+
+                propagator.start(_rte, with_detector=bool(_ft_detector.value))
+
         mark_runtime_initialized(True)
         _state = State.INIT_COMPLETED
         atexit.register(_atexit_finalize)
@@ -158,6 +172,9 @@ def finalize() -> None:
             return
         _state = State.FINALIZE_STARTED
         try:
+            from ompi_tpu.ft import propagator as _ft_prop
+
+            _ft_prop.stop()
             if _world is not None and _world.pml is not None:
                 fin = getattr(_world.pml, "finalize", None)
                 if fin is not None:
@@ -187,6 +204,9 @@ def reset_for_testing() -> None:
     """Full teardown allowing re-init (tests only)."""
     global _state
     finalize()
+    from ompi_tpu.ft import state as _ft_state
+
+    _ft_state.reset_for_testing()
     with _lock:
         _state = State.NOT_INITIALIZED
 

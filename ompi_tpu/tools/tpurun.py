@@ -29,6 +29,10 @@ def main(argv=None) -> int:
                     help="Set an MCA variable for all ranks")
     ap.add_argument("--tag-output", action="store_true", default=True)
     ap.add_argument("--coord-port", type=int, default=0)
+    ap.add_argument("--enable-recovery", action="store_true",
+                    help="ULFM mode: a dying rank is reported as a "
+                         "proc_failed event instead of tearing down the job "
+                         "(mpirun --enable-recovery)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -82,6 +86,7 @@ def main(argv=None) -> int:
         pumps.append(t)
 
     exit_code = 0
+    reported_failed: set = set()
     try:
         while True:
             alive = [p for p in procs if p.poll() is None]
@@ -91,9 +96,27 @@ def main(argv=None) -> int:
                 exit_code = server.aborted
                 break
             if failed:
-                exit_code = failed[0].returncode
-                break
+                if args.enable_recovery:
+                    # ULFM: report the death, keep the job running — the
+                    # PRRTE-daemon-detects-child-death path of the reference
+                    for rank, p in enumerate(procs):
+                        if p in failed and rank not in reported_failed:
+                            reported_failed.add(rank)
+                            print(f"tpurun: rank {rank} failed (exit "
+                                  f"{p.returncode}); continuing (recovery)",
+                                  file=sys.stderr)
+                            server.publish("proc_failed",
+                                           {"rank": rank, "origin": "launcher"})
+                else:
+                    exit_code = failed[0].returncode
+                    break
             if not alive:
+                if args.enable_recovery and not any(
+                        p.returncode == 0 for p in procs):
+                    # recovery mode, but nothing survived to completion:
+                    # the job as a whole failed
+                    exit_code = next(p.returncode for p in procs
+                                     if p.returncode != 0)
                 break
             time.sleep(0.05)
     except KeyboardInterrupt:

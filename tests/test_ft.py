@@ -1,0 +1,243 @@
+"""ULFM fault tolerance: failure state, revoke/shrink/agree, detector,
+recovery-mode launcher (SURVEY.md §3.5/§5.3)."""
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.ft import state as ft_state
+from ompi_tpu.runtime import init as rt
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def world():
+    from ompi_tpu.api.errhandler import ERRORS_RETURN
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    w.set_errhandler(ERRORS_RETURN)  # ULFM apps opt out of abort-on-error
+    yield w
+    rt.reset_for_testing()
+
+
+class TestFailureState:
+    def test_mark_and_listeners(self):
+        ft_state.reset_for_testing()
+        seen = []
+        ft_state.on_failure(seen.append)
+        ft_state.mark_failed(3)
+        ft_state.mark_failed(3)  # dedup
+        assert ft_state.is_failed(3)
+        assert ft_state.failed_ranks() == frozenset({3})
+        assert seen == [3]
+        ft_state.reset_for_testing()
+
+    def test_revoked_cids_epoch_scoped(self):
+        ft_state.reset_for_testing()
+        ft_state.mark_revoked(5, epoch=0)
+        assert ft_state.is_comm_revoked(5, 0)
+        assert not ft_state.is_comm_revoked(5, 1)  # reused CID, new epoch
+        ft_state.reset_for_testing()
+
+
+class TestDeviceWorldFt:
+    def test_send_to_failed_rank_raises(self, world):
+        from ompi_tpu.api.errors import ProcFailedError
+
+        if world.size < 2:
+            pytest.skip("needs >= 2 ranks in device world")
+        ft_state.mark_failed(world.world_rank(1))
+        with pytest.raises(ProcFailedError):
+            world.as_rank(0).send(np.zeros(1), dest=1)
+        assert world.get_failed().size == 1
+
+    def test_revoke_then_ops_raise(self, world):
+        from ompi_tpu.api.errors import RevokedError
+
+        dup = world.dup()
+        dup.revoke()
+        assert dup.is_revoked()
+        # a facade of the same comm (another "rank") sees the revocation
+        # through the global FT state even though the flag was set on dup
+        other = dup.as_rank(min(1, world.size - 1))
+        other.revoked = False
+        with pytest.raises(RevokedError):
+            other.barrier()
+
+    def test_shrink_excludes_failed(self, world):
+        if world.size < 2:
+            pytest.skip("needs >= 2 ranks")
+        dead = world.world_rank(world.size - 1)
+        ft_state.mark_failed(dead)
+        s = world.shrink()
+        assert s.size == world.size - 1
+        assert dead not in s.group.world_ranks
+        assert s.epoch == world.epoch + 1
+        # shrunken comm is fully operational (conductor model: leading axis
+        # indexes ranks)
+        out = s.allreduce(np.ones((s.size, 4)))
+        assert out.tolist() == [float(s.size)] * 4
+
+    def test_ack_failed(self, world):
+        if world.size < 2:
+            pytest.skip("needs >= 2 ranks")
+        ft_state.mark_failed(world.world_rank(1))
+        assert world.ack_failed() == 1
+
+
+def _tpurun(n, script, timeout=180, recovery=False, mca=()):
+    env = dict(os.environ)
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n)]
+    if recovery:
+        cmd.append("--enable-recovery")
+    for k, v in mca:
+        cmd += ["--mca", k, v]
+    cmd += [sys.executable, str(script)]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO, env=env)
+
+
+class TestMultiprocessFt:
+    def test_launcher_detects_death_survivors_shrink(self, tmp_path):
+        script = tmp_path / "ft.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            import numpy as np
+            import ompi_tpu
+            from ompi_tpu.ft import state as ft_state
+
+            w = ompi_tpu.init()
+            w.barrier()
+            if w.rank == 1:
+                os._exit(13)  # sudden death, no cleanup
+            deadline = time.time() + 60
+            while not ft_state.is_failed(1):
+                if time.time() > deadline:
+                    sys.exit("failure of rank 1 never detected")
+                time.sleep(0.05)
+            assert w.get_failed().size == 1
+            s = w.shrink()
+            assert s.size == 3, s.size
+            assert s.epoch == 1
+            out = s.allreduce(np.array([float(s.rank + 1)]))
+            assert out[0] == 6.0, out
+            if s.rank == 0:
+                print("FT SHRINK OK")
+            ompi_tpu.finalize()
+        """))
+        r = _tpurun(4, script, recovery=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "FT SHRINK OK" in r.stdout
+
+    def test_agree_with_failure_and_ack(self, tmp_path):
+        script = tmp_path / "agree.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            import ompi_tpu
+            from ompi_tpu.api.errors import ProcFailedError
+            from ompi_tpu.api.errhandler import ERRORS_RETURN
+            from ompi_tpu.ft import state as ft_state
+
+            w = ompi_tpu.init()
+            w.set_errhandler(ERRORS_RETURN)
+            # first: agreement with everyone alive ANDs the flags
+            got = w.agree(0b1110 if w.rank else 0b0111)
+            assert got == 0b0110, got
+            w.barrier()
+            if w.rank == 2:
+                os._exit(7)
+            deadline = time.time() + 60
+            while not ft_state.is_failed(2):
+                if time.time() > deadline:
+                    sys.exit("no detection")
+                time.sleep(0.05)
+            # unacknowledged failure -> uniform ProcFailedError, flag agreed
+            try:
+                w.agree(0b11)
+                sys.exit("expected ProcFailedError")
+            except ProcFailedError as e:
+                assert e.flag == 0b11, e.flag
+            w.ack_failed()
+            assert w.agree(0b11) == 0b11
+            if w.rank == 0:
+                print("FT AGREE OK")
+            ompi_tpu.finalize()
+        """))
+        r = _tpurun(3, script, recovery=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "FT AGREE OK" in r.stdout
+
+    def test_revoke_propagates_between_processes(self, tmp_path):
+        script = tmp_path / "revoke.py"
+        script.write_text(textwrap.dedent("""
+            import sys, time
+            import ompi_tpu
+            from ompi_tpu.api.errors import RevokedError
+            from ompi_tpu.api.errhandler import ERRORS_RETURN
+
+            w = ompi_tpu.init()
+            w.set_errhandler(ERRORS_RETURN)
+            d = w.dup()
+            if w.rank == 0:
+                d.revoke()
+            deadline = time.time() + 60
+            while not d.is_revoked():
+                if time.time() > deadline:
+                    sys.exit("revocation never arrived")
+                time.sleep(0.05)
+            try:
+                d.barrier()
+                sys.exit("expected RevokedError")
+            except RevokedError:
+                pass
+            w.barrier()  # parent comm unaffected
+            if w.rank == 0:
+                print("FT REVOKE OK")
+            ompi_tpu.finalize()
+        """))
+        r = _tpurun(3, script)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "FT REVOKE OK" in r.stdout
+
+    def test_heartbeat_detector_finds_silent_peer(self, tmp_path):
+        script = tmp_path / "hb.py"
+        script.write_text(textwrap.dedent("""
+            import sys, time
+            import ompi_tpu
+            from ompi_tpu.ft import state as ft_state
+            from ompi_tpu.ft import propagator
+
+            w = ompi_tpu.init()
+            w.barrier()
+            if w.rank == 1:
+                # simulate a hang: the process stays alive (so the launcher
+                # sees nothing) but its heartbeats stop.  Halt the emitter
+                # thread WITHOUT the clean-finalize tombstone that stop()
+                # would write -- a hang leaves no tombstone.
+                propagator._detector._stop.set()
+                time.sleep(8)
+                sys.exit(0)
+            deadline = time.time() + 60
+            while not ft_state.is_failed(1):
+                if time.time() > deadline:
+                    sys.exit("heartbeat detector never fired")
+                time.sleep(0.05)
+            if w.rank == 0:
+                print("FT DETECTOR OK")
+            ompi_tpu.finalize()
+        """))
+        r = _tpurun(3, script, recovery=True, timeout=120,
+                    mca=[("ft_detector", "true"),
+                         ("ft_detector_period", "0.2"),
+                         ("ft_detector_timeout", "1.5")])
+        assert "FT DETECTOR OK" in r.stdout, r.stdout + r.stderr
+        assert r.returncode == 0, r.stdout + r.stderr
