@@ -357,9 +357,7 @@ class Comm(AttributeHost):
         else:
             proposal = np.array([local], dtype=np.int64)
         agreed = self.allreduce(proposal, op_mod.MAX)
-        cid = int(np.asarray(agreed).ravel()[0])
-        rt.reserve_cid(cid)
-        return cid
+        return rt.adopt_cid(local, int(np.asarray(agreed).ravel()[0]))
 
     def dup(self) -> "Comm":
         self._check_state()
@@ -399,7 +397,8 @@ class Comm(AttributeHost):
                          if c == my_color)
         ranks = [self.group.world_rank(r) for _, r in members]
         newcomm = Comm(Group(ranks), cids[my_color], self.rte,
-                       name=f"{self.name}~split", parent=self)
+                       name=f"{self.name}~split", epoch=self.epoch,
+                       parent=self)
         self._finish_create(newcomm)
         return newcomm
 
@@ -414,7 +413,7 @@ class Comm(AttributeHost):
         if group.rank_of(self.rte.my_world_rank) < 0:
             return None
         newcomm = Comm(group, cid, self.rte, name=f"{self.name}~create",
-                       parent=self)
+                       epoch=self.epoch, parent=self)
         self._finish_create(newcomm)
         return newcomm
 
@@ -427,7 +426,8 @@ class Comm(AttributeHost):
         cid = rt.next_local_cid()
         rt.reserve_cid(cid)
         newcomm = Comm(group, cid, self.rte,
-                       name=f"{self.name}~create_group", parent=self)
+                       name=f"{self.name}~create_group", epoch=self.epoch,
+                       parent=self)
         self._finish_create(newcomm)
         return newcomm
 
@@ -441,12 +441,216 @@ class Comm(AttributeHost):
                 add(newcomm)
         comm_select(newcomm)
 
+    # -- process topologies (``ompi/mca/topo``) -------------------------
+    def cart_create(self, dims: Sequence[int], periods=None,
+                    reorder: bool = False) -> Optional["Comm"]:
+        """``MPI_Cart_create``.
+
+        ``reorder=True`` in the device-world model maps the grid onto the
+        ICI mesh device order (the treematch hardware-mapping analog) —
+        cart neighbors then sit one ICI hop apart.
+        """
+        from ompi_tpu.mca.topo import CartTopo
+
+        dims = list(dims)
+        if periods is None:
+            periods = [False] * len(dims)
+        grid = int(np.prod(dims)) if dims else 1
+        if grid > self.size:
+            raise MpiError(ErrorClass.ERR_DIMS,
+                           f"grid {dims} larger than comm size {self.size}")
+        # ranks beyond the grid are excluded (MPI_COMM_NULL).  reorder=True
+        # keeps device order in the conductor model: the device world is
+        # built from jax.devices() order, which enumerates the ICI mesh
+        # row-major — already matching our row-major cart convention.
+        if self.rte is not None and self.rte.is_device_world:
+            # conductor split needs the whole color table, not my scalar
+            color = np.array([0 if r < grid else -1
+                              for r in range(self.size)])
+            key = np.arange(self.size)
+        else:
+            color = 0 if self.rank < grid else -1
+            key = self.rank
+        sub = self.split(color, key)
+        if sub is None:
+            return None
+        sub.topo = CartTopo(dims, periods)
+        sub.name = f"{self.name}~cart"
+        return sub
+
+    def cart_coords(self, rank: Optional[int] = None) -> list:
+        self._require_topo("cart")
+        return self.topo.coords_of(self.rank if rank is None else rank)
+
+    def cart_rank(self, coords) -> int:
+        self._require_topo("cart")
+        return self.topo.rank_of(coords)
+
+    def cart_shift(self, direction: int, disp: int = 1) -> tuple:
+        self._require_topo("cart")
+        return self.topo.shift(self.rank, direction, disp)
+
+    def cart_get(self) -> tuple:
+        self._require_topo("cart")
+        return (list(self.topo.dims), list(self.topo.periods),
+                self.cart_coords())
+
+    def cart_sub(self, remain_dims) -> Optional["Comm"]:
+        """``MPI_Cart_sub``: keep the axes where remain_dims is true."""
+        self._require_topo("cart")
+        from ompi_tpu.mca.topo import CartTopo
+
+        coords = self.cart_coords()
+        dropped = tuple(c for c, keep in zip(coords, remain_dims)
+                        if not keep)
+
+        # one color per combination of dropped coordinates
+        def color_of(rank: int) -> int:
+            c0 = 0
+            for c, dim, keep in zip(self.topo.coords_of(rank),
+                                    self.topo.dims, remain_dims):
+                if not keep:
+                    c0 = c0 * dim + c
+            return c0
+
+        if self.rte is not None and self.rte.is_device_world:
+            color = np.array([color_of(r) for r in range(self.size)])
+            key = np.arange(self.size)
+        else:
+            color, key = color_of(self.rank), self.rank
+        sub = self.split(color, key)
+        if sub is None:
+            return None
+        sub.topo = CartTopo(
+            [d for d, keep in zip(self.topo.dims, remain_dims) if keep],
+            [p for p, keep in zip(self.topo.periods, remain_dims) if keep])
+        sub.name = f"{self.name}~sub{dropped}"
+        return sub
+
+    def graph_create(self, index, edges,
+                     reorder: bool = False) -> Optional["Comm"]:
+        from ompi_tpu.mca.topo import GraphTopo
+
+        nnodes = len(index)
+        if self.rte is not None and self.rte.is_device_world:
+            color = np.array([0 if r < nnodes else -1
+                              for r in range(self.size)])
+            key = np.arange(self.size)
+        else:
+            color, key = (0 if self.rank < nnodes else -1), self.rank
+        sub = self.split(color, key)
+        if sub is None:
+            return None
+        sub.topo = GraphTopo(index, edges)
+        sub.name = f"{self.name}~graph"
+        return sub
+
+    def dist_graph_create_adjacent(self, sources, destinations,
+                                   sourceweights=None, destweights=None,
+                                   reorder: bool = False) -> "Comm":
+        from ompi_tpu.mca.topo import DistGraphTopo
+
+        sub = self.dup()
+        sub.topo = DistGraphTopo(sources, destinations, sourceweights,
+                                 destweights)
+        sub.name = f"{self.name}~distgraph"
+        return sub
+
+    def _require_topo(self, kind: str) -> None:
+        if self.topo is None or self.topo.kind != kind:
+            raise MpiError(ErrorClass.ERR_TOPOLOGY,
+                           f"{self.name} has no {kind} topology")
+
+    # neighbor collectives (``coll_base_neighbor_*``): p2p compositions
+    # over the attached topology's (sources, destinations)
+    def neighbor_allgather(self, sendbuf) -> list:
+        if self.topo is None:
+            raise MpiError(ErrorClass.ERR_TOPOLOGY,
+                           f"{self.name} has no topology")
+        srcs, dsts = self.topo.neighbors(self.rank)
+        if self.rte is not None and self.rte.is_device_world:
+            # conductor model: leading axis of sendbuf indexes ranks
+            table = np.asarray(sendbuf)
+            return [None if s == PROC_NULL else np.array(table[s], copy=True)
+                    for s in srcs]
+        arr = np.ascontiguousarray(sendbuf)
+        reqs = [self.isend(arr, d, tag=-3) for d in dsts if d != PROC_NULL]
+        out = []
+        for s in srcs:
+            if s == PROC_NULL:
+                out.append(None)
+            else:
+                buf = np.empty_like(arr)
+                self.recv(buf, s, tag=-3)
+                out.append(buf)
+        waitall(reqs)
+        return out
+
+    def neighbor_alltoall(self, sendbufs) -> list:
+        if self.topo is None:
+            raise MpiError(ErrorClass.ERR_TOPOLOGY,
+                           f"{self.name} has no topology")
+        srcs, dsts = self.topo.neighbors(self.rank)
+        if self.rte is not None and self.rte.is_device_world:
+            # conductor model: sendbufs[r][k] is rank r's buffer for its
+            # k-th destination.  Pair inbound slots with senders' outbound
+            # slots FIFO per (src, dst) channel — the per-source ordering
+            # real message passing gives, correct even when a neighbor
+            # appears twice (periodic size-2 ring)
+            from collections import defaultdict, deque
+
+            chan: dict = defaultdict(deque)
+            for r in range(self.size):
+                _, r_dsts = self.topo.neighbors(r)
+                for k, d in enumerate(r_dsts):
+                    if d != PROC_NULL:
+                        chan[(r, d)].append(np.asarray(sendbufs[r][k]))
+            return [None if s == PROC_NULL
+                    else np.array(chan[(s, self.rank)].popleft(), copy=True)
+                    for s in srcs]
+        if len(sendbufs) != len(dsts):
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"need {len(dsts)} send buffers, got "
+                           f"{len(sendbufs)}")
+        reqs = []
+        template = None  # all blocks are same-sized (MPI neighbor semantics)
+        for d, buf in zip(dsts, sendbufs):
+            if d != PROC_NULL:
+                arr = np.ascontiguousarray(buf)
+                template = arr
+                reqs.append(self.isend(arr, d, tag=-4))
+        out = []
+        for s in srcs:
+            if s == PROC_NULL:
+                out.append(None)
+            elif template is None:
+                raise MpiError(ErrorClass.ERR_ARG,
+                               "cannot size receive blocks: no real "
+                               "destination buffer to mirror")
+            else:
+                buf = np.empty_like(template)
+                self.recv(buf, s, tag=-4)
+                out.append(buf)
+        waitall(reqs)
+        return out
+
     def free(self) -> None:
         self._attrs_delete_all()
         for mod in self.coll_modules:
             close = getattr(mod, "comm_unquery", None)
             if close is not None:
                 close(self)
+        if self.pml is not None:
+            del_comm = getattr(self.pml, "del_comm", None)
+            if del_comm is not None:
+                del_comm(self)
+        # revoked CIDs are retired, never released: global revocation state
+        # is keyed (cid, epoch) forever, so a reused CID at the same epoch
+        # would be falsely revoked (comm_cid.c:73-78 epoch rationale)
+        if self.cid > 1 and not self.is_revoked():
+            from ompi_tpu.runtime import init as rt
+
+            rt.release_cid(self.cid)
         self.freed = True
 
     def abort(self, errorcode: int = 1) -> None:
@@ -466,7 +670,10 @@ class Comm(AttributeHost):
         return ft_shrink.shrink(self)
 
     def agree(self, flag: int) -> int:
-        self._check_state()
+        # NOT _check_state: ULFM's agreement is the recovery primitive and
+        # must keep working on a revoked communicator (like shrink)
+        if self.freed:
+            raise MpiError(ErrorClass.ERR_COMM, "communicator was freed")
         return self._coll("agree")(self, flag)
 
     def get_failed(self) -> Group:

@@ -1,0 +1,181 @@
+"""RMA windows — the MPI one-sided API surface.
+
+Re-design of ``/root/reference/ompi/win/win.c`` + the ``osc`` framework
+dispatch (``ompi/mca/osc/osc.h`` module vtable): a ``Win`` owns an exposure
+region (a 1-D numpy array; ``disp_unit`` = dtype itemsize), an internal
+duplicate of the creating communicator isolating its RMA traffic (the
+reference allocates a window CID the same way), and the osc module chosen
+at creation (``win_select``).  Public ops mirror MPI-3 RMA: put/get/
+accumulate/get_accumulate/fetch_and_op/compare_and_swap, with fence,
+passive-target lock/unlock/lock_all/flush, and PSCW generalized active
+target sync.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.attributes import AttributeHost
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.group import Group
+
+
+class Win(AttributeHost):
+    LOCK_EXCLUSIVE = "exclusive"
+    LOCK_SHARED = "shared"
+
+    def __init__(self, comm, local: np.ndarray, name: str = "") -> None:
+        self.comm = comm            # internal dup — RMA traffic isolation
+        self.local = local          # my exposure region
+        self.name = name or f"win#{comm.cid}"
+        self.module = None          # selected osc module
+        self.freed = False
+
+    # -- creation (collective) ------------------------------------------
+    @classmethod
+    def create(cls, comm, size: Optional[int] = None, base=None,
+               dtype=np.float64, name: str = "") -> "Win":
+        """``MPI_Win_create`` / ``MPI_Win_allocate``.
+
+        ``base``: expose an existing 1-D array; or ``size``: allocate a
+        zero-filled region of ``size`` elements of ``dtype``.
+        """
+        if base is None:
+            if size is None:
+                raise MpiError(ErrorClass.ERR_WIN,
+                               "Win.create needs size= or base=")
+            base = np.zeros(size, dtype=dtype)
+        else:
+            base = np.ascontiguousarray(base)
+            if base.ndim != 1:
+                raise MpiError(ErrorClass.ERR_WIN,
+                               "window base must be 1-D")
+        win = cls(comm.dup(), base, name=name)
+        from ompi_tpu.mca.osc import win_select
+
+        win_select(win)
+        win.comm.barrier()  # all exposure agents live before first access
+        return win
+
+    # -- accessors -------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.comm.size
+
+    @property
+    def rank(self) -> int:
+        return self.comm.rank
+
+    def _check(self) -> None:
+        if self.freed:
+            raise MpiError(ErrorClass.ERR_WIN, "window was freed")
+
+    # -- RMA ops ---------------------------------------------------------
+    def put(self, arr, target: int, offset: int = 0) -> None:
+        self._check()
+        self.module.put(self, np.ascontiguousarray(arr), target, offset)
+
+    def get(self, count: int, target: int, offset: int = 0) -> np.ndarray:
+        self._check()
+        return self.module.get(self, count, target, offset)
+
+    def accumulate(self, arr, target: int, offset: int = 0,
+                   op: op_mod.Op = op_mod.SUM) -> None:
+        self._check()
+        self.module.accumulate(self, np.ascontiguousarray(arr), target,
+                               offset, op)
+
+    def get_accumulate(self, arr, target: int, offset: int = 0,
+                       op: op_mod.Op = op_mod.SUM) -> np.ndarray:
+        """Atomically fetch the old contents and apply ``arr (op) target``."""
+        self._check()
+        return self.module.get_accumulate(self, np.ascontiguousarray(arr),
+                                          target, offset, op)
+
+    def fetch_and_op(self, value, target: int, offset: int = 0,
+                     op: op_mod.Op = op_mod.SUM):
+        self._check()
+        out = self.module.get_accumulate(
+            self, np.asarray([value], dtype=self.local.dtype), target,
+            offset, op)
+        return out[0]
+
+    def compare_and_swap(self, value, compare, target: int, offset: int = 0):
+        self._check()
+        return self.module.compare_and_swap(self, value, compare, target,
+                                            offset)
+
+    # -- synchronization -------------------------------------------------
+    def fence(self) -> None:
+        """``MPI_Win_fence``: close + open an active-target epoch."""
+        self._check()
+        self.module.fence(self)
+
+    def lock(self, target: int, lock_type: str = LOCK_EXCLUSIVE) -> None:
+        self._check()
+        self.module.lock(self, target, lock_type)
+
+    def unlock(self, target: int) -> None:
+        self._check()
+        self.module.unlock(self, target)
+
+    def lock_all(self) -> None:
+        self._check()
+        for t in range(self.size):
+            self.module.lock(self, t, self.LOCK_SHARED)
+
+    def unlock_all(self) -> None:
+        self._check()
+        for t in range(self.size):
+            self.module.unlock(self, t)
+
+    def flush(self, target: int) -> None:
+        """Complete all outstanding ops this process issued to ``target``."""
+        self._check()
+        self.module.flush(self, target)
+
+    def flush_all(self) -> None:
+        self._check()
+        for t in range(self.size):
+            self.module.flush(self, t)
+
+    def flush_local(self, target: int) -> None:
+        # origin-local completion; our put/accumulate pack eagerly, so
+        # origin buffers are reusable as soon as the call returns
+        self._check()
+
+    def sync(self) -> None:
+        self._check()
+
+    # PSCW generalized active-target (MPI_Win_post/start/complete/wait)
+    def post(self, group: Group) -> None:
+        self._check()
+        self.module.post(self, group)
+
+    def start(self, group: Group) -> None:
+        self._check()
+        self.module.start(self, group)
+
+    def complete(self) -> None:
+        self._check()
+        self.module.complete(self)
+
+    def wait(self) -> None:
+        self._check()
+        self.module.wait(self)
+
+    # -- lifecycle -------------------------------------------------------
+    def free(self) -> None:
+        if self.freed:
+            return
+        self.comm.barrier()
+        self.module.detach(self)
+        self._attrs_delete_all()
+        self.comm.free()  # release the internal dup (CID, match state)
+        self.freed = True
+
+    def __repr__(self) -> str:
+        return (f"Win({self.name}, rank={self.rank}/{self.size}, "
+                f"len={self.local.size})")

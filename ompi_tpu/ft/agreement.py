@@ -11,21 +11,24 @@ for failure eventing) as the agreement rendezvous:
    failure knowledge) under a per-instance key;
 2. the *coordinator* — the lowest participant it believes alive — gathers
    contributions from all live participants, reduces them, and publishes
-   one immutable decision under ``(instance, coordinator)``;
-3. everyone adopts the decision of the lowest coordinator that published
-   one; if a coordinator dies before deciding, the next-lowest live rank
-   takes over (ERA's tree-rebalancing equivalent).
+   the decision into the instance's SINGLE decision slot with an atomic
+   put-if-absent (first writer wins, server-side);
+3. everyone (including a late or superseded coordinator) adopts whatever
+   value won the slot; if a coordinator dies before deciding, the
+   next-lowest live rank takes over (ERA's tree-rebalancing equivalent)
+   and races for the same slot — either way one value wins uniformly.
 
-Uniformity rests on the failure detector being authoritative (ranks are
-declared dead by the launcher/heartbeat ring only when actually dead —
-the same perfect-detector assumption ULFM's detector makes): decisions
-are immutable per (instance, coordinator) key, and all survivors walk the
-coordinator list in the same ascending order.
+The single first-writer-wins slot makes the decision uniform even when a
+dead coordinator's publish lands late or a rank is falsely suspected:
+there is exactly one slot per instance and the server arbitrates it
+atomically.  Liveness (someone eventually decides) still rests on the
+failure detector being authoritative, the same perfect-detector
+assumption ULFM's detector makes.
 """
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Iterable
+from typing import Any, Callable, Iterable, Optional
 
 from ompi_tpu.ft import state as ft_state
 
@@ -46,6 +49,7 @@ def agree_kv(
     combine: Callable[[Any, Any], Any],
     timeout: float = 60.0,
     poll: float = 0.02,
+    prev_instance: Optional[tuple] = None,
 ) -> tuple[Any, frozenset]:
     """One agreement instance; returns (combined value, agreed failed set).
 
@@ -53,64 +57,61 @@ def agree_kv(
     (e.g. ``(cid, epoch, seq)``).  ``participants`` are world ranks.
     Contributions are combined in ascending-rank order, so any associative
     reduction is deterministic.
+
+    ``prev_instance``: an instance on the same ordered stream that is
+    *read-complete* — every live participant has both finished it AND read
+    its decision.  The caller must pass the instance TWO steps back
+    (seq-2), not the immediately preceding one: entering seq N proves this
+    rank completed N-1, and every live peer is at least past N-2 (inside
+    or beyond N-1), hence has read N-2's decision; a slow peer may still
+    be parked reading N-1's slot, so N-1 must survive.  Its KV entries are
+    deleted here so the coordination server's store stays bounded over
+    long-running recovery loops.
     """
     participants = sorted(participants)
     me = rte.my_world_rank
     ckey = _key(instance, "c")
+    dkey = _key(instance, "d")
+    client = getattr(rte, "client", None)
+    if client is None:
+        raise AgreementError(
+            "kv agreement requires the coordination service (ProcRte)")
+    if prev_instance is not None:
+        # my contribution to the previous instance + its decision slot
+        # (idempotent: every participant deletes the shared slot)
+        try:
+            client.delete(me, _key(prev_instance, "c"))
+            client.delete(-1, _key(prev_instance, "d"))
+        except Exception:
+            pass
     rte.modex_put(ckey, contribution)
     deadline = time.monotonic() + timeout
 
     while True:
-        # am I the lowest live participant? then gather, decide, publish
+        # the decision slot is global (rank namespace -1) and written with
+        # an atomic first-writer-wins put, so one value wins uniformly no
+        # matter how many coordinators race for it
+        got = client.get(-1, dkey, wait=False)
+        if got is not None:
+            return got
+        # am I the lowest live participant? then gather, decide, race
         live = [r for r in participants if not ft_state.is_failed(r)]
         if not live:
             raise AgreementError(f"agreement {instance}: no live participants")
-        coord = live[0]
-        if coord == me:
-            # adopt a lower (now-dead) coordinator's decision if it landed
-            # before it died — decisions are immutable, so republishing an
-            # adopted one under my own key is harmless
-            decision = None
-            for r in participants:
-                if r >= me:
-                    break
-                got = rte.modex_get(r, _key(instance, f"d{r}"), wait=False)
-                if got is not None:
-                    decision = got
-                    break
-            if decision is None:
-                decision = _decide(rte, instance, participants, combine,
-                                   deadline, poll)
-            rte.modex_put(_key(instance, f"d{me}"), decision)
-            return decision
-        # otherwise adopt the decision of the lowest coordinator that
-        # published one (a dead coordinator's decision still counts — it is
-        # immutable and globally visible once published).  Scan ALL
-        # participants, not just lower ranks: if this rank was itself
-        # falsely suspected, a higher-ranked coordinator may have decided.
-        for r in participants:
-            if r == me:
-                continue
-            got = rte.modex_get(r, _key(instance, f"d{r}"), wait=False)
-            if got is not None:
-                return got
+        if live[0] == me:
+            decision = _decide(rte, instance, participants, combine,
+                               deadline, poll)
+            return client.put_new(-1, dkey, decision)
         if time.monotonic() > deadline:
             raise AgreementError(f"agreement {instance} timed out at rank {me}")
-        # park on the believed coordinator's decision key with ONE
-        # server-side waiting get instead of busy-rescanning n keys every
-        # poll interval (O(n^2) RPC load across the job otherwise); fall
-        # back to the scan when the wait expires or the coordinator changes
-        client = getattr(rte, "client", None)
-        if client is not None:
-            try:
-                got = client.get(coord, _key(instance, f"d{coord}"),
-                                 wait=True, timeout=0.5)
-            except Exception:
-                got = None
-            if got is not None:
-                return got
-        else:
-            time.sleep(poll)
+        # park on the decision slot with ONE server-side waiting get
+        # instead of busy-polling (O(n^2) RPC load across the job otherwise)
+        try:
+            got = client.get(-1, dkey, wait=True, timeout=0.5)
+        except Exception:
+            got = None
+        if got is not None:
+            return got
 
 
 def _decide(rte, instance, participants, combine, deadline, poll):

@@ -60,6 +60,10 @@ class Detector:
             self.client.put(self.rte.my_world_rank, "hb_final", True)
         except Exception:
             pass
+        try:
+            self.client.close()
+        except Exception:
+            pass
 
     # -- internals -------------------------------------------------------
     def _emitter_of(self) -> int:

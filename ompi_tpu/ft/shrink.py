@@ -42,9 +42,12 @@ def shrink(comm):
             (_bits(members, ft_state.failed_ranks()), proposed),
             live,
             combine,
+            prev_instance=(("shrink", comm.cid, comm.epoch, seq - 2)
+                           if seq > 2 else None),
         )
         dead = {r for r in agreed_failed} | _unbits(members, failed_bits)
         survivors = [r for r in members if r not in dead]
+        cid = rt.adopt_cid(proposed, cid)
 
     rt.reserve_cid(cid)
     newcomm = Comm(Group(survivors), cid, comm.rte,

@@ -35,14 +35,17 @@ class FtAgreeModule:
         # of the uniform decision (comm_agree.c group-fault sync) — all
         # survivors raise ProcFailedError or none do, never a mix
         acked = getattr(comm, "_acked_failed", frozenset())
-        my_unacked = any(r in ft_state.failed_ranks() and r not in acked
+        known_failed = ft_state.failed_ranks()
+        my_unacked = any(r in known_failed and r not in acked
                          for r in members)
         (agreed_flag, agreed_failed, any_unacked), _ = agree_kv(
             comm.rte,
             ("agree", comm.cid, comm.epoch, seq),
-            (int(flag), frozenset(ft_state.failed_ranks()), my_unacked),
+            (int(flag), known_failed, my_unacked),
             live,
             lambda a, b: (a[0] & b[0], a[1] | b[1], a[2] or b[2]),
+            prev_instance=(("agree", comm.cid, comm.epoch, seq - 2)
+                           if seq > 2 else None),
         )
         if any_unacked:
             in_group_failed = [r for r in members if r in agreed_failed]

@@ -1,0 +1,42 @@
+"""osc — one-sided communication framework (``/root/reference/ompi/mca/osc/``).
+
+Components are selected per *window*, the way the reference queries
+osc components at ``MPI_Win_create`` (``osc_base_init.c``):
+
+- ``pt2pt`` — active-message RMA over the p2p engine with a per-process
+  servicing agent (the re-design of ``osc/rdma``'s AM fallback path,
+  ``osc_rdma_accumulate.c:26-71``; true passive-target progress comes from
+  the agent thread, which the reference approximates with opal_progress).
+- ``local`` — single-controller/device-world windows where every rank's
+  exposure region lives in this process.
+"""
+from __future__ import annotations
+
+from ompi_tpu.base import mca
+
+
+def osc_framework() -> mca.Framework:
+    return mca.framework("osc", "one-sided communication", multi_select=True)
+
+
+def win_select(win) -> None:
+    """Pick the highest-priority osc component claiming this window."""
+    fw = osc_framework()
+    best = None
+    for comp in fw.select_all():
+        query = getattr(comp, "win_query", None)
+        if query is None:
+            continue
+        res = query(win)
+        if res is None:
+            continue
+        priority, module = res
+        if best is None or priority > best[0]:
+            best = (priority, module)
+    if best is None:
+        from ompi_tpu.api.errors import ErrorClass, MpiError
+
+        raise MpiError(ErrorClass.ERR_WIN,
+                       "no osc component available for this window")
+    win.module = best[1]
+    win.module.attach(win)

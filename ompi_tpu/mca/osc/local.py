@@ -1,0 +1,97 @@
+"""osc/local — windows in the single-controller models.
+
+Counterpart of ``osc/sm`` (``/root/reference/ompi/mca/osc/sm/``): when every
+rank's exposure region lives in one address space (the device-world
+conductor model, or COMM_SELF), RMA is direct memory access.  Each facade
+rank registers its base array in a shared per-window table; ops index the
+table and apply immediately; all synchronization collapses to no-ops (there
+is one thread of control, so epochs are trivially ordered).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+
+
+class LocalModule:
+    def attach(self, win) -> None:
+        # one region per rank, all hosted here (conductor model)
+        self._bases = {r: (np.array(win.local, copy=True) if r != win.rank
+                           else win.local)
+                       for r in range(win.size)}
+
+    def detach(self, win) -> None:
+        self._bases.clear()
+
+    def base_of(self, rank: int) -> np.ndarray:
+        return self._bases[rank]
+
+    # -- ops -------------------------------------------------------------
+    def put(self, win, arr, target: int, offset: int) -> None:
+        self._bases[target][offset:offset + arr.size] = arr
+
+    def get(self, win, count: int, target: int, offset: int) -> np.ndarray:
+        return np.array(self._bases[target][offset:offset + count], copy=True)
+
+    def accumulate(self, win, arr, target: int, offset: int, op) -> None:
+        view = self._bases[target][offset:offset + arr.size]
+        op(arr.astype(view.dtype, copy=False), view)
+
+    def get_accumulate(self, win, arr, target: int, offset: int,
+                       op) -> np.ndarray:
+        old = self.get(win, arr.size, target, offset)
+        self.accumulate(win, arr, target, offset, op)
+        return old
+
+    def compare_and_swap(self, win, value, compare, target: int, offset: int):
+        base = self._bases[target]
+        old = base[offset]
+        if old == compare:
+            base[offset] = value
+        return old
+
+    # -- sync: single thread of control, all trivially ordered ----------
+    def flush(self, win, target: int) -> None:
+        pass
+
+    def fence(self, win) -> None:
+        pass
+
+    def lock(self, win, target: int, lock_type: str) -> None:
+        pass
+
+    def unlock(self, win, target: int) -> None:
+        pass
+
+    def post(self, win, group) -> None:
+        pass
+
+    def start(self, win, group) -> None:
+        pass
+
+    def complete(self, win) -> None:
+        pass
+
+    def wait(self, win) -> None:
+        pass
+
+
+class LocalComponent(Component):
+    name = "local"
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=80,
+            help="Selection priority of osc/local")
+
+    def win_query(self, win):
+        if (win.comm.rte is not None and win.comm.rte.is_device_world) \
+                or win.comm.size == 1:
+            return self._prio.value, LocalModule()
+        return None
+
+
+COMPONENT = LocalComponent()

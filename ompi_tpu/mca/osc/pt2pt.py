@@ -1,0 +1,330 @@
+"""osc/pt2pt — active-message RMA over the p2p engine.
+
+Re-design of the reference's one-sided engine (``ompi/mca/osc/rdma/`` with
+its BTL active-message fallback, ``osc_rdma_accumulate.c:26-71`` lock-and-
+apply path): every process runs one *exposure agent* thread per window,
+serving PUT/GET/ACC/GACC/CAS requests and the passive-target lock protocol
+on the window's private communicator.  Where the reference gets target-side
+progress only when the target enters the MPI library (opal_progress), the
+agent thread gives true passive-target progress — the honest equivalent of
+hardware RDMA on the host path.  Completion semantics lean on ob1's
+per-(source,tag) ordering: requests from one origin are applied in issue
+order, so a FLUSH round-trip implies all earlier ops from that origin are
+target-complete (the reference's osc_rdma "frag flush + local completion"
+argument, inverted for AM).
+
+Protocol (all on the window's dup'd comm):
+  REQ_TAG:    pickled request dicts origin→target (fire-and-forget for
+              PUT/ACC; round-trip for GET/GACC/CAS/LOCK/FLUSH via a
+              per-request reply tag)
+  reply tags: REPLY_BASE - seq, unique per outstanding request per origin
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.status import ANY_SOURCE
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+
+REQ_TAG = -(1 << 22)
+REPLY_BASE = -(1 << 22) - 16
+_REPLY_SPACE = 1 << 20
+
+
+# wire format = Comm.send_obj/recv_obj (pickled payload behind an int64
+# size header, both on one tag); only the agent's improbe-based header
+# read needs custom code (it must not block on a specific source)
+def _send_req(comm, dest: int, req: dict) -> None:
+    comm.send_obj(req, dest, REQ_TAG)
+
+
+def _send_reply(comm, dest: int, tag: int, obj) -> None:
+    comm.send_obj(obj, dest, tag)
+
+
+def _recv_reply(comm, source: int, tag: int):
+    return comm.recv_obj(source, tag)
+
+
+class _LockState:
+    """Per-window target-side reader/writer lock with FIFO fairness."""
+
+    def __init__(self) -> None:
+        self.mode: Optional[str] = None  # None | "exclusive" | "shared"
+        self.holders: set[int] = set()
+        self.queue: deque = deque()      # (origin, reply_tag, lock_type)
+
+    def try_grant(self, origin: int, reply_tag: int, lock_type: str) -> bool:
+        if self.mode is None:
+            self.mode = lock_type
+            self.holders.add(origin)
+            return True
+        if self.mode == "shared" and lock_type == "shared" and not self.queue:
+            # no writer waiting: shared locks pile in (FIFO fairness:
+            # a queued exclusive blocks later shared acquisitions)
+            self.holders.add(origin)
+            return True
+        self.queue.append((origin, reply_tag, lock_type))
+        return False
+
+    def release(self, origin: int) -> list[tuple[int, int]]:
+        """Drop ``origin``'s hold; return [(origin, reply_tag)] to grant."""
+        self.holders.discard(origin)
+        granted = []
+        if self.holders:
+            return granted
+        self.mode = None
+        while self.queue:
+            o, rt, lt = self.queue[0]
+            if self.mode is None:
+                self.mode = lt
+                self.holders.add(o)
+                granted.append((o, rt))
+                self.queue.popleft()
+            elif self.mode == "shared" and lt == "shared":
+                self.holders.add(o)
+                granted.append((o, rt))
+                self.queue.popleft()
+            else:
+                break
+        return granted
+
+
+class Pt2ptModule:
+    """One module instance per window (state is per-window)."""
+
+    def __init__(self) -> None:
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._agent: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # target-side state
+        self._locks = _LockState()
+        self._posts: set[int] = set()          # PSCW: who posted to me
+        self._completes: set[int] = set()      # PSCW: who completed to me
+        self._pscw_cond = threading.Condition()
+        self._start_group: Optional[list] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def attach(self, win) -> None:
+        self._win = win
+        self._agent = threading.Thread(
+            target=self._serve, args=(win,),
+            name=f"otpu-osc-{win.name}", daemon=True)
+        self._agent.start()
+
+    def detach(self, win) -> None:
+        self._stop.set()
+        if self._agent is not None:
+            self._agent.join(timeout=10)
+
+    def _next_reply_tag(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return REPLY_BASE - (self._seq % _REPLY_SPACE)
+
+    # -- origin side -----------------------------------------------------
+    def put(self, win, arr, target: int, offset: int) -> None:
+        _send_req(win.comm, target,
+                  {"kind": "put", "off": offset, "data": arr})
+
+    def get(self, win, count: int, target: int, offset: int) -> np.ndarray:
+        rt = self._next_reply_tag()
+        _send_req(win.comm, target,
+                  {"kind": "get", "off": offset, "count": count, "rt": rt})
+        return _recv_reply(win.comm, target, rt)
+
+    def accumulate(self, win, arr, target: int, offset: int, op) -> None:
+        _send_req(win.comm, target,
+                  {"kind": "acc", "off": offset, "data": arr, "op": op.name})
+
+    def get_accumulate(self, win, arr, target: int, offset: int,
+                       op) -> np.ndarray:
+        rt = self._next_reply_tag()
+        _send_req(win.comm, target,
+                  {"kind": "gacc", "off": offset, "data": arr,
+                   "op": op.name, "rt": rt})
+        return _recv_reply(win.comm, target, rt)
+
+    def compare_and_swap(self, win, value, compare, target: int, offset: int):
+        rt = self._next_reply_tag()
+        _send_req(win.comm, target,
+                  {"kind": "cas", "off": offset, "value": value,
+                   "compare": compare, "rt": rt})
+        return _recv_reply(win.comm, target, rt)
+
+    def flush(self, win, target: int) -> None:
+        rt = self._next_reply_tag()
+        _send_req(win.comm, target, {"kind": "flush", "rt": rt})
+        _recv_reply(win.comm, target, rt)
+
+    def fence(self, win) -> None:
+        # close epoch: everything I issued is target-complete, then sync
+        for t in range(win.size):
+            self.flush(win, t)
+        win.comm.barrier()
+
+    def lock(self, win, target: int, lock_type: str) -> None:
+        rt = self._next_reply_tag()
+        _send_req(win.comm, target,
+                  {"kind": "lock", "type": lock_type, "rt": rt})
+        _recv_reply(win.comm, target, rt)  # blocks until granted
+
+    def unlock(self, win, target: int) -> None:
+        # flush-then-release in one round trip: the UNLOCK ack arrives
+        # after all prior ops from this origin were applied (FIFO order)
+        rt = self._next_reply_tag()
+        _send_req(win.comm, target, {"kind": "unlock", "rt": rt})
+        _recv_reply(win.comm, target, rt)
+
+    # PSCW --------------------------------------------------------------
+    def post(self, win, group) -> None:
+        """Expose my window to the access group (MPI_Win_post)."""
+        self._post_group = [win.comm.group.rank_of(r)
+                            for r in group.world_ranks]
+        for t in self._post_group:
+            _send_req(win.comm, t, {"kind": "post"})
+
+    def start(self, win, group) -> None:
+        """Open an access epoch: wait for every target's post."""
+        targets = [win.comm.group.rank_of(r) for r in group.world_ranks]
+        self._start_group = targets
+        with self._pscw_cond:
+            while not all(t in self._posts for t in targets):
+                self._pscw_cond.wait(0.05)
+                if self._stop.is_set():
+                    return
+            for t in targets:
+                self._posts.discard(t)
+
+    def complete(self, win) -> None:
+        """Close the access epoch (MPI_Win_complete)."""
+        targets = self._start_group or []
+        for t in targets:
+            self.flush(win, t)
+            _send_req(win.comm, t, {"kind": "complete"})
+        self._start_group = None
+
+    def wait(self, win) -> None:
+        """Close the exposure epoch: wait for every access-group member's
+        complete (MPI_Win_wait)."""
+        starters = getattr(self, "_post_group", [])
+        with self._pscw_cond:
+            while not all(s in self._completes for s in starters):
+                self._pscw_cond.wait(0.05)
+                if self._stop.is_set():
+                    return
+            for s in starters:
+                self._completes.discard(s)
+        self._post_group = []
+
+    # -- target side (the exposure agent) --------------------------------
+    def _serve(self, win) -> None:
+        from ompi_tpu.runtime.progress import progress
+
+        comm = win.comm
+        hdr = np.zeros(1, dtype=np.int64)
+        while not self._stop.is_set():
+            try:
+                # the agent IS the passive-target progress thread: pump the
+                # progress engine so transport frags reach the matching
+                # engine even while the app thread is outside the library
+                progress()
+                ok, msg = comm.improbe(ANY_SOURCE, REQ_TAG)
+            except Exception:
+                return  # runtime finalizing under us
+            if not ok:
+                time.sleep(0.0005)
+                continue
+            try:
+                st = msg.recv(hdr)
+                payload = np.zeros(int(hdr[0]), dtype=np.uint8)
+                comm.recv(payload, st.source, REQ_TAG)
+                self._handle(win, st.source, pickle.loads(payload.tobytes()))
+            except Exception:
+                if self._stop.is_set():
+                    return
+                from ompi_tpu.base import output as _o
+
+                import traceback
+
+                _o.output(0, 0, "osc agent error: %s",
+                          traceback.format_exc(limit=3))
+
+    def _handle(self, win, source: int, req: dict) -> None:
+        kind = req["kind"]
+        base = win.local
+        if kind == "put":
+            data = req["data"]
+            base[req["off"]:req["off"] + data.size] = data
+        elif kind == "get":
+            out = np.array(
+                base[req["off"]:req["off"] + req["count"]], copy=True)
+            _send_reply(win.comm, source, req["rt"], out)
+        elif kind == "acc":
+            self._apply(base, req["off"], req["data"], req["op"])
+        elif kind == "gacc":
+            old = np.array(
+                base[req["off"]:req["off"] + req["data"].size], copy=True)
+            self._apply(base, req["off"], req["data"], req["op"])
+            _send_reply(win.comm, source, req["rt"], old)
+        elif kind == "cas":
+            old = base[req["off"]]
+            if old == req["compare"]:
+                base[req["off"]] = req["value"]
+            _send_reply(win.comm, source, req["rt"], old)
+        elif kind == "flush":
+            _send_reply(win.comm, source, req["rt"], True)
+        elif kind == "lock":
+            if self._locks.try_grant(source, req["rt"], req["type"]):
+                _send_reply(win.comm, source, req["rt"], True)
+        elif kind == "unlock":
+            granted = self._locks.release(source)
+            _send_reply(win.comm, source, req["rt"], True)
+            for origin, rtag in granted:
+                _send_reply(win.comm, origin, rtag, True)
+        elif kind == "post":
+            with self._pscw_cond:
+                self._posts.add(source)
+                self._pscw_cond.notify_all()
+        elif kind == "complete":
+            with self._pscw_cond:
+                self._completes.add(source)
+                self._pscw_cond.notify_all()
+        else:
+            raise MpiError(ErrorClass.ERR_RMA_SYNC,
+                           f"unknown RMA request {kind!r}")
+
+    @staticmethod
+    def _apply(base: np.ndarray, off: int, data: np.ndarray,
+               op_name: str) -> None:
+        op = getattr(op_mod, op_name)
+        view = base[off:off + data.size]
+        op(data.astype(base.dtype, copy=False), view)
+
+
+class Pt2ptComponent(Component):
+    name = "pt2pt"
+    priority = 50
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=50,
+            help="Selection priority of osc/pt2pt")
+
+    def win_query(self, win):
+        if win.comm.rte is None or win.comm.rte.is_device_world:
+            return None
+        return self._prio.value, Pt2ptModule()
+
+
+COMPONENT = Pt2ptComponent()

@@ -123,6 +123,14 @@ class Ob1Pml:
             for r in comm.group.world_ranks:
                 self._match.setdefault((comm.cid, r), _MatchState())
 
+    def del_comm(self, comm) -> None:
+        """Drop per-comm matching state (``MPI_Comm_free`` teardown)."""
+        with self._lock:
+            for key in [k for k in self._match if k[0] == comm.cid]:
+                del self._match[key]
+            for key in [k for k in self._seq if k[0] == comm.cid]:
+                del self._seq[key]
+
     def finalize(self) -> None:
         self.bml.finalize()
 

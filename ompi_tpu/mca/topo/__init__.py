@@ -1,0 +1,14 @@
+"""topo — process topologies (``/root/reference/ompi/mca/topo/``).
+
+Cartesian, graph, and distributed-graph topologies attached to
+communicators, plus the rank-reordering hook (the reference's
+``topo/treematch`` maps ranks onto the hardware tree; TPU-native, the
+equivalent is mapping a cartesian grid onto the ICI device mesh so cart
+neighbors are one ICI hop apart).
+"""
+from __future__ import annotations
+
+from ompi_tpu.mca.topo.base import (CartTopo, DistGraphTopo, GraphTopo,
+                                    dims_create)
+
+__all__ = ["CartTopo", "GraphTopo", "DistGraphTopo", "dims_create"]

@@ -87,6 +87,20 @@ class CoordServer:
                         self._kv[(req["rank"], req["key"])] = req["value"]
                         self._kv_cond.notify_all()
                     _send_frame(conn, {"ok": True})
+                elif op == "del":
+                    with self._kv_cond:
+                        self._kv.pop((req["rank"], req["key"]), None)
+                    _send_frame(conn, {"ok": True})
+                elif op == "put_new":
+                    # atomic put-if-absent: first writer wins, everyone gets
+                    # the winning value back (consensus decision slots)
+                    with self._kv_cond:
+                        k = (req["rank"], req["key"])
+                        if k not in self._kv:
+                            self._kv[k] = req["value"]
+                            self._kv_cond.notify_all()
+                        val = self._kv[k]
+                    _send_frame(conn, {"ok": True, "value": val})
                 elif op == "get":
                     deadline = time.monotonic() + req.get("timeout", 60.0)
                     with self._kv_cond:
@@ -202,6 +216,14 @@ class CoordClient:
 
     def put(self, rank: int, key: str, value: Any) -> None:
         self._rpc(op="put", rank=rank, key=key, value=value)
+
+    def put_new(self, rank: int, key: str, value: Any) -> Any:
+        """Atomic put-if-absent; returns the winning (stored) value."""
+        return self._rpc(op="put_new", rank=rank, key=key,
+                         value=value)["value"]
+
+    def delete(self, rank: int, key: str) -> None:
+        self._rpc(op="del", rank=rank, key=key)
 
     def get(self, rank: int, key: str, wait: bool = True,
             timeout: float = 60.0) -> Any:

@@ -63,6 +63,15 @@ def release_cid(cid: int) -> None:
         _cid_map.clear(cid)
 
 
+def adopt_cid(proposed: int, agreed: int) -> int:
+    """Adopt the group-agreed CID: release the losing local proposal
+    (returned to the pool) and reserve the winner."""
+    if agreed != proposed:
+        release_cid(proposed)
+    reserve_cid(agreed)
+    return agreed
+
+
 # -- init / finalize ----------------------------------------------------
 
 def init(devices=None, rte=None, argv: Optional[list] = None):
