@@ -1,0 +1,63 @@
+"""Device-mesh construction for the (dp, pp, sp, tp) axis set."""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+AXES = ("dp", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def n(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp
+
+    def sizes(self) -> dict:
+        return {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp}
+
+
+def _prime_factors(n: int) -> list:
+    fs, d = [], 2
+    while d * d <= n:
+        while n % d == 0:
+            fs.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        fs.append(n)
+    return fs
+
+
+def default_axis_sizes(n_devices: int) -> MeshSpec:
+    """Deterministically factor a device count over (tp, sp, dp[, pp]).
+
+    Model-parallel axes want the fastest links, so tp and sp claim factors
+    first (they ride ICI neighbours in a real torus); pp only activates at
+    >=16 devices, mirroring how pipeline stages only pay off across hosts.
+    """
+    sizes = {"dp": 1, "pp": 1, "sp": 1, "tp": 1}
+    order = ["tp", "sp", "dp", "pp"] if n_devices >= 16 else ["tp", "sp", "dp"]
+    for i, f in enumerate(_prime_factors(n_devices)):
+        sizes[order[i % len(order)]] *= f
+    return MeshSpec(**sizes)
+
+
+def make_mesh(devices, spec: MeshSpec = None):
+    """Build a jax Mesh with axes (dp, pp, sp, tp) over the given devices."""
+    from jax.sharding import Mesh
+
+    devices = list(devices)
+    if spec is None:
+        spec = default_axis_sizes(len(devices))
+    if spec.n != len(devices):
+        raise ValueError(f"mesh spec {spec} needs {spec.n} devices, "
+                         f"got {len(devices)}")
+    grid = np.array(devices).reshape(spec.dp, spec.pp, spec.sp, spec.tp)
+    return Mesh(grid, AXES), spec

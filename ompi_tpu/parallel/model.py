@@ -1,0 +1,143 @@
+"""Per-device transformer block bodies with explicit mesh collectives.
+
+These run *inside* ``shard_map`` — the MPI-flavoured explicit-SPMD style:
+every cross-device exchange is a named collective on a mesh axis, the
+device-side mirror of the reference's coll algorithms (ring allreduce
+``coll_base_allreduce.c:341``, pairwise alltoall ``coll_base_alltoall.c``,
+binomial pipelines) rather than GSPMD auto-propagation.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x, eps: float = 1e-6):
+    return x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+
+
+def ring_attention(q, k, v, axis: str, n_shards: int):
+    """Flash-style ring attention over the sequence-parallel axis.
+
+    q/k/v local: (b, h_local, s_local, hd).  K/V blocks rotate around the
+    ``axis`` ring via ``ppermute`` (the CP/ring-attention neighbor exchange,
+    SURVEY.md §2.6) while the numerator/denominator accumulate with the
+    running-max rescaling, so memory stays O(s_local) regardless of the
+    global sequence length — long context is a first-class mesh axis.
+    """
+    hd = q.shape[-1]
+    scale = 1.0 / math.sqrt(hd)
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
+    num0 = jnp.zeros_like(q)
+    den0 = jnp.zeros(q.shape[:-1], q.dtype)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(carry, _):
+        k_blk, v_blk, m, num, den = carry
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        new_m = jnp.maximum(m, s.max(axis=-1))
+        c = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m[..., None])
+        num = num * c[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        den = den * c + p.sum(axis=-1)
+        if n_shards > 1:
+            k_blk = jax.lax.ppermute(k_blk, axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, new_m, num, den), None
+
+    (_, _, _, num, den), _ = jax.lax.scan(
+        body, (k, v, m0, num0, den0), None, length=n_shards)
+    return num / den[..., None]
+
+
+def attention_block(p, x, *, sp: int, tp: int, n_heads_local: int):
+    """Ring attention with tp-sharded heads; psum-combined output proj.
+
+    x local: (b, s_local, d) replicated over tp.  Head projections are
+    column-sharded over tp (h_local = H/tp); the output projection is
+    row-sharded, so its partial products combine with a ``psum`` over tp —
+    the tensor-parallel allreduce (DP/TP table row, SURVEY.md §2.6).
+    """
+    b, s_l, d = x.shape
+    h = rmsnorm(x)
+
+    def heads(w):
+        y = h @ w  # (b, s_l, h_local*hd)
+        return y.reshape(b, s_l, n_heads_local, -1).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(p["wq"]), heads(p["wk"]), heads(p["wv"])
+    o = ring_attention(q, k, v, "sp", sp)           # (b, h_l, s_l, hd)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s_l, -1)  # (b, s_l, h_l*hd)
+    o = o @ p["wo"]
+    if tp > 1:
+        o = jax.lax.psum(o, "tp")
+    return x + o
+
+
+def mlp_block(p, x, *, tp: int):
+    """Megatron-style tp MLP: column-shard w1, row-shard w2, psum combine."""
+    h = rmsnorm(x)
+    y = jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+    if tp > 1:
+        y = jax.lax.psum(y, "tp")
+    return x + y
+
+
+def moe_block(p, x, *, tp: int, n_experts: int, capacity: int):
+    """Top-1 MoE with experts sharded over tp (the ep axis) via all_to_all.
+
+    Local tokens are chunked over tp (each tp shard routes its slice),
+    dispatched to expert-home shards with ``all_to_all`` (the MoE dispatch
+    ≅ pairwise alltoall, SURVEY.md §2.6 EP row), processed by the local
+    expert FFNs, returned by the inverse all_to_all, and the chunks
+    re-replicated with ``all_gather``.  Static capacity per (expert,
+    source-shard); overflow tokens fall through on the residual path.
+    """
+    b, s_l, d = x.shape
+    xf = rmsnorm(x).reshape(b * s_l, d)
+    t = xf.shape[0]
+    tc = t // tp
+    e_l = n_experts // tp
+    r = jax.lax.axis_index("tp") if tp > 1 else 0
+    chunk = jax.lax.dynamic_slice_in_dim(xf, r * tc, tc, 0)  # (tc, d)
+
+    logits = chunk @ p["wr"]                        # (tc, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    eid = jnp.argmax(probs, axis=-1)                # (tc,)
+    oh = jax.nn.one_hot(eid, n_experts, dtype=xf.dtype)          # (tc, E)
+    pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh                    # (tc, E)
+    keep = oh * (pos < capacity)
+    pos_oh = jax.nn.one_hot(
+        jnp.clip(pos.astype(jnp.int32), 0, capacity - 1), capacity,
+        dtype=xf.dtype)                                          # (tc, E, cap)
+    disp = keep[..., None] * pos_oh                              # (tc, E, cap)
+
+    ex_in = jnp.einsum("tec,td->ecd", disp, chunk)   # (E, cap, d)
+    ex_in = ex_in.reshape(tp, e_l, capacity, d)
+    if tp > 1:
+        ex_in = jax.lax.all_to_all(ex_in, "tp", split_axis=0, concat_axis=0)
+    # (tp, e_l, cap, d): leading dim is now source shard
+    ex_in = ex_in.transpose(1, 0, 2, 3).reshape(e_l, tp * capacity, d)
+    hid = jax.nn.gelu(jnp.einsum("etd,edf->etf", ex_in, p["we1"]))
+    ex_out = jnp.einsum("etf,efd->etd", hid, p["we2"])
+    ex_out = ex_out.reshape(e_l, tp, capacity, d).transpose(1, 0, 2, 3)
+    if tp > 1:
+        ex_out = jax.lax.all_to_all(ex_out, "tp", split_axis=0, concat_axis=0)
+    ex_out = ex_out.reshape(n_experts, capacity, d)
+
+    gate = jnp.einsum("tec,te->t", disp, probs)      # kept-assignment prob
+    out_chunk = jnp.einsum("tec,ecd->td", disp, ex_out) * gate[:, None]
+    if tp > 1:
+        out = jax.lax.all_gather(out_chunk, "tp", axis=0, tiled=True)  # (t, d)
+    else:
+        out = out_chunk
+    return x + out.reshape(b, s_l, d)
+
+
+def transformer_block(p, x, *, sp, tp, n_heads_local, n_experts, capacity):
+    x = attention_block(p, x, sp=sp, tp=tp, n_heads_local=n_heads_local)
+    x = mlp_block(p, x, tp=tp)
+    x = moe_block(p, x, tp=tp, n_experts=n_experts, capacity=capacity)
+    return x

@@ -1,0 +1,131 @@
+"""The flagship training step: dp × pp × sp × tp(+ep) in one shard_map.
+
+Assembles the explicit-SPMD transformer (model.py) and pipeline
+(pipeline.py) into a jitted train step over a 4-axis mesh:
+
+- activations sharded (dp: batch, sp: sequence), weights sharded (pp:
+  layers, tp: hidden/heads/experts)
+- grad sync = ``psum`` over (dp, sp) — the DP allreduce
+  (≅ ``coll_base_allreduce.c`` ring; SURVEY.md §2.6)
+- loss reduced across the pipeline with a pp-masked psum
+
+Model dims are *derived from the mesh spec* so every axis size divides its
+tensor dims — the driver's ``dryrun_multichip`` runs this for arbitrary
+device counts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.parallel.mesh import MeshSpec
+from ompi_tpu.parallel.model import transformer_block
+from ompi_tpu.parallel.pipeline import pipeline_apply
+
+
+def model_dims(spec: MeshSpec) -> dict:
+    tp, sp, dp, pp = spec.tp, spec.sp, spec.dp, spec.pp
+    d = 8
+    hd = 4
+    n_heads = 2 * tp
+    ff = 8 * tp
+    n_experts = 2 * tp
+    ffe = 4
+    s_local = 4
+    M = 2                      # microbatches
+    mb = tp                    # microbatch rows per device (keeps MoE even)
+    t_local = mb * s_local     # MoE tokens per device per microbatch
+    cap = max(1, (t_local // tp) // n_experts * 2)
+    return dict(
+        d=d, hd=hd, n_heads=n_heads, h_local=n_heads // tp, ff=ff,
+        n_experts=n_experts, ffe=ffe, seq=s_local * sp, s_local=s_local,
+        M=M, mb=mb, batch=mb * M * dp, b_local=mb * M, capacity=cap,
+        layers=pp, layers_local=1,
+    )
+
+
+def init_params(spec: MeshSpec, seed: int = 0) -> dict:
+    dims = model_dims(spec)
+    rng = np.random.RandomState(seed)
+    d, L = dims["d"], dims["layers"]
+    hh = dims["n_heads"] * dims["hd"]
+
+    def w(*shape):
+        return rng.normal(0, 0.5 / np.sqrt(shape[-2]), shape).astype(
+            np.float32)
+
+    return {
+        "wq": w(L, d, hh), "wk": w(L, d, hh), "wv": w(L, d, hh),
+        "wo": w(L, hh, d),
+        "w1": w(L, d, dims["ff"]), "w2": w(L, dims["ff"], d),
+        "wr": w(L, d, dims["n_experts"]),
+        "we1": w(L, dims["n_experts"], d, dims["ffe"]),
+        "we2": w(L, dims["n_experts"], dims["ffe"], d),
+    }
+
+
+def param_specs(P) -> dict:
+    return {
+        "wq": P("pp", None, "tp"), "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"), "wo": P("pp", "tp", None),
+        "w1": P("pp", None, "tp"), "w2": P("pp", "tp", None),
+        "wr": P("pp", None, None),
+        "we1": P("pp", "tp", None, None), "we2": P("pp", "tp", None, None),
+    }
+
+
+def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4):
+    """Return (jitted_step, place) where step(params, x) -> (params, loss).
+
+    ``place(params, x_np)`` device_puts globals with the right shardings.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dims = model_dims(spec)
+    tp, sp_n, pp = spec.tp, spec.sp, spec.pp
+    M, mb, s_l, d = dims["M"], dims["mb"], dims["s_local"], dims["d"]
+
+    def stage_fn(stage_params, x_mb):
+        for i in range(dims["layers_local"]):
+            layer = jax.tree.map(lambda a: a[i], stage_params)
+            x_mb = transformer_block(
+                layer, x_mb, sp=sp_n, tp=tp,
+                n_heads_local=dims["h_local"],
+                n_experts=dims["n_experts"], capacity=dims["capacity"])
+        return x_mb
+
+    def body(params, x):
+        def loss_fn(ps):
+            xmb = x.reshape(M, mb, s_l, d)
+            y = pipeline_apply(stage_fn, ps, xmb, pp=pp)
+            # pipeline_apply outputs are zero off the last pp stage, so the
+            # psum over pp collects exactly the last stage's loss
+            local = 0.5 * jnp.sum(y * y)
+            return jax.lax.psum(local, ("dp", "pp", "sp"))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, ("dp", "sp")), grads)
+        if tp > 1:
+            grads["wr"] = jax.lax.psum(grads["wr"], "tp")
+        new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new, loss
+
+    pspecs = param_specs(P)
+    step = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(pspecs, P("dp", "sp", None)),
+        out_specs=(pspecs, P()),
+        check_vma=False))
+
+    def place(params, x_np):
+        p = {k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
+             for k, v in params.items()}
+        x = jax.device_put(
+            np.asarray(x_np, np.float32),
+            NamedSharding(mesh, P("dp", "sp", None)))
+        return p, x
+
+    return step, place

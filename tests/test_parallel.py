@@ -1,0 +1,88 @@
+"""ompi_tpu.parallel: mesh factoring, ring attention, MoE, pipeline, train.
+
+Numerical references are single-device jnp computations; the parallel
+versions must match them exactly (same math, different schedule) — the
+analog of the reference's coll algorithm-vs-basic cross-checks.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ompi_tpu.parallel.mesh import MeshSpec, default_axis_sizes, make_mesh
+from ompi_tpu.parallel.model import ring_attention
+from ompi_tpu.parallel.pipeline import pipeline_apply
+from ompi_tpu.parallel.train import build_train_step, init_params, model_dims
+
+
+def test_default_axis_sizes():
+    assert default_axis_sizes(8) == MeshSpec(dp=2, pp=1, sp=2, tp=2)
+    assert default_axis_sizes(16) == MeshSpec(dp=2, pp=2, sp=2, tp=2)
+    assert default_axis_sizes(1) == MeshSpec()
+    assert default_axis_sizes(4).n == 4
+    assert default_axis_sizes(12).n == 12
+
+
+def _ref_attention(q, k, v):
+    # q,k,v: (b, h, s, hd) global — plain softmax attention
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_ring_attention_matches_dense():
+    n_sp = 4
+    mesh = Mesh(np.array(jax.devices()[:n_sp]), ("sp",))
+    rng = np.random.RandomState(0)
+    b, h, s, hd = 2, 2, 8, 4
+    q, k, v = (rng.normal(0, 1, (b, h, s, hd)).astype(np.float32)
+               for _ in range(3))
+
+    fn = jax.jit(shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "sp", n_sp),
+        mesh=mesh, in_specs=P(None, None, "sp", None),
+        out_specs=P(None, None, "sp", None), check_vma=False))
+    out = fn(q, k, v)
+    np.testing.assert_allclose(out, _ref_attention(q, k, v),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_matches_sequential():
+    pp = 4
+    mesh = Mesh(np.array(jax.devices()[:pp]), ("pp",))
+    rng = np.random.RandomState(1)
+    M, mb, d = 3, 2, 4
+    x = rng.normal(0, 1, (M, mb, d)).astype(np.float32)
+    w = rng.normal(0, 0.5, (pp, d, d)).astype(np.float32)
+
+    def stage(wi, z):
+        return jnp.tanh(z @ wi[0])
+
+    fn = jax.jit(shard_map(
+        # outputs live on the last stage only; psum over pp collects them
+        lambda w_, x_: jax.lax.psum(pipeline_apply(stage, w_, x_, pp=pp),
+                                    "pp"),
+        mesh=mesh, in_specs=(P("pp", None, None), P()),
+        out_specs=P(), check_vma=False))
+    out = fn(w, x)
+
+    ref = x
+    for i in range(pp):
+        ref = jnp.tanh(ref @ w[i])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [1, 4, 8])
+def test_train_step_descends(n):
+    mesh, spec = make_mesh(jax.devices()[:n])
+    dims = model_dims(spec)
+    step, place = build_train_step(mesh, spec)
+    rng = np.random.RandomState(2)
+    x = rng.normal(0, 1, (dims["batch"], dims["seq"], dims["d"]))
+    params, xd = place(init_params(spec), x)
+    p1, l1 = step(params, xd)
+    _, l2 = step(p1, xd)
+    assert np.isfinite(float(l1))
+    assert float(l2) < float(l1)
