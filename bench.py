@@ -1,14 +1,22 @@
 #!/usr/bin/env python
-"""OSU-style allreduce benchmark (BASELINE.md config #3).
+"""OSU-style collective benchmark suite (BASELINE.md configs #1-#5).
 
-Measures bus bandwidth of the framework's MPI_Allreduce path (coll/xla →
-``lax.psum`` over the ICI mesh) on float32 payloads and compares it against
-raw hand-written ``jax.lax.psum`` — the ``vs_baseline`` ratio is framework
-bandwidth / raw-XLA bandwidth (north star: ≥0.8 at ≥4MB, BASELINE.json).
+Primary metric (the ONE printed JSON line, BASELINE.json config #3): bus
+bandwidth of the framework's MPI_Allreduce path (coll/xla → ``lax.psum``
+over the ICI mesh) at 16MB float32 vs raw hand-written ``jax.lax.psum`` —
+``vs_baseline`` = framework / raw (north star ≥0.8 at ≥4MB).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Also runs (written to BENCH_SWEEP.json + BENCH_SWEEP.md, not the JSON
+line):
+  - allreduce latency + bus-bw sweep 8B→256MB (OSU osu_allreduce protocol)
+  - bcast / allgather / reduce_scatter spot sizes (configs #4, #5)
+  - persistent-collective (MPI_Allreduce_init analog) datapoint
+  - 4-rank host-path ring smoke (config #1) when tpurun is runnable
+
+Set OTPU_BENCH_FAST=1 to skip everything but the primary metric.
 """
 import json
+import os
 import statistics
 import sys
 import time
@@ -17,14 +25,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def _bus_bw_gbs(nbytes: int, ndev: int, seconds: float) -> float:
-    # OSU bus-bandwidth convention for allreduce: 2*(n-1)/n * bytes moved
-    factor = 2.0 * (ndev - 1) / ndev if ndev > 1 else 1.0
-    return factor * nbytes / seconds / 1e9
+SWEEP_SIZES = (8, 4096, 262144, 4 << 20, 16 << 20, 64 << 20, 256 << 20)
+SPOT_SIZES = (4096, 4 << 20, 64 << 20)
+PRIMARY = 16 << 20
 
 
-def _time_fn(fn, arg, iters=20, warmup=3):
+def _bus_factor(coll: str, ndev: int) -> float:
+    # OSU bus-bandwidth conventions per collective
+    if ndev <= 1:
+        return 1.0
+    if coll in ("allreduce",):
+        return 2.0 * (ndev - 1) / ndev
+    return (ndev - 1) / ndev
+
+
+def _time_fn(fn, arg, iters=10, warmup=2):
     for _ in range(warmup):
         out = fn(arg)
     jax.block_until_ready(out)
@@ -37,57 +52,201 @@ def _time_fn(fn, arg, iters=20, warmup=3):
     return statistics.median(samples)
 
 
-def main() -> None:
-    devices = jax.devices()
-    ndev = len(devices)
-    nelem = (16 << 20) // 4  # 16 MB float32 per rank
-    mesh = jax.sharding.Mesh(np.array(devices), ("x",))
+class DeviceBench:
+    def __init__(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
 
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+        self.devices = jax.devices()
+        self.ndev = len(self.devices)
+        self.mesh = jax.sharding.Mesh(np.array(self.devices), ("x",))
+        self._P = P
+        self._sm = shard_map
 
-    @jax.jit
-    def raw_psum(x):
-        return shard_map(
-            lambda a: jax.lax.psum(a, "x"), mesh=mesh,
-            in_specs=P("x"), out_specs=P(),
-        )(x)
-
-    x = jnp.ones((ndev * nelem,), jnp.float32)
-    x = jax.device_put(
-        x, jax.sharding.NamedSharding(mesh, P("x")))
-    raw_t = _time_fn(raw_psum, x)
-    raw_bw = _bus_bw_gbs(nelem * 4, ndev, raw_t)
-
-    # Framework path: eager allreduce through the full stack (comm vtable →
-    # coll selection → coll/xla compiled program cache).
-    try:
         import ompi_tpu
         from ompi_tpu.mca.coll.xla import XlaCollModule
 
-        world = ompi_tpu.init()
-        xla_mod = next((m for m in world.coll_modules
-                        if isinstance(m, XlaCollModule)), None)
-        if xla_mod is None:
+        self.world = ompi_tpu.init()
+        self.xla_mod = next(
+            (m for m in self.world.coll_modules
+             if isinstance(m, XlaCollModule)), None)
+        if self.xla_mod is None:
             raise RuntimeError("coll/xla did not select on COMM_WORLD")
-        xd = xla_mod.make_world_array(
-            np.ones((world.size, nelem), np.float32))
-        fw_t = _time_fn(lambda a: world.allreduce_array(a), xd)
-        ompi_tpu.finalize()
-        fw_bw = _bus_bw_gbs(nelem * 4, ndev, fw_t)
-        value, vs = fw_bw, (fw_bw / raw_bw if raw_bw else 0.0)
+
+    def make(self, nbytes_per_rank: int):
+        nelem = max(1, nbytes_per_rank // 4)
+        return self.xla_mod.make_world_array(
+            np.ones((self.world.size, nelem), np.float32))
+
+    def raw_fn(self, coll: str):
+        P, sm = self._P, self._sm
+
+        bodies = {
+            "allreduce": lambda t: jax.lax.psum(t[0], "x"),
+            "bcast": lambda t: jax.lax.all_gather(t[0], "x")[0][None],
+            "allgather": lambda t: jax.lax.all_gather(t[0], "x"),
+        }
+        out_specs = {"allreduce": P(), "bcast": P("x"), "allgather": P()}
+        if coll == "reduce_scatter":
+            def body(t):  # (1, n*S) -> (1, S)
+                return jax.lax.psum_scatter(
+                    t[0].reshape(self.ndev, -1), "x",
+                    scatter_dimension=0, tiled=False)[None]
+            return jax.jit(sm(body, mesh=self.mesh, in_specs=P("x"),
+                              out_specs=P("x"), check_vma=False))
+        return jax.jit(sm(bodies[coll], mesh=self.mesh, in_specs=P("x"),
+                          out_specs=out_specs[coll], check_vma=False))
+
+    def fw_fn(self, coll: str):
+        w = self.world
+        if coll == "reduce_scatter":
+            # framework reduce_scatter wants (n, n, *S)
+            return lambda x: w.reduce_scatter_array(x)
+        return {
+            "allreduce": lambda x: w.allreduce_array(x),
+            "bcast": lambda x: w.bcast_array(x),
+            "allgather": lambda x: w.allgather_array(x),
+        }[coll]
+
+    def point(self, coll: str, nbytes: int, iters: int = 10) -> dict:
+        if coll == "reduce_scatter":
+            # (n, n, S): each rank contributes n blocks of nbytes/n
+            nelem = max(self.ndev, nbytes // 4 // self.ndev * self.ndev)
+            x = self.xla_mod.make_world_array(np.ones(
+                (self.world.size, self.ndev, nelem // self.ndev),
+                np.float32))
+            xr = self.make(nbytes)
+        else:
+            x = xr = self.make(nbytes)
+        # interleave fw/raw samples so tunnel/clock drift cancels
+        fw, raw = self.fw_fn(coll), self.raw_fn(coll)
+        for _ in range(2):
+            out = fw(x)
+            out2 = raw(xr)
+        jax.block_until_ready((out, out2))
+        fw_s, raw_s = [], []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fw(x))
+            t1 = time.perf_counter()
+            jax.block_until_ready(raw(xr))
+            t2 = time.perf_counter()
+            fw_s.append(t1 - t0)
+            raw_s.append(t2 - t1)
+        fw_t, raw_t = statistics.median(fw_s), statistics.median(raw_s)
+        f = _bus_factor(coll, self.ndev)
+        return {
+            "coll": coll, "nbytes": nbytes,
+            "fw_lat_us": round(fw_t * 1e6, 2),
+            "raw_lat_us": round(raw_t * 1e6, 2),
+            "fw_bw_gbs": round(f * nbytes / fw_t / 1e9, 3),
+            "raw_bw_gbs": round(f * nbytes / raw_t / 1e9, 3),
+            "ratio": round(raw_t / fw_t, 4),
+        }
+
+    def persistent_point(self, nbytes: int) -> dict:
+        x = self.make(nbytes)
+        h = self.world.allreduce_array_init(x)
+        t = _time_fn(h, x)
+        f = _bus_factor("allreduce", self.ndev)
+        return {"coll": "allreduce_persistent", "nbytes": nbytes,
+                "fw_lat_us": round(t * 1e6, 2),
+                "fw_bw_gbs": round(f * nbytes / t / 1e9, 3)}
+
+
+def host_ring_smoke() -> dict:
+    """BASELINE config #1: 4-rank ring over the host path (tpurun)."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "4",
+         sys.executable, os.path.join(here, "examples", "ring.py")],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    dt = time.perf_counter() - t0
+    return {"coll": "ring_4rank_host", "ok": proc.returncode == 0,
+            "wall_s": round(dt, 2)}
+
+
+def main() -> None:
+    fast = os.environ.get("OTPU_BENCH_FAST", "") not in ("", "0")
+    try:
+        b = DeviceBench()
+        primary = b.point("allreduce", PRIMARY, iters=40)
     except Exception as exc:
-        # report the raw number but an honest 0.0 ratio: the framework
-        # path did NOT run, so claiming parity would be false
+        # honest failure: report raw psum only, with vs_baseline=0 — the
+        # framework path did NOT run, claiming parity would be false
         print(f"framework path unavailable ({exc}); reporting raw psum "
               "with vs_baseline=0", file=sys.stderr)
-        value, vs = raw_bw, 0.0
+        ndev = len(jax.devices())
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("x",))
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
 
+        fn = jax.jit(shard_map(lambda t: jax.lax.psum(t[0], "x"), mesh=mesh,
+                               in_specs=P("x"), out_specs=P(),
+                               check_vma=False))
+        x = jnp.ones((ndev, PRIMARY // 4), jnp.float32)
+        t = _time_fn(fn, x)
+        print(json.dumps({
+            "metric": "osu_allreduce_bus_bw_16MB_f32",
+            "value": round(_bus_factor("allreduce", ndev) * PRIMARY / t / 1e9,
+                           3),
+            "unit": "GB/s", "vs_baseline": 0.0}))
+        return
+    results = [primary]
+
+    if not fast:
+        for nbytes in SWEEP_SIZES:
+            if nbytes != PRIMARY:
+                try:
+                    results.append(b.point("allreduce", nbytes))
+                except Exception as exc:
+                    print(f"allreduce@{nbytes} failed: {exc}",
+                          file=sys.stderr)
+        for coll in ("bcast", "allgather", "reduce_scatter"):
+            for nbytes in SPOT_SIZES:
+                try:
+                    results.append(b.point(coll, nbytes))
+                except Exception as exc:
+                    print(f"{coll}@{nbytes} failed: {exc}", file=sys.stderr)
+        try:
+            results.append(b.persistent_point(PRIMARY))
+        except Exception as exc:
+            print(f"persistent failed: {exc}", file=sys.stderr)
+        try:
+            results.append(host_ring_smoke())
+        except Exception as exc:
+            print(f"ring smoke failed: {exc}", file=sys.stderr)
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_SWEEP.json"), "w") as f:
+            json.dump({"ndev": b.ndev, "results": results}, f, indent=1)
+        lines = ["# Collective sweep (OSU protocol, BASELINE.md configs "
+                 "#1-#5)", "",
+                 f"Devices: {b.ndev}", "",
+                 "| coll | bytes | fw lat us | raw lat us | fw GB/s | "
+                 "raw GB/s | ratio |",
+                 "|---|---|---|---|---|---|---|"]
+        for r in results:
+            lines.append(
+                f"| {r['coll']} | {r.get('nbytes', '-')} | "
+                f"{r.get('fw_lat_us', '-')} | {r.get('raw_lat_us', '-')} | "
+                f"{r.get('fw_bw_gbs', '-')} | {r.get('raw_bw_gbs', '-')} | "
+                f"{r.get('ratio', '-')} |")
+        with open(os.path.join(here, "BENCH_SWEEP.md"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+    import ompi_tpu
+
+    ompi_tpu.finalize()
     print(json.dumps({
         "metric": "osu_allreduce_bus_bw_16MB_f32",
-        "value": round(value, 3),
+        "value": primary["fw_bw_gbs"],
         "unit": "GB/s",
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": primary["ratio"],
     }))
 
 

@@ -38,7 +38,8 @@ COLL_FUNCTIONS = (
     "allreduce_array", "bcast_array", "allgather_array",
     "reduce_scatter_array", "alltoall_array", "ppermute_array",
     "psum_scatter_array", "reduce_array", "gather_array", "scatter_array",
-    "device_barrier",
+    "allgatherv_array", "alltoallv_array", "scan_array", "exscan_array",
+    "persistent_coll", "device_barrier",
     "agree", "iagree",
     "neighbor_allgather", "neighbor_alltoall",
 )
@@ -267,6 +268,43 @@ class Comm(AttributeHost):
     def reduce_scatter_array(self, x, op: op_mod.Op = op_mod.SUM):
         self._check_state()
         return self._coll("reduce_scatter_array")(self, x, op)
+
+    def reduce_array(self, x, op: op_mod.Op = op_mod.SUM, root: int = 0):
+        self._check_state()
+        return self._coll("reduce_array")(self, x, op, root)
+
+    def gather_array(self, x, root: int = 0):
+        self._check_state()
+        return self._coll("gather_array")(self, x, root)
+
+    def scatter_array(self, x, root: int = 0):
+        self._check_state()
+        return self._coll("scatter_array")(self, x, root)
+
+    def allgatherv_array(self, x, counts):
+        self._check_state()
+        return self._coll("allgatherv_array")(self, x, counts)
+
+    def alltoallv_array(self, x, counts):
+        self._check_state()
+        return self._coll("alltoallv_array")(self, x, counts)
+
+    def scan_array(self, x, op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("scan_array")(self, x, op)
+
+    def exscan_array(self, x, op: op_mod.Op = op_mod.SUM):
+        self._check_state()
+        return self._coll("exscan_array")(self, x, op)
+
+    def coll_init(self, coll: str, template, *args):
+        """Persistent collective (MPI_Allreduce_init & friends, MPI-4):
+        pre-bind the compiled program for ``template``-shaped buffers."""
+        self._check_state()
+        return self._coll("persistent_coll")(self, coll, template, *args)
+
+    def allreduce_array_init(self, template, op: op_mod.Op = op_mod.SUM):
+        return self.coll_init("allreduce", template, op)
 
     def alltoall_array(self, x):
         self._check_state()

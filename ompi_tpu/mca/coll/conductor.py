@@ -60,7 +60,10 @@ class ConductorModule:
 
     def reduce(self, comm, sendbuf, op, root=0):
         if self._is_device(sendbuf):
-            return comm.c_coll["reduce_array"](comm, sendbuf, op, root)
+            # single-controller: root's recvbuf is this process's result, so
+            # the replicated allreduce IS the reduce (root row masking is
+            # the multi-rank reduce_array slot's business)
+            return comm.c_coll["allreduce_array"](comm, sendbuf, op)
         return _fold(op, self._stack(comm, sendbuf))
 
     def allreduce(self, comm, sendbuf, op):
@@ -70,7 +73,8 @@ class ConductorModule:
 
     def gather(self, comm, sendbuf, root=0):
         if self._is_device(sendbuf):
-            return comm.c_coll["gather_array"](comm, sendbuf, root)
+            # single-controller: the replicated allgather is root's recvbuf
+            return comm.c_coll["allgather_array"](comm, sendbuf)
         return np.array(self._stack(comm, sendbuf), copy=True)
 
     def gatherv(self, comm, sendbuf, root=0):
@@ -78,7 +82,17 @@ class ConductorModule:
 
     def scatter(self, comm, sendbuf, root=0):
         if self._is_device(sendbuf):
-            return comm.c_coll["scatter_array"](comm, sendbuf, root)
+            # single-controller: root's (n, *S) buffer scattered over the
+            # mesh is exactly a resharding; XLA schedules the ICI moves
+            xm = next((m for m in getattr(comm, "coll_modules", ())
+                       if hasattr(m, "reshard")), None)
+            if xm is None:
+                from ompi_tpu.api.errors import ErrorClass, MpiError
+
+                raise MpiError(
+                    ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                    "device-buffer scatter needs a device coll module")
+            return xm.reshard(sendbuf)
         return np.array(self._stack(comm, sendbuf), copy=True)
 
     def scatterv(self, comm, sendbufs, root=0):

@@ -12,22 +12,56 @@ ON device instead).
 Data model (single-controller SPMD): a communicator of size N over an
 N-device mesh; device arrays carry a leading rank axis of global size N
 sharded over the mesh axis (``x[i]`` lives on device-rank i's HBM).
-Compiled programs are cached per (function, op, shape, dtype, args) — the
-trace-time analog of the MCA-selection-at-runtime the reference does per
-call (SURVEY.md §7 hard part #1).
+
+Hot-path design: compiled programs are cached per (coll, op, shape, dtype)
+— the trace-time analog of per-call MCA selection (SURVEY.md §7 hard part
+#1) — and a cache *hit* is one unlocked dict probe + relaxed SPC bump +
+the XLA dispatch, nothing else; argument validation is memoized with the
+program (same key ⇒ already validated).  ``persistent()`` exposes the
+bound compiled program directly — the MPI-4 persistent-collective
+(``MPI_Allreduce_init``) analog.
 """
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 import numpy as np
 
 from ompi_tpu.api import op as op_mod
 from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.request import CompletedRequest
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
 from ompi_tpu.runtime import spc
+
+
+class PersistentColl:
+    """A bound, pre-compiled collective program (MPI_*_init analog).
+
+    ``__call__`` runs it eagerly; ``start`` returns a request completing
+    with the result (device dispatch is already asynchronous, so the
+    request is born complete — the XLA stream is the progress engine).
+    """
+
+    __slots__ = ("fn", "coll", "_nbytes")
+
+    def __init__(self, fn, coll: str, nbytes: int) -> None:
+        self.fn = fn
+        self.coll = coll
+        self._nbytes = nbytes
+
+    def __call__(self, x):
+        spc.bump_device(self._nbytes)
+        return self.fn(x)
+
+    def start(self, x):
+        spc.bump_device(self._nbytes)
+        r = CompletedRequest()
+        r.result = self.fn(x)
+        return r
+
+    def free(self) -> None:
+        self.fn = None
 
 
 class XlaCollModule:
@@ -46,7 +80,8 @@ class XlaCollModule:
         self._replicated = NamedSharding(self.mesh, P())
 
     # -- helpers ---------------------------------------------------------
-    def _check(self, comm, x):
+    def _check(self, comm, x, inner_n: bool = False):
+        """Validate + place a buffer (slow path, memoized by program key)."""
         import jax
 
         if not isinstance(x, jax.Array):
@@ -56,9 +91,17 @@ class XlaCollModule:
                 ErrorClass.ERR_BUFFER,
                 f"device collective needs leading rank axis {self.n}, "
                 f"got shape {x.shape}")
-        spc.record("device_collectives")
-        spc.record("device_bytes", x.nbytes)
+        if inner_n and (x.ndim < 2 or x.shape[1] != self.n):
+            raise MpiError(
+                ErrorClass.ERR_BUFFER,
+                f"this collective needs shape (n, n, ...), got {x.shape}")
         return x
+
+    def reshard(self, x):
+        """Reshard a device array to the row-per-rank layout (XLA moves)."""
+        import jax
+
+        return jax.device_put(x, self._sharded)
 
     def make_world_array(self, host_stack):
         """Place a (size, ...) host stack so row i lives on device-rank i."""
@@ -72,13 +115,19 @@ class XlaCollModule:
                 f"{arr.shape}")
         return jax.device_put(arr, self._sharded)
 
-    def _compiled(self, key, builder):
-        with self._lock:
-            fn = self._cache.get(key)
-            if fn is None:
-                fn = builder()
-                self._cache[key] = fn
-        return fn
+    def _get(self, comm, key, x, builder, inner_n: bool = False):
+        """One-probe fast path; build+validate under the lock on miss."""
+        entry = self._cache.get(key)
+        if entry is None:
+            x = self._check(comm, x, inner_n)
+            with self._lock:
+                entry = self._cache.get(key)
+                if entry is None:
+                    entry = (builder(), x.nbytes)
+                    self._cache[key] = entry
+        fn, nbytes = entry
+        spc.bump_device(nbytes)
+        return fn, x
 
     def _shard_map(self, fn, in_specs, out_specs, check_vma: bool = False):
         # check_vma off by default: several collective results (all_gather,
@@ -114,19 +163,33 @@ class XlaCollModule:
 
     # -- collective slots ------------------------------------------------
     def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
-        x = self._check(comm, x)
         P = self._P
-        key = ("allreduce", op.name, x.shape, str(x.dtype))
-        body = self._reduce_in_shard(op)
-        # gather+fold lowerings produce replicated values the static checker
-        # can't infer; native psum/pmax/pmin pass the check
-        fn = self._compiled(key, lambda: self._shard_map(
-            lambda t: body(t[0]), P(self.axis), P()))
+        fn, x = self._get(
+            comm, self._keyfor("allreduce", x, op), x,
+            lambda: self._shard_map(
+                lambda t: self._reduce_in_shard(op)(t[0]),
+                P(self.axis), P()))
         return fn(x)
 
-    def reduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM, root: int = 0):
-        # on a mesh the reduced value is replicated; root semantics are moot
-        return self.allreduce_array(comm, x, op)
+    def reduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM,
+                     root: int = 0):
+        """Reduction lands in root's row; other rows are zero (their
+        content is undefined per MPI — zeros make misuse visible)."""
+        import jax
+        import jax.numpy as jnp
+
+        P = self._P
+        reduce_body = self._reduce_in_shard(op)
+
+        def body(t):  # (1, *S)
+            r = reduce_body(t[0])
+            me = jax.lax.axis_index(self.axis)
+            return jnp.where(me == root, r, jnp.zeros_like(r))[None]
+
+        fn, x = self._get(
+            comm, self._keyfor("reduce", x, op, root), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)))
+        return fn(x)
 
     def bcast_array(self, comm, x, root: int = 0):
         """Binomial-tree broadcast: log2(n) ppermute rounds over ICI.
@@ -139,10 +202,8 @@ class XlaCollModule:
         import jax
         import jax.numpy as jnp
 
-        x = self._check(comm, x)
         P = self._P
         n, ax = self.n, self.axis
-        key = ("bcast", root, x.shape, str(x.dtype))
 
         def body(t):  # t: (1, *S)
             me = jax.lax.axis_index(ax)
@@ -158,23 +219,54 @@ class XlaCollModule:
                 k *= 2
             return cur
 
-        fn = self._compiled(key, lambda: self._shard_map(
-            body, P(self.axis), P(self.axis), check_vma=False))
+        fn, x = self._get(
+            comm, self._keyfor("bcast", x, root), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)))
         return fn(x)
 
     def allgather_array(self, comm, x):
         import jax
 
-        x = self._check(comm, x)
         P = self._P
-        key = ("allgather", x.shape, str(x.dtype))
-        fn = self._compiled(key, lambda: self._shard_map(
-            lambda t: jax.lax.all_gather(t[0], self.axis),
-            P(self.axis), P()))
+        fn, x = self._get(
+            comm, self._keyfor("allgather", x), x,
+            lambda: self._shard_map(
+                lambda t: jax.lax.all_gather(t[0], self.axis),
+                P(self.axis), P()))
         return fn(x)
 
+    def allgatherv_array(self, comm, x, counts):
+        """Padded allgatherv: blocks padded to a common (max) first dim.
+
+        Ragged shapes don't exist under XLA's static-shape model, so the
+        v-variant is allgather of padded blocks + zero-copy host-side
+        views: returns a list of per-rank arrays sliced to ``counts[i]``.
+        """
+        counts = tuple(int(c) for c in counts)
+        if len(counts) != self.n:
+            raise MpiError(ErrorClass.ERR_BUFFER,
+                           f"allgatherv needs {self.n} counts, got "
+                           f"{len(counts)}")
+        full = self.allgather_array(comm, x)  # (n, Smax, ...)
+        return [full[i, :counts[i]] for i in range(self.n)]
+
     def gather_array(self, comm, x, root: int = 0):
-        return self.allgather_array(comm, x)
+        """Gathered rows land at root; non-root rows are zero."""
+        import jax
+        import jax.numpy as jnp
+
+        P = self._P
+        n, ax = self.n, self.axis
+
+        def body(t):  # (1, *S) -> (1, n, *S)
+            g = jax.lax.all_gather(t[0], ax)
+            me = jax.lax.axis_index(ax)
+            return jnp.where(me == root, g, jnp.zeros_like(g))[None]
+
+        fn, x = self._get(
+            comm, self._keyfor("gather", x, root), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)))
+        return fn(x)
 
     def reduce_scatter_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
         """Each rank contributes (n, *S); rank i receives the reduced block i.
@@ -183,19 +275,12 @@ class XlaCollModule:
         """
         import jax
 
-        x = self._check(comm, x)
-        if x.ndim < 2 or x.shape[1] != self.n:
-            raise MpiError(ErrorClass.ERR_BUFFER,
-                           f"reduce_scatter needs shape (n, n, ...), got "
-                           f"{x.shape}")
         P = self._P
-        key = ("reduce_scatter", op.name, x.shape, str(x.dtype))
         if op.jax_reduce == "psum":
             def body(t):  # t: (1, n, *S)
                 return jax.lax.psum_scatter(
                     t[0], self.axis, scatter_dimension=0, tiled=False)[None]
         else:
-            fold = op_mod.jax_fold(op)
             reduce_body = self._reduce_in_shard(op)
 
             def body(t):
@@ -203,8 +288,10 @@ class XlaCollModule:
                 i = jax.lax.axis_index(self.axis)
                 return jax.lax.dynamic_index_in_dim(full, i, 0)
 
-        fn = self._compiled(key, lambda: self._shard_map(
-            body, P(self.axis), P(self.axis)))
+        fn, x = self._get(
+            comm, self._keyfor("reduce_scatter", x, op), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)),
+            inner_n=True)
         return fn(x)
 
     def psum_scatter_array(self, comm, x):
@@ -215,51 +302,148 @@ class XlaCollModule:
         import jax
         import jax.numpy as jnp
 
-        x = self._check(comm, x)
-        if x.ndim < 2 or x.shape[1] != self.n:
-            raise MpiError(ErrorClass.ERR_BUFFER,
-                           f"alltoall needs shape (n, n, ...), got {x.shape}")
         P = self._P
-        key = ("alltoall", x.shape, str(x.dtype))
 
         def body(t):  # (1, n, *S)
             y = jax.lax.all_to_all(t, self.axis, split_axis=1, concat_axis=0)
             return jnp.swapaxes(y, 0, 1)  # (1, n, *S): row = my received blocks
 
-        fn = self._compiled(key, lambda: self._shard_map(
-            body, P(self.axis), P(self.axis)))
+        fn, x = self._get(
+            comm, self._keyfor("alltoall", x), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)),
+            inner_n=True)
         return fn(x)
+
+    def alltoallv_array(self, comm, x, counts):
+        """Padded alltoallv: x (n, n, Smax, ...), counts[i][j] = rows rank j
+        receives from rank i.  Returns list-of-lists of sliced views."""
+        full = self.alltoall_array(comm, x)  # row i = blocks received by i
+        return [[full[i, j, :int(counts[j][i])] for j in range(self.n)]
+                for i in range(self.n)]
 
     def ppermute_array(self, comm, x, perm):
         import jax
 
-        x = self._check(comm, x)
         P = self._P
         perm = tuple((int(s), int(d)) for s, d in perm)
-        key = ("ppermute", perm, x.shape, str(x.dtype))
-        fn = self._compiled(key, lambda: self._shard_map(
-            lambda t: jax.lax.ppermute(t, self.axis, perm),
-            P(self.axis), P(self.axis)))
+        fn, x = self._get(
+            comm, self._keyfor("ppermute", x, perm), x,
+            lambda: self._shard_map(
+                lambda t: jax.lax.ppermute(t, self.axis, perm),
+                P(self.axis), P(self.axis)))
         return fn(x)
 
     def scatter_array(self, comm, x, root: int = 0):
-        """Root's (n, *S) blocks land one per device-rank (a resharding:
-        block i moves root→device i over ICI, XLA schedules the moves)."""
+        """Scatter root's buffer: x (n, n, *S) where row root holds root's
+        n blocks; rank i receives block i.  One all_to_all moves only the
+        root's blocks' worth of data per link (non-root rows are dead
+        freight XLA may DCE after the swap-select)."""
         import jax
 
-        x = self._check(comm, x)
-        return jax.device_put(x, self._sharded)
+        P = self._P
 
-    def device_barrier(self, comm) -> None:
+        def body(t):  # (1, n, *S) -> (n, 1, *S) after the exchange
+            y = jax.lax.all_to_all(t, self.axis, split_axis=1, concat_axis=0)
+            # y[s] = (1, *S) block received from source s; keep root's
+            return y[root]
+
+        fn, x = self._get(
+            comm, self._keyfor("scatter", x, root), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)),
+            inner_n=True)
+        return fn(x)
+
+    def scan_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        """Inclusive scan over ranks: row i = reduce(rows 0..i)."""
+        import jax
+
+        P = self._P
+        fold = op_mod.jax_fold(op)
+
+        def body(t):  # (1, *S)
+            g = jax.lax.all_gather(t[0], self.axis)        # (n, *S)
+            # fold convention: acc = in (op) acc, rank-ordered
+            s = jax.lax.associative_scan(lambda a, b: fold(a, b), g, axis=0)
+            i = jax.lax.axis_index(self.axis)
+            return jax.lax.dynamic_index_in_dim(s, i, 0)
+
+        fn, x = self._get(
+            comm, self._keyfor("scan", x, op), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)))
+        return fn(x)
+
+    def exscan_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        """Exclusive scan; rank 0's row is zeros (MPI: undefined)."""
         import jax
         import jax.numpy as jnp
 
-        key = ("barrier",)
         P = self._P
-        fn = self._compiled(key, lambda: self._shard_map(
-            lambda t: jax.lax.psum(t, self.axis),
-            P(self.axis), P()))
+        fold = op_mod.jax_fold(op)
+
+        def body(t):
+            g = jax.lax.all_gather(t[0], self.axis)
+            s = jax.lax.associative_scan(lambda a, b: fold(a, b), g, axis=0)
+            i = jax.lax.axis_index(self.axis)
+            prev = jax.lax.dynamic_index_in_dim(
+                s, jnp.maximum(i - 1, 0), 0, keepdims=False)
+            return jnp.where(i == 0, jnp.zeros_like(prev), prev)[None]
+
+        fn, x = self._get(
+            comm, self._keyfor("exscan", x, op), x,
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)))
+        return fn(x)
+
+    # -- persistent collectives (MPI_Allreduce_init analog) --------------
+    def persistent_coll(self, comm, coll: str, template, *args):
+        """Pre-bind a compiled collective for a template buffer.
+
+        Runs the named collective once eagerly (building + caching the
+        program, validating the template) and returns a ``PersistentColl``
+        whose ``__call__``/``start`` skip everything but the XLA dispatch.
+        """
+        method = getattr(self, coll + "_array", None)
+        if method is None:
+            raise MpiError(ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                           f"no device collective '{coll}'")
+        template = self._check(comm, template)
+        method(comm, template, *args)   # build + cache + validate
+        fn, nbytes = self._cache[self._keyfor(coll, template, *args)]
+        return PersistentColl(fn, coll, nbytes)
+
+    def _keyfor(self, coll: str, x, *args):
+        """Single source of truth for program-cache keys (used by the
+        *_array methods and persistent_coll alike)."""
+        def op_of(i=0):
+            return (args[i] if len(args) > i else op_mod.SUM).name
+
+        def root_of(i=0):
+            return args[i] if len(args) > i else 0
+
+        if coll == "allreduce":
+            return (coll, op_of(), x.shape, x.dtype)
+        if coll == "reduce":
+            return (coll, op_of(0), root_of(1), x.shape, x.dtype)
+        if coll in ("bcast", "gather", "scatter"):
+            return (coll, root_of(), x.shape, x.dtype)
+        if coll in ("reduce_scatter", "scan", "exscan"):
+            return (coll, op_of(), x.shape, x.dtype)
+        if coll in ("allgather", "alltoall"):
+            return (coll, x.shape, x.dtype)
+        if coll == "ppermute":
+            perm = tuple((int(s), int(d)) for s, d in args[0])
+            return (coll, perm, x.shape, x.dtype)
+        raise MpiError(ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                       f"no persistent binding for '{coll}'")
+
+    def device_barrier(self, comm) -> None:
+        import jax
+
+        P = self._P
         tok = self.make_world_array(np.zeros((self.n, 1), np.float32))
+        fn, tok = self._get(
+            comm, ("barrier",), tok,
+            lambda: self._shard_map(
+                lambda t: jax.lax.psum(t, self.axis), P(self.axis), P()))
         jax.block_until_ready(fn(tok))
 
     def barrier(self, comm) -> None:

@@ -30,6 +30,25 @@ def record(name: str, value: float = 1) -> None:
         pv.add(value)
 
 
+_dev_calls = None
+_dev_bytes = None
+
+
+def bump_device(nbytes: int) -> None:
+    """Hot-path SPC bump for device collectives: relaxed (unlocked) adds,
+    mirroring the reference's plain inline counter increments
+    (``ompi_spc.c`` — SPC counters are not atomic unless multithreaded
+    accuracy is requested)."""
+    global _dev_calls, _dev_bytes
+    if _dev_calls is None:
+        _dev_calls = _pvars.get("device_collectives")
+        _dev_bytes = _pvars.get("device_bytes")
+        if _dev_calls is None:
+            return
+    _dev_calls.add_relaxed(1)
+    _dev_bytes.add_relaxed(nbytes)
+
+
 def read(name: str) -> float:
     pv = _pvars.get(name)
     return 0 if pv is None else pv.read()

@@ -171,3 +171,82 @@ def test_split_device_subcomm(world, xla):
     host = np.ones((4, 3), np.float32)
     out = np.asarray(sub.allreduce_array(submod[0].make_world_array(host)))
     np.testing.assert_allclose(out, np.full(3, 4.0))
+
+
+def test_device_reduce_root_semantics(world, xla):
+    host, dev = _world_data(xla, seed=10)
+    out = np.asarray(world.reduce_array(dev, root=2))
+    np.testing.assert_allclose(out[2], host.sum(0), rtol=1e-5)
+    for i in (0, 1, 3, 7):
+        np.testing.assert_array_equal(out[i], np.zeros_like(out[i]))
+
+
+def test_device_gather_root_semantics(world, xla):
+    host, dev = _world_data(xla, seed=11)
+    out = np.asarray(world.gather_array(dev, root=5))
+    np.testing.assert_allclose(out[5], host, rtol=1e-6)
+    assert not out[0].any() and not out[7].any()
+
+
+def test_device_scatter_from_root(world, xla):
+    # per-rank buffers (8, 8, 3); only root's row is significant
+    rng = np.random.default_rng(12)
+    host = rng.standard_normal((8, 8, 3)).astype(np.float32)
+    dev = xla.make_world_array(host)
+    out = np.asarray(world.scatter_array(dev, root=4))
+    # rank i receives root's block i
+    np.testing.assert_allclose(out, host[4], rtol=1e-6)
+
+
+def test_device_scan_exscan(world, xla):
+    host, dev = _world_data(xla, seed=13)
+    out = np.asarray(world.scan_array(dev))
+    np.testing.assert_allclose(out, np.cumsum(host, 0), rtol=1e-4)
+    ex = np.asarray(world.exscan_array(dev))
+    np.testing.assert_array_equal(ex[0], np.zeros_like(ex[0]))
+    np.testing.assert_allclose(ex[1:], np.cumsum(host, 0)[:-1], rtol=1e-4)
+
+
+def test_device_allgatherv(world, xla):
+    host = np.random.default_rng(14).standard_normal((8, 4, 2)) \
+        .astype(np.float32)
+    dev = xla.make_world_array(host)
+    counts = [1, 2, 3, 4, 4, 3, 2, 1]
+    outs = world.allgatherv_array(dev, counts)
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o), host[i, :counts[i]],
+                                   rtol=1e-6)
+
+
+def test_device_alltoallv(world, xla):
+    host = np.arange(8 * 8 * 3, dtype=np.float32).reshape(8, 8, 3)
+    dev = xla.make_world_array(host)
+    # asymmetric so a counts[i][j]/counts[j][i] transpose bug is caught
+    counts = [[(2 * i + j) % 4 for j in range(8)] for i in range(8)]
+    outs = world.alltoallv_array(dev, counts)
+    for i in range(8):
+        for j in range(8):
+            np.testing.assert_array_equal(
+                np.asarray(outs[i][j]), host[j, i, :counts[j][i]])
+
+
+def test_persistent_allreduce(world, xla):
+    host, dev = _world_data(xla, seed=15)
+    h = world.allreduce_array_init(dev)
+    out = np.asarray(h(dev))
+    np.testing.assert_allclose(out, host.sum(0), rtol=1e-5)
+    req = h.start(dev)
+    req.wait()
+    np.testing.assert_allclose(np.asarray(req.result), host.sum(0),
+                               rtol=1e-5)
+    # same shape/op/dtype shares the compiled program with the eager path
+    assert h.fn is xla._cache[("allreduce", "SUM", dev.shape, dev.dtype)][0]
+
+
+def test_spc_device_counters_bump(world, xla):
+    from ompi_tpu.runtime import spc
+
+    before = spc.read("device_collectives")
+    host, dev = _world_data(xla, seed=16)
+    world.allreduce_array(dev)
+    assert spc.read("device_collectives") >= before + 1
