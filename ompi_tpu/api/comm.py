@@ -382,20 +382,30 @@ class Comm(AttributeHost):
     def _next_cid(self) -> int:
         """Agree on the next free CID across members (``comm_cid.c:53``).
 
-        The reference runs a multi-round allreduce agreement; here each
-        member proposes its local next-free id and the group takes the MAX —
-        one allreduce round over the parent (FT epoch rides along).
+        Multi-round like the reference: each member proposes its first
+        locally-free id (unreserved), the group takes the MAX, then a
+        second allreduce confirms the winner is free on *every* member
+        (it may not be: group-scoped create_group allocations make
+        bitmaps diverge).  On conflict, re-propose above the loser.
         """
         from ompi_tpu.runtime import init as rt
 
-        local = rt.next_local_cid()
         if self.rte is not None and self.rte.is_device_world:
-            # conductor model: every co-located rank proposes the same id
-            proposal = np.full((self.size, 1), local, dtype=np.int64)
-        else:
-            proposal = np.array([local], dtype=np.int64)
-        agreed = self.allreduce(proposal, op_mod.MAX)
-        return rt.adopt_cid(local, int(np.asarray(agreed).ravel()[0]))
+            # single process backs every co-located rank: one bitmap,
+            # local find-and-set IS the agreement
+            return rt.next_local_cid()
+        floor = 0
+        while True:
+            local = rt.candidate_cid(floor)
+            agreed = int(np.asarray(self.allreduce(
+                np.array([local], dtype=np.int64), op_mod.MAX)).ravel()[0])
+            ok = 1 if rt.is_cid_free(agreed) else 0
+            all_ok = int(np.asarray(self.allreduce(
+                np.array([ok], dtype=np.int64), op_mod.MIN)).ravel()[0])
+            if all_ok:
+                rt.reserve_cid(agreed)
+                return agreed
+            floor = agreed + 1
 
     def dup(self) -> "Comm":
         self._check_state()
@@ -456,18 +466,61 @@ class Comm(AttributeHost):
         return newcomm
 
     def create_group(self, group: Group, tag: int = 0) -> Optional["Comm"]:
-        """Non-collective over the parent: only group members participate."""
+        """Non-collective over the parent: only group members participate.
+
+        The CID must still be agreed across the *group* (a purely local
+        allocation can hand members of the same new comm different CIDs),
+        so members run the multi-round agreement over parent p2p on a
+        reserved tag (the reference's comm_create_group activation uses
+        tagged parent traffic the same way).
+        """
         if group.rank_of(self.rte.my_world_rank) < 0:
             return None
         from ompi_tpu.runtime import init as rt
 
-        cid = rt.next_local_cid()
-        rt.reserve_cid(cid)
+        if self.rte is not None and self.rte.is_device_world:
+            cid = rt.next_local_cid()
+        else:
+            cid = self._agree_cid_group(group, tag)
         newcomm = Comm(group, cid, self.rte,
                        name=f"{self.name}~create_group", epoch=self.epoch,
                        parent=self)
         self._finish_create(newcomm)
         return newcomm
+
+    def _agree_cid_group(self, group: Group, tag: int) -> int:
+        """Multi-round CID agreement among group members via parent p2p."""
+        from ompi_tpu.runtime import init as rt
+
+        members = [self.group.rank_of(w) for w in group.world_ranks]
+        leader = members[0]
+        t = -(1 << 20) - tag  # reserved internal tag space
+
+        def xchg(value: int, combine) -> int:
+            buf = np.array([value], dtype=np.int64)
+            if self.rank == leader:
+                acc = value
+                got = np.zeros(1, dtype=np.int64)
+                for m in members[1:]:
+                    self.recv(got, m, t)
+                    acc = combine(acc, int(got[0]))
+                out = np.array([acc], dtype=np.int64)
+                for m in members[1:]:
+                    self.send(out, m, t)
+                return acc
+            self.send(buf, leader, t)
+            got = np.zeros(1, dtype=np.int64)
+            self.recv(got, leader, t)
+            return int(got[0])
+
+        floor = 0
+        while True:
+            agreed = xchg(rt.candidate_cid(floor), max)
+            all_ok = xchg(1 if rt.is_cid_free(agreed) else 0, min)
+            if all_ok:
+                rt.reserve_cid(agreed)
+                return agreed
+            floor = agreed + 1
 
     def _finish_create(self, newcomm: "Comm") -> None:
         from ompi_tpu.mca.coll.base import comm_select
@@ -673,6 +726,10 @@ class Comm(AttributeHost):
         return out
 
     def free(self) -> None:
+        if self.freed:
+            # double-free must not touch a newer communicator's state
+            # (release/del_comm are keyed by bare cid)
+            return
         self._attrs_delete_all()
         for mod in self.coll_modules:
             close = getattr(mod, "comm_unquery", None)
@@ -682,13 +739,10 @@ class Comm(AttributeHost):
             del_comm = getattr(self.pml, "del_comm", None)
             if del_comm is not None:
                 del_comm(self)
-        # revoked CIDs are retired, never released: global revocation state
-        # is keyed (cid, epoch) forever, so a reused CID at the same epoch
-        # would be falsely revoked (comm_cid.c:73-78 epoch rationale)
-        if self.cid > 1 and not self.is_revoked():
+        if self.cid > 1:
             from ompi_tpu.runtime import init as rt
 
-            rt.release_cid(self.cid)
+            rt.retire_cid(self.cid)
         self.freed = True
 
     def abort(self, errorcode: int = 1) -> None:

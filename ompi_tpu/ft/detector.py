@@ -24,6 +24,11 @@ _period_var = registry.register(
 _timeout_var = registry.register(
     "ft", None, "detector_timeout", vtype=VarType.FLOAT, default=10.0,
     help="Heartbeat staleness timeout in seconds (reference tau=10s)")
+_grace_var = registry.register(
+    "ft", None, "detector_startup_grace", vtype=VarType.FLOAT, default=10.0,
+    help="Extra staleness allowance before a rank whose heartbeat was "
+         "NEVER observed is declared failed (the reference arms the "
+         "timeout relative to heartbeat activation, not first poll)")
 
 
 class Detector:
@@ -42,6 +47,7 @@ class Detector:
         self.client = CoordClient()
         self.period = float(_period_var.value)
         self.timeout = float(_timeout_var.value)
+        self.startup_grace = float(_grace_var.value)
         self._stop = threading.Event()
         self._seq = 0
         self._departed: set[int] = set()
@@ -95,9 +101,14 @@ class Detector:
                 except Exception:
                     return
                 prev = last_seq.get(target)
+                # a never-seen emitter (hb key not yet written, or a newly
+                # rotated-to target) gets timeout + startup grace before
+                # being declared: its detector thread may just be late
+                limit = (self.timeout if prev is None or prev[0] is not None
+                         else self.timeout + self.startup_grace)
                 if prev is None or (seen is not None and seen != prev[0]):
                     last_seq[target] = (seen, now)
-                elif now - prev[1] > self.timeout:
+                elif now - prev[1] > limit:
                     try:
                         finalized = self.client.get(target, "hb_final",
                                                     wait=False)

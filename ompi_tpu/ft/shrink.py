@@ -30,24 +30,35 @@ def shrink(comm):
         from ompi_tpu.ft.agreement import agree_kv
 
         seq = comm._ft_seq = getattr(comm, "_ft_seq", 0) + 1
-        proposed = rt.next_local_cid()
 
         def combine(a, b):
-            return (a[0] | b[0], max(a[1], b[1]))
+            return (a[0] | b[0], max(a[1], b[1]), min(a[2], b[2]))
 
         live = [r for r in members if not ft_state.is_failed(r)]
-        (failed_bits, cid), agreed_failed = agree_kv(
-            comm.rte,
-            ("shrink", comm.cid, comm.epoch, seq),
-            (_bits(members, ft_state.failed_ranks()), proposed),
-            live,
-            combine,
-            prev_instance=(("shrink", comm.cid, comm.epoch, seq - 2)
-                           if seq > 2 else None),
-        )
+        # multi-round: propose (unreserved) candidate, confirm the MAX is
+        # free on every survivor; re-propose above it on conflict
+        floor, attempt, prev_ok = 0, 0, True
+        while True:
+            attempt += 1
+            proposed = rt.candidate_cid(floor)
+            key = ("shrink", comm.cid, comm.epoch, seq, attempt)
+            (failed_bits, cid, _), agreed_failed = agree_kv(
+                comm.rte, key,
+                (_bits(members, ft_state.failed_ranks()), proposed, 1),
+                live, combine,
+                prev_instance=(("shrink", comm.cid, comm.epoch, seq - 2,
+                                attempt) if seq > 2 and prev_ok else None),
+            )
+            okkey = ("shrinkok", comm.cid, comm.epoch, seq, attempt)
+            (_, _, all_ok), _ = agree_kv(
+                comm.rte, okkey,
+                (0, 0, 1 if rt.is_cid_free(cid) else 0),
+                live, combine)
+            if all_ok:
+                break
+            floor, prev_ok = cid + 1, False
         dead = {r for r in agreed_failed} | _unbits(members, failed_bits)
         survivors = [r for r in members if r not in dead]
-        cid = rt.adopt_cid(proposed, cid)
 
     rt.reserve_cid(cid)
     newcomm = Comm(Group(survivors), cid, comm.rte,

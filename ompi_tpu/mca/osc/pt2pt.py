@@ -40,11 +40,13 @@ REPLY_BASE = -(1 << 22) - 16
 _REPLY_SPACE = 1 << 20
 
 
-# wire format = Comm.send_obj/recv_obj (pickled payload behind an int64
-# size header, both on one tag); only the agent's improbe-based header
-# read needs custom code (it must not block on a specific source)
+# Request wire format: ONE self-sized message (pickle bytes), so the
+# agent never blocks on a second recv from an origin that died between
+# sends — the exact failure window ULFM recovery mode opens.  The matched
+# size comes from the improbe status.  Replies keep the
+# send_obj/recv_obj two-part format (origin-side, actively waited).
 def _send_req(comm, dest: int, req: dict) -> None:
-    comm.send_obj(req, dest, REQ_TAG)
+    comm.send(np.frombuffer(pickle.dumps(req), np.uint8), dest, REQ_TAG)
 
 
 def _send_reply(comm, dest: int, tag: int, obj) -> None:
@@ -232,7 +234,6 @@ class Pt2ptModule:
         from ompi_tpu.runtime.progress import progress
 
         comm = win.comm
-        hdr = np.zeros(1, dtype=np.int64)
         while not self._stop.is_set():
             try:
                 # the agent IS the passive-target progress thread: pump the
@@ -246,9 +247,10 @@ class Pt2ptModule:
                 time.sleep(0.0005)
                 continue
             try:
-                st = msg.recv(hdr)
-                payload = np.zeros(int(hdr[0]), dtype=np.uint8)
-                comm.recv(payload, st.source, REQ_TAG)
+                # single self-sized message: recv of a matched frag cannot
+                # block on further traffic from the (possibly dead) origin
+                payload = np.zeros(msg.status._nbytes, dtype=np.uint8)
+                st = msg.recv(payload)
                 self._handle(win, st.source, pickle.loads(payload.tobytes()))
             except Exception:
                 if self._stop.is_set():

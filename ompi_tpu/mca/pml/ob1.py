@@ -116,6 +116,12 @@ class Ob1Pml:
         self._send_reqs: dict[int, SendRequest] = {}
         self._recv_reqs: dict[int, RecvRequest] = {}
         self.bml = Bml(rte, self._recv_frag)
+        # req_ft.c analog: peer death completes its pending requests in
+        # error instead of leaving waiters (e.g. an osc agent mid-rndv)
+        # blocked forever
+        from ompi_tpu.ft import state as ft_state
+
+        ft_state.on_failure(self._peer_failed)
 
     # -- framework hooks -------------------------------------------------
     def add_comm(self, comm) -> None:
@@ -133,6 +139,46 @@ class Ob1Pml:
 
     def finalize(self) -> None:
         self.bml.finalize()
+
+    # -- FT request completion (``ompi/request/req_ft.c``) ---------------
+    def _peer_failed(self, world_rank: int) -> None:
+        """Complete pending requests whose explicit peer died in error.
+
+        ANY_SOURCE recvs are left pending (the reference raises
+        ERR_PROC_FAILED_PENDING, a warning, without destroying them).
+        """
+        from ompi_tpu.api.errors import ProcFailedError
+
+        err = ProcFailedError(f"peer world rank {world_rank} failed",
+                              (world_rank,))
+        victims = []
+        with self._lock:
+            for st in self._match.values():
+                for req in list(st.posted):
+                    if req.source == ANY_SOURCE:
+                        continue
+                    try:
+                        src_w = req.comm.group.world_rank(req.source)
+                    except Exception:
+                        continue
+                    if src_w == world_rank:
+                        st.posted.remove(req)
+                        victims.append(req)
+            for rid, req in list(self._recv_reqs.items()):
+                if req.matched_src == world_rank:
+                    del self._recv_reqs[rid]
+                    victims.append(req)
+            for rid, req in list(self._send_reqs.items()):
+                try:
+                    grp = (req.comm.remote_group if req.comm.is_inter
+                           else req.comm.group)
+                    if grp.world_rank(req.dest) == world_rank:
+                        del self._send_reqs[rid]
+                        victims.append(req)
+                except Exception:
+                    continue
+        for req in victims:
+            req.complete(err)
 
     # -- send path (pml_ob1_isend.c:233) --------------------------------
     def isend(self, comm, buf, dest: int, tag: int) -> Request:

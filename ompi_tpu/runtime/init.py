@@ -58,18 +58,34 @@ def reserve_cid(cid: int) -> None:
         _cid_map.set(cid)
 
 
-def release_cid(cid: int) -> None:
+def candidate_cid(floor: int = 0) -> int:
+    """First locally-free CID >= floor, WITHOUT reserving it.
+
+    Proposals are not reserved until the group agreement succeeds, so a
+    losing proposal never punches a hole in the bitmap (the hole would
+    break the MAX-of-candidates agreement: a candidate chosen from a hole
+    can already back a live communicator on another rank).
+    """
     with _cid_lock:
-        _cid_map.clear(cid)
+        cid = floor
+        while _cid_map.is_set(cid):
+            cid += 1
+        return cid
 
 
-def adopt_cid(proposed: int, agreed: int) -> int:
-    """Adopt the group-agreed CID: release the losing local proposal
-    (returned to the pool) and reserve the winner."""
-    if agreed != proposed:
-        release_cid(proposed)
-    reserve_cid(agreed)
-    return agreed
+def is_cid_free(cid: int) -> bool:
+    with _cid_lock:
+        return not _cid_map.is_set(cid)
+
+
+def retire_cid(cid: int) -> None:
+    """Freed CIDs are retired, never returned to the pool: reuse would
+    both break the agreement's density assumption and allow a revoked
+    (cid, epoch) to be confused with a new incarnation (the reference
+    instead re-runs a multi-round agreement until the candidate is
+    globally unused — ``comm_cid.c:53-93``; retirement buys the same
+    safety from a 64-bit CID space)."""
+    # the bit simply stays set; the function records intent at call sites
 
 
 # -- init / finalize ----------------------------------------------------

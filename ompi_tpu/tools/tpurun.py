@@ -33,6 +33,12 @@ def main(argv=None) -> int:
                     help="ULFM mode: a dying rank is reported as a "
                          "proc_failed event instead of tearing down the job "
                          "(mpirun --enable-recovery)")
+    ap.add_argument("--with-tpu", action="store_true",
+                    help="Keep accelerator boot hooks active in ranks. By "
+                         "default ranks run the host path (ProcRte) and the "
+                         "TPU attach hook is stripped from their env: it "
+                         "costs seconds of startup/teardown per rank and a "
+                         "single chip cannot be shared by N ranks anyway")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -57,6 +63,9 @@ def main(argv=None) -> int:
         if env_base.get("PYTHONPATH") else pkg_root)
     env_base["OTPU_NPROCS"] = str(args.nprocs)
     env_base["OTPU_COORD"] = f"{host}:{port}"
+    if not args.with_tpu:
+        env_base.pop("PALLAS_AXON_POOL_IPS", None)
+        env_base["JAX_PLATFORMS"] = "cpu"
     for name, value in args.mca:
         env_base["OTPU_MCA_" + name.removeprefix("otpu_")] = value
 

@@ -224,7 +224,8 @@ class TestMultiprocessFt:
                 # thread WITHOUT the clean-finalize tombstone that stop()
                 # would write -- a hang leaves no tombstone.
                 propagator._detector._stop.set()
-                time.sleep(8)
+                # stay silent past detector_timeout (1.5s) + detection slack
+                time.sleep(4)
                 sys.exit(0)
             deadline = time.time() + 60
             while not ft_state.is_failed(1):
