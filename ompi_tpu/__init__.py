@@ -32,6 +32,20 @@ _API = {
     "Win": "ompi_tpu.api.win",
     "File": "ompi_tpu.api.file",
     "Status": "ompi_tpu.api.status",
+    # built-in reduction operators (MPI_SUM & friends)
+    "SUM": "ompi_tpu.api.op",
+    "PROD": "ompi_tpu.api.op",
+    "MAX": "ompi_tpu.api.op",
+    "MIN": "ompi_tpu.api.op",
+    "LAND": "ompi_tpu.api.op",
+    "LOR": "ompi_tpu.api.op",
+    "BAND": "ompi_tpu.api.op",
+    "BOR": "ompi_tpu.api.op",
+    "BXOR": "ompi_tpu.api.op",
+    "MAXLOC": "ompi_tpu.api.op",
+    "MINLOC": "ompi_tpu.api.op",
+    "REPLACE": "ompi_tpu.api.op",
+    "NO_OP": "ompi_tpu.api.op",
 }
 
 

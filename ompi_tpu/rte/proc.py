@@ -25,7 +25,12 @@ class ProcRte(Rte):
         self.world_size = int(os.environ["OTPU_NPROCS"])
         self.client = CoordClient()
         self._hostname = socket.gethostname()
+        # node identity for the hierarchy (coll/han): hostname by default,
+        # OTPU_NODE_ID when the launcher partitions ranks into fake nodes
+        # (tpurun --fake-nodes) or a multi-host launcher names slices
+        self._node = os.environ.get("OTPU_NODE_ID", self._hostname)
         self.modex_put("hostname", self._hostname)
+        self.modex_put("node", self._node)
         self._fence_counter = 0
 
     def modex_put(self, key: str, value: Any) -> None:
@@ -39,8 +44,8 @@ class ProcRte(Rte):
         self.client.fence(f"f{self._fence_counter}", rank=self.my_world_rank)
 
     def locality_color(self, split_type: str) -> int:
-        # 'shared' → same host (the sm/ICI domain)
-        return abs(hash(self._hostname)) % (1 << 30)
+        # 'shared' → same node (the sm/ICI domain)
+        return abs(hash(self._node)) % (1 << 30)
 
     def event_notify(self, event: str, payload: Any) -> None:
         self.client.event_publish(event, payload)

@@ -29,6 +29,11 @@ def main(argv=None) -> int:
                     help="Set an MCA variable for all ranks")
     ap.add_argument("--tag-output", action="store_true", default=True)
     ap.add_argument("--coord-port", type=int, default=0)
+    ap.add_argument("--fake-nodes", type=int, default=0, metavar="K",
+                    help="Partition ranks into K emulated nodes (sets "
+                         "OTPU_NODE_ID=rank*K//nprocs per rank) so the "
+                         "hierarchical coll/han path can be exercised on "
+                         "one host, like mpirun --oversubscribe for han")
     ap.add_argument("--enable-recovery", action="store_true",
                     help="ULFM mode: a dying rank is reported as a "
                          "proc_failed event instead of tearing down the job "
@@ -80,6 +85,8 @@ def main(argv=None) -> int:
     for rank in range(args.nprocs):
         env = dict(env_base)
         env["OTPU_RANK"] = str(rank)
+        if args.fake_nodes > 0:
+            env["OTPU_NODE_ID"] = f"node{rank * args.fake_nodes // args.nprocs}"
         try:
             p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                  stderr=subprocess.STDOUT)

@@ -1,0 +1,180 @@
+"""coll/han — hierarchical two-level collectives.
+
+Host path: tpurun --fake-nodes partitions ranks into emulated nodes so the
+low/up sub-comm composition is exercised on one host (the reference tests
+han under ``mpirun --oversubscribe`` the same way).  Device path: the
+('dcn', 'ici') 2-D mesh composition on the 8-device CPU mesh
+(VERDICT round-1 item #3: 2x4 split).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _tpurun(n, args, timeout=120, extra=()):
+    env = dict(os.environ)
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+         *extra, *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_han_symmetric_two_nodes(tmp_path):
+    """4 ranks on 2 fake nodes: han selects and every composition is
+    correct, including the reduce_scatter/allreduce/allgather fast path."""
+    script = tmp_path / "han_sym.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        w = ompi_tpu.init()
+        r = w.rank
+        mod = w.c_coll['allreduce'].__self__
+        assert type(mod).__name__ == 'HanModule', type(mod).__name__
+
+        # symmetric fast path: 8 elems / low size 2 divides evenly
+        out = w.allreduce(np.arange(8, dtype=np.float64) + r)
+        assert np.allclose(out, 4 * np.arange(8) + 6.0), out
+        # leader path: odd length not divisible by low size
+        out = w.allreduce(np.ones(7) * (r + 1))
+        assert np.allclose(out, 10.0), out
+        # MAX reduction through the hierarchy
+        out = w.allreduce(np.array([float(r)]), ompi_tpu.MAX)
+        assert out[0] == 3.0, out
+
+        # bcast from a NON-leader root (rank 1 lives on node 0)
+        b = w.bcast(np.array([42.5]) if r == 1 else np.zeros(1), root=1)
+        assert b[0] == 42.5
+        # bcast from node 1's leader (rank 2)
+        b = w.bcast(np.array([7.0, 8.0]) if r == 2 else np.zeros(2), root=2)
+        assert b.tolist() == [7.0, 8.0]
+
+        # reduce to a non-leader root on node 1 (rank 3)
+        red = w.reduce(np.array([float(r + 1)]), root=3)
+        if r == 3:
+            assert red[0] == 10.0, red
+        else:
+            assert red is None
+
+        g = w.allgather(np.array([r * 10], np.int64))
+        assert np.asarray(g).ravel().tolist() == [0, 10, 20, 30]
+
+        w.barrier()
+
+        gat = w.gather(np.array([r, r * r], np.int64), root=3)
+        if r == 3:
+            assert gat.tolist() == [[0, 0], [1, 1], [2, 4], [3, 9]], gat
+        else:
+            assert gat is None
+
+        stack = np.arange(8, dtype=np.float32).reshape(4, 2) * 100
+        sc = w.scatter(stack if r == 1 else np.zeros(2, np.float32), root=1)
+        assert sc.tolist() == [r * 2 * 100.0, (r * 2 + 1) * 100.0], sc
+
+        assert w.agree(1) == 1  # served by coll/ftagree, not han
+
+        # slots han doesn't provide fall through to tuned on the same comm
+        a2a = w.alltoall(np.arange(4, dtype=np.int64) + 100 * r)
+        assert a2a.ravel().tolist() == [r, 100 + r, 200 + r, 300 + r]
+
+        # a split spanning both nodes with 1 rank each: han declines, the
+        # tuned ladder owns it
+        sub = w.split(0 if r in (0, 3) else 1)
+        assert type(sub.c_coll['allreduce'].__self__).__name__ != 'HanModule'
+        assert sub.allreduce(np.array([1.0]))[0] == 2.0
+        print(f"han symmetric OK rank {r}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)],
+                extra=("--fake-nodes", "2"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("han symmetric OK") == 4
+
+
+def test_han_asymmetric_nodes(tmp_path):
+    """5 ranks over 2 fake nodes (3+2): the leader-based compositions
+    handle unequal node sizes."""
+    script = tmp_path / "han_asym.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        w = ompi_tpu.init()
+        r = w.rank
+        mod = w.c_coll['allreduce'].__self__
+        assert type(mod).__name__ == 'HanModule', type(mod).__name__
+        out = w.allreduce(np.full(6, float(r)))
+        assert np.allclose(out, 10.0), out
+        b = w.bcast(np.array([3.25]) if r == 4 else np.zeros(1), root=4)
+        assert b[0] == 3.25
+        g = w.allgather(np.array([r + 1], np.int64))
+        assert np.asarray(g).ravel().tolist() == [1, 2, 3, 4, 5]
+        gat = w.gather(np.array([float(r)]), root=2)
+        if r == 2:
+            assert gat.ravel().tolist() == [0.0, 1.0, 2.0, 3.0, 4.0]
+        sc = w.scatter(np.arange(5., dtype=np.float64).reshape(5, 1) * 3
+                       if r == 0 else np.zeros(1), root=0)
+        assert sc[0] == r * 3.0
+        w.barrier()
+        print(f"han asymmetric OK rank {r}")
+    """))
+    r = _tpurun(5, [sys.executable, str(script)],
+                extra=("--fake-nodes", "2"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("han asymmetric OK") == 5
+
+
+def test_han_single_node_declines(tmp_path):
+    """Without --fake-nodes every rank shares one node: han must NOT
+    select (the reference disqualifies itself the same way)."""
+    script = tmp_path / "no_han.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        w = ompi_tpu.init()
+        assert type(w.c_coll['allreduce'].__self__).__name__ != 'HanModule'
+        assert w.allreduce(np.ones(1))[0] == 2.0
+        print("no-han OK")
+    """))
+    r = _tpurun(2, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("no-han OK") == 2
+
+
+def test_device_hierarchical_allreduce():
+    """2x4 ('dcn', 'ici') mesh on the 8-device CPU backend: the two-level
+    trace-time composition equals a flat global reduction."""
+    import jax
+
+    from ompi_tpu.mca.coll.han import XlaHierarchicalColl
+
+    devs = jax.devices()[:8]
+    h = XlaHierarchicalColl(devs, n_up=2, n_low=4)
+
+    # divisible inner dim: psum_scatter/psum/all_gather path
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    out = np.asarray(h.allreduce(x))
+    assert out.shape == (16,)
+    assert np.allclose(out, x.sum(0))
+
+    # non-divisible (1-elem rows): plain two-axis psum path
+    y = np.linspace(0, 1, 8, dtype=np.float32).reshape(8)
+    out = np.asarray(h.allreduce(y))
+    assert np.allclose(out, y.sum())
+
+
+def test_device_hierarchical_reduce_scatter():
+    import jax
+
+    from ompi_tpu.mca.coll.han import XlaHierarchicalColl
+
+    devs = jax.devices()[:8]
+    h = XlaHierarchicalColl(devs, n_up=2, n_low=4)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 8, 4)).astype(np.float32)
+    out = np.asarray(h.reduce_scatter(x))
+    assert out.shape == (8, 4)
+    expect = x.sum(0)  # (8, 4): row i belongs to device i
+    assert np.allclose(out, expect, atol=1e-5)
