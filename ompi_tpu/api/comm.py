@@ -362,12 +362,21 @@ class Comm(AttributeHost):
         return self.pml.mprobe(self, source, tag, blocking=False)
 
     def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        from ompi_tpu.api.request import waitall
+
+        waitall(self.isend_obj(obj, dest, tag))
+
+    def isend_obj(self, obj: Any, dest: int, tag: int = 0) -> list:
+        """Nonblocking ``send_obj``: returns the requests to waitall.
+
+        The payload buffer is referenced by the returned requests, so the
+        caller only needs to keep the request list alive.
+        """
         import pickle
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
         hdr = np.array([payload.size], dtype=np.int64)
-        self.send(hdr, dest, tag)
-        self.send(payload, dest, tag)
+        return [self.isend(hdr, dest, tag), self.isend(payload, dest, tag)]
 
     def recv_obj(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Any:
         import pickle

@@ -1,0 +1,398 @@
+"""File — the MPI-IO surface object (``MPI_File``).
+
+Re-design of ``/root/reference/ompi/file/file.c`` + the ``MPI_File_*``
+bindings (``ompi/mpi/c/file_*.c``): a File is opened collectively on a
+communicator, carries an access mode, a file view (disp, etype, filetype),
+an individual file pointer, and a *shared* file pointer, and dispatches
+every I/O operation to the io module selected for it (``mca/io/base``).
+
+Buffers are numpy arrays (count/type inferred) or ``(array, count,
+Datatype)`` triples; non-contiguous memory layouts go through the datatype
+convertor's pack/unpack, and non-contiguous *file* layouts through the
+view's filetype — the same duality the reference's convertor + file-view
+machinery provides.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.request import CompletedRequest, Request
+from ompi_tpu.datatype import BYTE, Datatype
+from ompi_tpu.datatype.convertor import Convertor
+
+# amode flags (MPI_MODE_*)
+MODE_RDONLY = 0x01
+MODE_WRONLY = 0x02
+MODE_RDWR = 0x04
+MODE_CREATE = 0x08
+MODE_EXCL = 0x10
+MODE_DELETE_ON_CLOSE = 0x20
+MODE_APPEND = 0x40
+MODE_UNIQUE_OPEN = 0x80
+MODE_SEQUENTIAL = 0x100
+
+SEEK_SET, SEEK_CUR, SEEK_END = 0, 1, 2
+
+_MODE_CHARS = {"r": MODE_RDONLY, "w": MODE_WRONLY, "+": MODE_RDWR,
+               "c": MODE_CREATE, "x": MODE_EXCL, "a": MODE_APPEND,
+               "d": MODE_DELETE_ON_CLOSE}
+
+
+def _parse_amode(amode) -> int:
+    if isinstance(amode, int):
+        return amode
+    flags = 0
+    for ch in amode:
+        if ch not in _MODE_CHARS:
+            raise MpiError(ErrorClass.ERR_AMODE, f"bad amode char {ch!r}")
+        flags |= _MODE_CHARS[ch]
+    return flags
+
+
+def _buffer_to_bytes(buf) -> tuple[bytes, Any]:
+    """Pack a user buffer to its data-stream bytes (+ keepalive array)."""
+    if isinstance(buf, tuple):
+        arr, count, dt = buf
+        arr = np.asarray(arr)
+        if dt.is_contiguous and arr.flags.c_contiguous:
+            data = arr.tobytes()[:count * dt.size]
+        else:
+            conv = Convertor(dt, count).prepare(arr)
+            data = conv.pack()
+        return data, arr
+    arr = np.ascontiguousarray(buf)
+    return arr.tobytes(), arr
+
+
+def _stream_nbytes(buf) -> int:
+    """Data-stream byte size of a buffer spec without packing it."""
+    if isinstance(buf, tuple):
+        _, count, dt = buf
+        return count * dt.size
+    return np.asarray(buf).nbytes
+
+
+def _bytes_to_buffer(data: bytes, buf) -> int:
+    """Unpack stream bytes into the user buffer; returns element count."""
+    if isinstance(buf, tuple):
+        arr, count, dt = buf
+        arr = np.asarray(arr)
+        conv = Convertor(dt, count).prepare(arr)
+        return conv.unpack(data) // max(1, dt.size) if dt.size else 0
+    arr = np.asarray(buf)
+    if not arr.flags.c_contiguous:
+        raise MpiError(ErrorClass.ERR_BUFFER,
+                       "read into non-contiguous memory requires an "
+                       "(array, count, Datatype) buffer triple")
+    flat = arr.reshape(-1).view(np.uint8)
+    n = min(len(data), flat.nbytes)
+    flat[:n] = np.frombuffer(data, np.uint8, count=n)
+    return n // max(1, arr.dtype.itemsize)
+
+
+class File:
+    """An open MPI file.  Create with ``File.open(comm, name, amode)``."""
+
+    def __init__(self, comm, filename: str, amode: int, fd: int) -> None:
+        self.comm = comm
+        self.filename = filename
+        self.amode = amode
+        self.fd = fd
+        self.closed = False
+        self.atomicity = False
+        self.io_module = None      # set by file_select
+        # default view: displacement 0, byte stream
+        self.disp = 0
+        self.etype: Datatype = BYTE
+        self.filetype: Datatype = BYTE
+        self._fp = 0               # individual pointer, etype units
+        self._sfp_key = f"__sfp__:{os.path.abspath(filename)}"
+
+    # -- open / close -----------------------------------------------------
+    @classmethod
+    def open(cls, comm, filename: str, amode="rc",
+             info=None) -> "File":
+        """Collective open (``MPI_File_open``).
+
+        ``amode`` is an int of MODE_* flags or a string: r/w/+ access,
+        c(reate), x(excl), a(ppend), d(elete-on-close).
+        """
+        flags = _parse_amode(amode)
+        access = bool(flags & (MODE_RDONLY | MODE_WRONLY | MODE_RDWR))
+        if not access:
+            flags |= MODE_RDWR
+        osflags = os.O_RDONLY
+        if flags & MODE_RDWR or (flags & MODE_RDONLY and flags & MODE_WRONLY):
+            osflags = os.O_RDWR
+        elif flags & MODE_WRONLY:
+            osflags = os.O_WRONLY
+        if flags & MODE_CREATE:
+            osflags |= os.O_CREAT
+        if flags & MODE_APPEND:
+            osflags |= os.O_APPEND
+        rank = comm.rank if comm is not None else 0
+        # rank 0 creates (EXCL races resolved there), others open after
+        if comm is not None and comm.size > 1:
+            err = ""
+            if rank == 0:
+                try:
+                    excl = osflags | (os.O_EXCL if flags & MODE_EXCL else 0)
+                    fd = os.open(filename, excl, 0o644)
+                except OSError as exc:
+                    err, fd = str(exc), -1
+                comm.bcast(np.array([fd >= 0], np.int8), root=0)
+                if fd < 0:
+                    raise MpiError(ErrorClass.ERR_IO,
+                                   f"cannot open {filename!r}: {err}")
+            else:
+                ok = comm.bcast(np.zeros(1, np.int8), root=0)
+                if not int(ok[0]):
+                    raise MpiError(ErrorClass.ERR_IO,
+                                   f"cannot open {filename!r} (root failed)")
+                fd = os.open(filename, osflags & ~os.O_CREAT
+                             if not flags & MODE_CREATE else osflags, 0o644)
+        else:
+            excl = osflags | (os.O_EXCL if flags & MODE_EXCL else 0)
+            try:
+                fd = os.open(filename, excl, 0o644)
+            except OSError as exc:
+                raise MpiError(ErrorClass.ERR_IO,
+                               f"cannot open {filename!r}: {exc}")
+        f = cls(comm, filename, flags, fd)
+        from ompi_tpu.mca.io.base import file_select
+
+        file_select(f)
+        # per-open shared-pointer counter: a fresh key per collective open
+        # (so reopened or concurrently-opened handles of the same path
+        # don't share or inherit a stale counter), starting at 0
+        client = f._sfp_client()
+        if comm is not None and comm.size > 1:
+            seq = np.zeros(1, np.int64)
+            if rank == 0 and client is not None:
+                seq[0] = client.fetch_add(-1, "__sfp_open_seq__", 1)
+            seq = comm.bcast(seq, root=0)
+            f._sfp_key += f":open{int(seq[0])}"
+            if rank == 0:
+                f._shared_reset(0)
+            comm.barrier()   # reset visible before anyone's first I/O
+        else:
+            f._shared_reset(0)
+        return f
+
+    @staticmethod
+    def delete(filename: str) -> None:
+        try:
+            os.unlink(filename)
+        except FileNotFoundError as exc:
+            raise MpiError(ErrorClass.ERR_FILE, str(exc))
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        if self.comm is not None and self.comm.size > 1:
+            self.comm.barrier()
+        os.close(self.fd)
+        if self.amode & MODE_DELETE_ON_CLOSE:
+            if self.comm is None or self.comm.rank == 0:
+                try:
+                    os.unlink(self.filename)
+                except FileNotFoundError:
+                    pass
+        self.closed = True
+
+    def _check(self) -> None:
+        if self.closed:
+            raise MpiError(ErrorClass.ERR_FILE, "file is closed")
+
+    # -- view -------------------------------------------------------------
+    def set_view(self, disp: int = 0, etype: Optional[Datatype] = None,
+                 filetype: Optional[Datatype] = None,
+                 datarep: str = "native", info=None) -> None:
+        self._check()
+        self.disp = disp
+        self.etype = etype or BYTE
+        self.filetype = filetype or self.etype
+        if self.filetype.size % max(1, self.etype.size):
+            raise MpiError(ErrorClass.ERR_ARG,
+                           "filetype size must be a multiple of etype size")
+        if datarep != "native":
+            raise MpiError(ErrorClass.ERR_UNSUPPORTED_DATAREP
+                           if hasattr(ErrorClass, "ERR_UNSUPPORTED_DATAREP")
+                           else ErrorClass.ERR_ARG,
+                           f"unsupported datarep {datarep!r}")
+        self._fp = 0
+        if self.comm is None or self.comm.rank == 0:
+            self._shared_reset(0)
+        if self.comm is not None and self.comm.size > 1:
+            # set_view is collective: nobody may issue shared-pointer I/O
+            # until the reset has happened (rank 0 resets before its
+            # barrier arrival releases the others)
+            self.comm.barrier()
+
+    def get_view(self) -> tuple:
+        return self.disp, self.etype, self.filetype
+
+    # -- explicit-offset I/O ---------------------------------------------
+    def write_at(self, offset: int, buf) -> int:
+        self._check()
+        data, _ = _buffer_to_bytes(buf)
+        return self.io_module.write_at(self, offset, data)
+
+    def read_at(self, offset: int, buf) -> int:
+        self._check()
+        data = self.io_module.read_at(self, offset, _stream_nbytes(buf))
+        return _bytes_to_buffer(data, buf)
+
+    def write_at_all(self, offset: int, buf) -> int:
+        self._check()
+        data, _ = _buffer_to_bytes(buf)
+        return self.io_module.write_at_all(self, offset, data)
+
+    def read_at_all(self, offset: int, buf) -> int:
+        self._check()
+        data = self.io_module.read_at_all(self, offset, _stream_nbytes(buf))
+        return _bytes_to_buffer(data, buf)
+
+    # nonblocking variants (MPI_File_iwrite_at & friends): the I/O path is
+    # synchronous POSIX, so requests complete eagerly — same shape the
+    # device collectives use (the XLA stream / page cache is the engine)
+    def iwrite_at(self, offset: int, buf) -> Request:
+        r = CompletedRequest()
+        r.result = self.write_at(offset, buf)
+        return r
+
+    def iread_at(self, offset: int, buf) -> Request:
+        r = CompletedRequest()
+        r.result = self.read_at(offset, buf)
+        return r
+
+    # -- individual-pointer I/O ------------------------------------------
+    def _advance(self, buf, n_elems_bytes: int) -> None:
+        self._fp += n_elems_bytes // max(1, self.etype.size)
+
+    def write(self, buf) -> int:
+        self._check()
+        data, _ = _buffer_to_bytes(buf)
+        n = self.io_module.write_at(self, self._fp, data)
+        self._advance(buf, len(data))
+        return n
+
+    def read(self, buf) -> int:
+        self._check()
+        data = self.io_module.read_at(self, self._fp, _stream_nbytes(buf))
+        self._advance(buf, len(data))
+        return _bytes_to_buffer(data, buf)
+
+    def write_all(self, buf) -> int:
+        self._check()
+        data, _ = _buffer_to_bytes(buf)
+        n = self.io_module.write_at_all(self, self._fp, data)
+        self._advance(buf, len(data))
+        return n
+
+    def read_all(self, buf) -> int:
+        self._check()
+        data = self.io_module.read_at_all(self, self._fp, _stream_nbytes(buf))
+        self._advance(buf, len(data))
+        return _bytes_to_buffer(data, buf)
+
+    def seek(self, offset: int, whence: int = SEEK_SET) -> None:
+        self._check()
+        if whence == SEEK_SET:
+            self._fp = offset
+        elif whence == SEEK_CUR:
+            self._fp += offset
+        elif whence == SEEK_END:
+            size_et = self.get_size() // max(1, self.etype.size)
+            self._fp = size_et + offset
+        else:
+            raise MpiError(ErrorClass.ERR_ARG, f"bad whence {whence}")
+        if self._fp < 0:
+            raise MpiError(ErrorClass.ERR_ARG, "negative file position")
+
+    def get_position(self) -> int:
+        return self._fp
+
+    # -- shared-pointer I/O (sharedfp framework) -------------------------
+    def _sfp_client(self):
+        rte = self.comm.rte if self.comm is not None else None
+        return getattr(rte, "client", None)
+
+    def _shared_fetch_add(self, delta: int) -> int:
+        client = self._sfp_client()
+        if client is not None:
+            return client.fetch_add(-1, self._sfp_key, delta)
+        # single-process models: plain local counter
+        cur = getattr(self, "_local_sfp", 0)
+        self._local_sfp = cur + delta
+        return cur
+
+    def _shared_reset(self, value: int = 0) -> None:
+        """Set the shared pointer (one atomic put; MPI requires the shared
+        pointer to be 0 at open and reset by set_view)."""
+        client = self._sfp_client()
+        if client is not None:
+            client.put(-1, self._sfp_key, value)
+        else:
+            self._local_sfp = value
+
+    def write_shared(self, buf) -> int:
+        self._check()
+        data, _ = _buffer_to_bytes(buf)
+        n_et = -(-len(data) // max(1, self.etype.size))
+        pos = self._shared_fetch_add(n_et)
+        return self.io_module.write_at(self, pos, data)
+
+    def read_shared(self, buf) -> int:
+        self._check()
+        nbytes = _stream_nbytes(buf)
+        n_et = -(-nbytes // max(1, self.etype.size))
+        pos = self._shared_fetch_add(n_et)
+        data = self.io_module.read_at(self, pos, nbytes)
+        return _bytes_to_buffer(data, buf)
+
+    def seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
+        """Collective in MPI; here any rank may reset the shared counter."""
+        self._shared_reset(offset)
+
+    # -- fs passthrough ---------------------------------------------------
+    def get_size(self) -> int:
+        self._check()
+        return self.io_module.get_size(self)
+
+    def set_size(self, size: int) -> None:
+        self._check()
+        self.io_module.set_size(self, size)
+
+    def preallocate(self, size: int) -> None:
+        self._check()
+        self.io_module.preallocate(self, size)
+
+    def sync(self) -> None:
+        self._check()
+        self.io_module.sync(self)
+
+    def get_amode(self) -> int:
+        return self.amode
+
+    def get_group(self):
+        return self.comm.group if self.comm is not None else None
+
+    def set_atomicity(self, flag: bool) -> None:
+        self.atomicity = bool(flag)
+
+    def get_atomicity(self) -> bool:
+        return self.atomicity
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:
+        return f"File({self.filename!r}, fd={self.fd})"

@@ -1,0 +1,8 @@
+"""io — MPI-IO framework (``/root/reference/ompi/mca/io/``).
+
+Components compete per-file the way coll components compete per-comm:
+``file_query(file)`` returns ``(priority, module)``; the highest priority
+wins and its module serves every I/O operation on that file.  The single
+built-in component is ``ompio`` — a re-design of the reference's native
+MPI-IO stack (io/ompio + fs + fbtl + fcoll + sharedfp sub-frameworks).
+"""

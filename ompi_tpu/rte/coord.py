@@ -101,6 +101,15 @@ class CoordServer:
                             self._kv_cond.notify_all()
                         val = self._kv[k]
                     _send_frame(conn, {"ok": True, "value": val})
+                elif op == "fetch_add":
+                    # atomic counter (shared file pointers, spawn ids):
+                    # returns the PRE-add value, like MPI_Fetch_and_op SUM
+                    with self._kv_cond:
+                        k = (req["rank"], req["key"])
+                        old = self._kv.get(k, 0)
+                        self._kv[k] = old + req["delta"]
+                        self._kv_cond.notify_all()
+                    _send_frame(conn, {"ok": True, "value": old})
                 elif op == "get":
                     deadline = time.monotonic() + req.get("timeout", 60.0)
                     with self._kv_cond:
@@ -221,6 +230,11 @@ class CoordClient:
         """Atomic put-if-absent; returns the winning (stored) value."""
         return self._rpc(op="put_new", rank=rank, key=key,
                          value=value)["value"]
+
+    def fetch_add(self, rank: int, key: str, delta: int) -> int:
+        """Atomic fetch-and-add on a coord counter; returns the old value."""
+        return self._rpc(op="fetch_add", rank=rank, key=key,
+                         delta=delta)["value"]
 
     def delete(self, rank: int, key: str) -> None:
         self._rpc(op="del", rank=rank, key=key)

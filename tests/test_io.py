@@ -1,0 +1,269 @@
+"""MPI-IO: File object, views, individual + collective I/O, sharedfp.
+
+Mirrors the reference's io test strategy (SURVEY §4): datatype-view
+round-trips single-process, then tpurun multi-rank collective I/O with the
+two-phase fcoll path, ending in the SURVEY Phase-6 payoff — a sharded-array
+checkpoint written and restored through subarray file views across 4 ranks.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _tpurun(n, args, timeout=120, extra=()):
+    env = dict(os.environ)
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+         *extra, *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+# -- single-process: views + fbtl ---------------------------------------
+
+def test_view_extents_contiguous_and_vector():
+    from ompi_tpu.datatype import FLOAT64, core
+    from ompi_tpu.mca.io.ompio import view_extents
+
+    # contiguous byte view
+    runs = list(view_extents(0, core.BYTE, 3, 5))
+    assert runs == [(3, 5)]
+    # vector view: 2 doubles every 4 doubles → stream maps to strided file
+    ft = core.vector(2, 2, 4, FLOAT64)
+    runs = list(view_extents(100, ft, 0, 48))
+    # tile = 32 data bytes over extent 8*... : first tile two blocks of 16
+    assert runs[0] == (100, 16)
+    assert runs[1] == (100 + 32, 16)
+    assert sum(ln for _, ln in runs) == 48
+
+
+def test_file_individual_roundtrip(tmp_path):
+    import ompi_tpu
+    from ompi_tpu.api.file import File
+
+    path = str(tmp_path / "ind.dat")
+    w = ompi_tpu.init()
+    f = File.open(ompi_tpu.COMM_SELF, path, "c+")
+    data = np.arange(100, dtype=np.float32)
+    assert f.write_at(0, data) == 400
+    back = np.zeros(100, np.float32)
+    assert f.read_at(0, back) == 100
+    assert np.array_equal(back, data)
+    # individual pointer I/O
+    f.seek(0)
+    f.write(np.array([7, 8, 9], np.int64))
+    assert f.get_position() == 24
+    f.seek(8)
+    one = np.zeros(1, np.int64)
+    f.read(one)
+    assert one[0] == 8
+    assert f.get_size() == 400
+    f.set_size(16)
+    assert f.get_size() == 16
+    f.close()
+    File.delete(path)
+    assert not os.path.exists(path)
+
+
+def test_file_strided_view(tmp_path):
+    """A vector filetype interleaves two ranks' columns in one file."""
+    import ompi_tpu
+    from ompi_tpu.api.file import File
+    from ompi_tpu.datatype import FLOAT64, core
+
+    path = str(tmp_path / "view.dat")
+    f = File.open(ompi_tpu.COMM_SELF, path, "c+")
+    # even slots through a 1-every-2 vector view
+    ft = core.vector(4, 1, 2, FLOAT64)
+    f.set_view(0, FLOAT64, ft)
+    f.write_at(0, np.array([1., 2., 3., 4.]))
+    # odd slots: same view displaced one double
+    f.set_view(8, FLOAT64, ft)
+    f.write_at(0, np.array([10., 20., 30., 40.]))
+    f.set_view(0, FLOAT64, FLOAT64)   # flat view
+    allv = np.zeros(8)
+    f.read_at(0, allv)
+    assert allv.tolist() == [1., 10., 2., 20., 3., 30., 4., 40.]
+    f.close()
+
+
+def test_file_datatype_buffer_triple(tmp_path):
+    """Non-contiguous MEMORY through the convertor pack/unpack path."""
+    import ompi_tpu
+    from ompi_tpu.api.file import File
+    from ompi_tpu.datatype import FLOAT64, core
+
+    from ompi_tpu.api.errors import MpiError
+
+    path = str(tmp_path / "triple.dat")
+    f = File.open(ompi_tpu.COMM_SELF, path, "c+")
+    mem = np.arange(8, dtype=np.float64)
+    # memory type: every other element (vector 4x1 stride 2)
+    mt = core.vector(4, 1, 2, FLOAT64)
+    f.write_at(0, (mem, 1, mt))            # writes 0,2,4,6
+    back = np.zeros(4)
+    f.read_at(0, back)
+    assert back.tolist() == [0., 2., 4., 6.]
+    # read back into strided memory
+    dst = np.zeros(8)
+    f.read_at(0, (dst, 1, mt))
+    assert dst.tolist() == [0., 0., 2., 0., 4., 0., 6., 0.]
+    # pointer-based triple read: advances by the STREAM size (32 bytes),
+    # not the destination array's 64 bytes
+    f.seek(0)
+    dst2 = np.zeros(8)
+    f.read((dst2, 1, mt))
+    assert f.get_position() == 32
+    assert dst2.tolist() == [0., 0., 2., 0., 4., 0., 6., 0.]
+    with pytest.raises(MpiError):
+        f.seek(0, whence=9)
+    f.close()
+    with pytest.raises(MpiError):
+        f.write(np.zeros(1))   # closed file must error, not hit a stale fd
+
+
+def test_file_errors(tmp_path):
+    import ompi_tpu
+    from ompi_tpu.api.errors import MpiError
+    from ompi_tpu.api.file import File
+
+    with pytest.raises(MpiError):
+        File.delete(str(tmp_path / "missing.dat"))
+    f = File.open(ompi_tpu.COMM_SELF, str(tmp_path / "e.dat"), "c+")
+    f.close()
+    with pytest.raises(MpiError):
+        f.read_at(0, np.zeros(1))
+    with pytest.raises(MpiError):
+        File.open(ompi_tpu.COMM_SELF, str(tmp_path / "e.dat"), "cx+")
+
+
+# -- multi-process: collective I/O + sharedfp ---------------------------
+
+def test_mp_collective_write_read(tmp_path):
+    """4 ranks interleave blocks via write_at_all (two-phase), read back
+    with read_at_all, and exercise the shared file pointer."""
+    path = tmp_path / "coll.dat"
+    script = tmp_path / "coll_io.py"
+    script.write_text(textwrap.dedent(f"""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.api.file import File
+        w = ompi_tpu.init()
+        r = w.rank
+        f = File.open(w, {str(path)!r}, "c+")
+        # rank r owns bytes [r*32, (r+1)*32): contiguous blocks
+        # (offsets are in etype units = bytes under the default view)
+        data = np.full(8, float(r), np.float32)
+        f.write_at_all(r * 32, data)
+        # overlapping read: everyone reads the whole file collectively
+        back = np.zeros(32, np.float32)
+        f.read_at_all(0, back)
+        expect = np.repeat(np.arange(4, dtype=np.float32), 8)
+        assert np.array_equal(back, expect), back
+        # shared file pointer: every rank appends one record; records are
+        # disjoint and cover 4 slots
+        f.set_view(128, None, None)   # past the collective region
+        rec = np.full(2, 100.0 + r, np.float32)
+        f.write_shared(rec)
+        w.barrier()
+        tail = np.zeros(8, np.float32)
+        f.read_at(0, tail)
+        got = sorted(set(tail.tolist()))
+        assert got == [100.0, 101.0, 102.0, 103.0], tail
+        f.close()
+        # reopening must start the shared pointer at 0 again (no leak of
+        # the previous open's counter)
+        f3 = File.open(w, {str(path)!r}, "+")
+        f3.set_view(128, None, None)
+        one = np.zeros(2, np.float32)
+        f3.read_shared(one)
+        assert one[0] in (100.0, 101.0, 102.0, 103.0), one
+        f3.close()
+        print(f"coll io OK rank {{r}}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("coll io OK") == 4
+
+
+def test_mp_sharded_checkpoint_subarray(tmp_path):
+    """SURVEY Phase-6 payoff: a (8, 8) global array sharded 2x2 across 4
+    ranks checkpoints through subarray file views with write_at_all and
+    restores through the same views — and the file equals the dense
+    row-major global array."""
+    path = tmp_path / "ckpt.dat"
+    script = tmp_path / "ckpt.py"
+    script.write_text(textwrap.dedent(f"""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.api.file import File
+        from ompi_tpu.datatype import FLOAT64, core
+        w = ompi_tpu.init()
+        r = w.rank
+        G, B = 8, 4                     # global 8x8, 4x4 blocks, 2x2 grid
+        gi, gj = divmod(r, 2)
+        block = (np.arange(B * B, dtype=np.float64).reshape(B, B)
+                 + 100.0 * r)
+        ft = core.subarray([G, G], [B, B], [gi * B, gj * B],
+                           core.ORDER_C, FLOAT64)
+        f = File.open(w, {str(path)!r}, "c+")
+        f.set_view(0, FLOAT64, ft)
+        f.write_at_all(0, block)        # collective checkpoint
+        f.close()
+
+        # restore through the same view
+        f2 = File.open(w, {str(path)!r}, "r")
+        f2.set_view(0, FLOAT64, ft)
+        back = np.zeros((B, B))
+        f2.read_at_all(0, back)
+        assert np.array_equal(back, block), (r, back)
+        f2.close()
+
+        # rank 0 validates the dense file layout
+        if r == 0:
+            whole = np.fromfile({str(path)!r}, np.float64).reshape(G, G)
+            for rr in range(4):
+                i, j = divmod(rr, 2)
+                expect = (np.arange(16, dtype=np.float64).reshape(4, 4)
+                          + 100.0 * rr)
+                assert np.array_equal(
+                    whole[i*4:(i+1)*4, j*4:(j+1)*4], expect), rr
+        w.barrier()
+        print(f"checkpoint OK rank {{r}}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("checkpoint OK") == 4
+
+
+def test_mp_two_aggregator_fcoll(tmp_path):
+    """Force 2 aggregators so the aggregator-to-aggregator piece exchange
+    path runs (the deadlock-prone corner of two-phase I/O)."""
+    path = tmp_path / "agg2.dat"
+    script = tmp_path / "agg2.py"
+    script.write_text(textwrap.dedent(f"""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.api.file import File
+        w = ompi_tpu.init()
+        r = w.rank
+        f = File.open(w, {str(path)!r}, "c+")
+        # strided interleave: rank r writes 4-byte words at stride 4
+        data = np.full(64, r + 1, np.uint8)
+        f.write_at_all(r * 64, data)
+        back = np.zeros(256, np.uint8)
+        f.read_at_all(0, back)
+        expect = np.repeat(np.arange(1, 5, dtype=np.uint8), 64)
+        assert np.array_equal(back, expect)
+        f.close()
+        print(f"agg2 OK rank {{r}}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)],
+                extra=("--mca", "io_ompio_num_aggregators", "2"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("agg2 OK") == 4
