@@ -1,0 +1,34 @@
+"""PGAS ring over the OpenSHMEM-style layer: each PE writes a token into
+its right neighbor's symmetric slot, then the reduction closes the loop.
+
+Run:  python -m ompi_tpu.tools.tpurun -n 4 python examples/pgas_ring.py
+"""
+import numpy as np
+
+import ompi_tpu.shmem as shmem
+
+shmem.init()
+me, n = shmem.my_pe(), shmem.n_pes()
+
+slot = shmem.array(1, np.int64)
+slot.local[0] = -1
+shmem.barrier_all()
+
+# put my id into my right neighbor's slot
+shmem.p(slot, me, (me + 1) % n)
+shmem.barrier_all()
+
+left = (me - 1) % n
+assert slot.local[0] == left, (me, slot.local)
+
+# atomic ring accounting on PE 0
+counter = shmem.array(1, np.int64)
+counter.local[0] = 0
+shmem.barrier_all()
+shmem.atomic_add(counter, me + 1, 0)
+shmem.barrier_all()
+if me == 0:
+    total = counter.local[0]
+    assert total == n * (n + 1) // 2, total
+    print(f"pgas ring OK: {n} PEs, counter {total}")
+shmem.barrier_all()

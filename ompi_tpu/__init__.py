@@ -32,6 +32,9 @@ _API = {
     "Win": "ompi_tpu.api.win",
     "File": "ompi_tpu.api.file",
     "Status": "ompi_tpu.api.status",
+    # dynamic process management (MPI_Comm_get_parent / ports)
+    "get_parent": "ompi_tpu.dpm",
+    "open_port": "ompi_tpu.dpm",
     # built-in reduction operators (MPI_SUM & friends)
     "SUM": "ompi_tpu.api.op",
     "PROD": "ompi_tpu.api.op",

@@ -142,10 +142,13 @@ class Comm(AttributeHost):
         if peer is not None and peer not in (ANY_SOURCE, PROC_NULL):
             if not 0 <= peer < (self.remote_size if self.is_inter else self.size):
                 raise MpiError(ErrorClass.ERR_RANK, f"invalid rank {peer}")
-            # ULFM early liveness check (send.c:84)
+            # ULFM early liveness check (send.c:84); an intercomm peer
+            # rank indexes the remote group
             from ompi_tpu.ft import state as ft_state
 
-            if ft_state.is_failed(self.world_rank(peer)):
+            peer_world = (self.remote_group if self.is_inter
+                          else self.group).world_rank(peer)
+            if ft_state.is_failed(peer_world):
                 from ompi_tpu.api.errors import ProcFailedError
 
                 self._err(ProcFailedError(
@@ -754,6 +757,27 @@ class Comm(AttributeHost):
             rt.retire_cid(self.cid)
         self.freed = True
 
+    # -- dynamic process management (``ompi/dpm``) ----------------------
+    def spawn(self, command, maxprocs: int, root: int = 0) -> "Comm":
+        from ompi_tpu import dpm
+
+        return dpm.spawn(self, command, maxprocs, root)
+
+    def accept(self, port: str, root: int = 0) -> "Comm":
+        from ompi_tpu import dpm
+
+        return dpm.accept(self, port, root)
+
+    def connect(self, port: str, root: int = 0) -> "Comm":
+        from ompi_tpu import dpm
+
+        return dpm.connect(self, port, root)
+
+    def merge(self, high: bool = False) -> "Comm":
+        from ompi_tpu import dpm
+
+        return dpm.merge(self, high)
+
     def abort(self, errorcode: int = 1) -> None:
         from ompi_tpu.runtime import init as rt
 
@@ -798,11 +822,22 @@ class Comm(AttributeHost):
             self, "_acked_failed", frozenset())
         return len(self._acked_failed)
 
+    @property
+    def ft_scope(self) -> str:
+        """Revocation scope: job-local CIDs are scoped to the job (a
+        dpm-spawned job's cid-0 COMM_WORLD must not inherit the parent
+        job's revoked cid 0); bridge CIDs (>= 2^20) are globally unique
+        and share one scope."""
+        if self.cid >= (1 << 20):
+            return "#bridge"
+        return str(getattr(self.rte, "job", "0"))
+
     def is_revoked(self) -> bool:
         if not self.revoked:
             from ompi_tpu.ft import state as ft_state
 
-            if ft_state.is_comm_revoked(self.cid, self.epoch):
+            if ft_state.is_comm_revoked(self.cid, self.epoch,
+                                        self.ft_scope):
                 self.revoked = True
         return self.revoked
 

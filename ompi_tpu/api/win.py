@@ -32,6 +32,9 @@ class Win(AttributeHost):
         self.name = name or f"win#{comm.cid}"
         self.module = None          # selected osc module
         self.freed = False
+        # a byte-addressed window (symmetric heap): offsets are bytes and
+        # typed RMA ops reinterpret target bytes as the origin dtype
+        self.byte_addressed = False
 
     # -- creation (collective) ------------------------------------------
     @classmethod

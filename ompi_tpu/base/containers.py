@@ -5,8 +5,11 @@ TPU-native equivalents of the reference class/container library
 pointer array, bitmap, ring buffer, hotel, graph; 10,572 LoC of OO-in-C).
 Python's object model replaces the ``opal_object_t`` refcounting scheme
 (``opal/class/opal_object.h:1-526``); what carries over are the containers with
-framework-specific semantics.  Hot-path lock-free fifo/lifo have native C++
-twins in ``native/`` (see ``ompi_tpu.native``).
+framework-specific semantics.  The hot cross-process paths have native C++
+twins in ``ompi_tpu.native`` (the btl/sm SPSC ring and the datatype pack
+loops — the ``opal_fifo`` / ``opal_datatype_pack.c`` analogs); the
+in-process containers here stay Python, where the interpreter is not the
+bottleneck.
 """
 from __future__ import annotations
 

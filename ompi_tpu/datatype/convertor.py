@@ -62,6 +62,8 @@ class Convertor:
         self.position = 0
         self.checksum = 0
         segs = datatype.segments
+        self._native = None
+        self._seg_offs = np.array([s.offset for s in segs], dtype=np.int64)
         self._seg_lens = np.array([s.nbytes for s in segs], dtype=np.int64)
         self._seg_prefix = np.concatenate(
             ([0], np.cumsum(self._seg_lens)))  # len nseg+1
@@ -140,9 +142,25 @@ class Convertor:
 
     def _full_element_copy(self, first_elem: int, nelem: int,
                            packed: np.ndarray, to_packed: bool) -> None:
-        """Vectorized gather/scatter of whole elements via the template."""
+        """Gather/scatter of whole elements: native C++ pack loop when the
+        library is built (``ompi_tpu.native``, the
+        ``opal_datatype_pack.c`` twin), numpy template indexing otherwise."""
         dt = self.datatype
         if nelem <= 0:
+            return
+        if self._use_native():
+            from ompi_tpu import native
+
+            view = packed[: nelem * dt.size]
+            if to_packed:
+                native.pack_elems(self._mem, view, self._seg_offs,
+                                  self._seg_lens, dt.extent,
+                                  self.base_offset, first_elem, nelem)
+            else:
+                native.unpack_elems(self._mem, np.ascontiguousarray(view),
+                                    self._seg_offs, self._seg_lens,
+                                    dt.extent, self.base_offset,
+                                    first_elem, nelem)
             return
         idx = (self.base_offset
                + (first_elem + np.arange(nelem, dtype=np.int64))[:, None]
@@ -153,6 +171,23 @@ class Convertor:
             view[:] = self._mem[idx]
         else:
             self._mem[idx] = view
+
+    def _use_native(self) -> bool:
+        if self._native is None:
+            try:
+                from ompi_tpu import native
+
+                # the native loop wins when elements are many and small
+                # (interpreter-bound); huge contiguous runs are equally
+                # fast either way
+                # writeable: native unpack memcpy's into the buffer and
+                # must not bypass numpy's read-only protection
+                self._native = (native.available()
+                                and self._mem.flags.c_contiguous
+                                and self._mem.flags.writeable)
+            except Exception:
+                self._native = False
+        return self._native
 
     def _swap_external32(self, chunk: np.ndarray, stream_start: int) -> None:
         """In-place byteswap of a packed chunk (item-aligned chunks only)."""

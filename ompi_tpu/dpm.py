@@ -1,0 +1,264 @@
+"""dpm — dynamic process management (spawn / connect / accept / merge).
+
+Re-design of ``/root/reference/ompi/dpm/dpm.c:1-2152``: the reference spawns
+via ``PMIx_Spawn`` (the launcher execs children, children PMIx_Init back,
+both sides build an intercommunicator over agreed CIDs).  Here the
+coordination service plays PMIx: ``spawn`` allocates fresh *global* world
+ranks and the launcher (tpurun) execs the children as their own job with
+their own COMM_WORLD; parent and children meet through the coord KV and an
+intercommunicator is built from the published groups.
+
+Cross-job CIDs come from the coord's atomic counter in a reserved high
+range (``comm_cid.c``'s agreement cannot run before the bridge exists; the
+reference solves this with its next_cid exchange over the bridge — the
+counter is the same decision made central).
+
+This also completes the ULFM recovery loop: shrink (degrade) → spawn
+(replace) → merge (re-form a full-size world) — the forward-recovery story
+``README.FT.ULFM.md`` leaves to the application.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.api.comm import Comm
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.api.group import Group
+
+# cross-job CIDs live far above any locally-agreed CID
+_DPM_CID_BASE = 1 << 20
+
+
+def _client(comm) -> object:
+    client = getattr(comm.rte, "client", None)
+    if client is None:
+        raise MpiError(ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                       "dynamic process management needs the coordination "
+                       "service (run under tpurun)")
+    return client
+
+
+def _new_bridge_cid(client) -> int:
+    return _DPM_CID_BASE + client.fetch_add(-1, "__dpm_cid__", 1)
+
+
+def _make_intercomm(comm, cid: int, remote_ranks: Sequence[int],
+                    name: str) -> Comm:
+    from ompi_tpu.runtime import init as rt
+
+    # bridge comms pin epoch 0: the two sides' local epochs can differ
+    # (e.g. spawn from a shrunk comm), and the revocation key
+    # (scope, cid, epoch) must match across jobs — bridge CIDs are
+    # globally unique so the epoch carries no extra information
+    inter = Comm(comm.group, cid, comm.rte, name=name, epoch=0,
+                 parent=comm, remote_group=Group(list(remote_ranks)))
+    inter.local_comm = comm       # local-side collective channel (merge)
+    rt.reserve_cid(cid)
+    comm._finish_create(inter)
+    return inter
+
+
+def spawn(comm, command: Sequence[str], maxprocs: int,
+          root: int = 0) -> Comm:
+    """``MPI_Comm_spawn``: launch ``maxprocs`` new ranks running
+    ``command``; returns the parent↔children intercommunicator.
+
+    Collective over ``comm``.  Children find their side via
+    ``get_parent()``.
+    """
+    comm._check_state()
+    info = np.zeros(2 + maxprocs, np.int64)
+    err = None
+    if comm.rank == root:
+        try:
+            client = _client(comm)
+            cid = _new_bridge_cid(client)
+            parent_ranks = ",".join(str(w) for w in comm.group.world_ranks)
+            ranks, job = client.spawn(
+                list(command), maxprocs,
+                env={"OTPU_PARENT_RANKS": parent_ranks,
+                     "OTPU_PARENT_CID": str(cid)})
+            if len(ranks) != maxprocs:
+                raise MpiError(ErrorClass.ERR_SPAWN,
+                               f"spawn returned {len(ranks)} ranks")
+            info[0] = cid
+            info[1] = maxprocs
+            info[2:2 + maxprocs] = ranks
+        except Exception as exc:
+            # error sentinel: non-roots are already blocked in the bcast
+            # and must learn the spawn failed rather than hang
+            err = exc
+            info[0] = -1
+    info = np.asarray(comm.bcast(info, root=root))
+    if int(info[0]) < 0:
+        if err is not None:
+            raise err
+        raise MpiError(ErrorClass.ERR_SPAWN, "spawn failed at root")
+    cid = int(info[0])
+    children = [int(r) for r in info[2:2 + int(info[1])]]
+    return _make_intercomm(comm, cid, children,
+                           name=f"{comm.name}~spawn")
+
+
+_parent_intercomm: Optional[Comm] = None
+
+
+def get_parent() -> Optional[Comm]:
+    """``MPI_Comm_get_parent``: the spawned side of the bridge (None in a
+    job that was not spawned)."""
+    global _parent_intercomm
+    if _parent_intercomm is not None:
+        return _parent_intercomm
+    import ompi_tpu
+
+    world = ompi_tpu.init()
+    rte = world.rte
+    parent_ranks = getattr(rte, "parent_ranks", None)
+    if not parent_ranks:
+        return None
+    cid = int(getattr(rte, "parent_cid", -1))
+    if cid < 0:
+        return None
+    _parent_intercomm = _make_intercomm(
+        world, cid, parent_ranks, name="parent~spawn")
+    return _parent_intercomm
+
+
+# -- connect / accept (MPI_Open_port / MPI_Comm_accept / MPI_Comm_connect)
+
+def open_port(comm=None) -> str:
+    """Generate a unique port name for accept/connect."""
+    import ompi_tpu
+
+    comm = comm or ompi_tpu.COMM_WORLD
+    client = _client(comm)
+    seq = client.fetch_add(-1, "__dpm_port_seq__", 1)
+    return f"otpu-port-{seq}"
+
+
+def accept(comm, port: str, root: int = 0) -> Comm:
+    """Collective: publish our group under ``port`` and wait for a
+    connector; returns the intercommunicator."""
+    comm._check_state()
+    info = np.zeros(1, np.int64)
+    if comm.rank == root:
+        client = _client(comm)
+        cid = _new_bridge_cid(client)
+        client.put(-1, f"__dpm_accept__:{port}",
+                   {"cid": cid, "ranks": list(comm.group.world_ranks)})
+        other = None
+        while other is None:   # block past the KV's 60 s get timeout
+            other = client.get(-1, f"__dpm_connect__:{port}", wait=True)
+        # consume the pairing: a later accept on this port must wait for
+        # a NEW connector, not pair with this stale one
+        client.delete(-1, f"__dpm_accept__:{port}")
+        client.delete(-1, f"__dpm_connect__:{port}")
+        info[0] = cid
+        remote = other["ranks"]
+    else:
+        remote = None
+    info = np.asarray(comm.bcast(info, root=root))
+    remote = _bcast_obj(comm, remote, root)
+    return _make_intercomm(comm, int(info[0]), remote,
+                           name=f"{comm.name}~accept")
+
+
+def connect(comm, port: str, root: int = 0) -> Comm:
+    """Collective: join the acceptor publishing ``port``."""
+    comm._check_state()
+    info = np.zeros(1, np.int64)
+    if comm.rank == root:
+        client = _client(comm)
+        other = None
+        while other is None:   # block past the KV's 60 s get timeout
+            other = client.get(-1, f"__dpm_accept__:{port}", wait=True)
+        client.put(-1, f"__dpm_connect__:{port}",
+                   {"ranks": list(comm.group.world_ranks)})
+        info[0] = other["cid"]
+        remote = other["ranks"]
+    else:
+        remote = None
+    info = np.asarray(comm.bcast(info, root=root))
+    remote = _bcast_obj(comm, remote, root)
+    return _make_intercomm(comm, int(info[0]), remote,
+                           name=f"{comm.name}~connect")
+
+
+def _bcast_obj(comm, obj, root: int):
+    """Broadcast a small picklable object over the comm."""
+    import pickle
+
+    if comm.size == 1:
+        return obj
+    if comm.rank == root:
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        comm.bcast(np.array([payload.size], np.int64), root=root)
+        comm.bcast(payload, root=root)
+        return obj
+    n = int(np.asarray(comm.bcast(np.zeros(1, np.int64), root=root))[0])
+    payload = np.asarray(comm.bcast(np.zeros(n, np.uint8), root=root))
+    return pickle.loads(payload.tobytes())
+
+
+def merge(intercomm, high: bool = False) -> Comm:
+    """``MPI_Intercomm_merge``: one intracommunicator over both groups.
+
+    The ``high=False`` group's ranks come first.  Collective over the
+    intercommunicator; the low side's root allocates the merged CID and
+    bridges it to the high side's root over intercomm p2p.
+    """
+    if not intercomm.is_inter:
+        raise MpiError(ErrorClass.ERR_COMM, "merge needs an intercomm")
+    local = getattr(intercomm, "local_comm", None)
+    if local is None:
+        raise MpiError(ErrorClass.ERR_COMM,
+                       "intercomm carries no local collective channel")
+    from ompi_tpu.runtime import init as rt
+
+    client = _client(intercomm)
+    # deterministic CID allocator: the group containing the smaller world
+    # rank allocates and bridges it over (`high` only orders ranks, below)
+    my_min = min(intercomm.group.world_ranks)
+    other_min = min(intercomm.remote_group.world_ranks)
+    i_am_low = my_min < other_min
+    buf = np.zeros(1, np.int64)
+    if i_am_low:
+        if local.rank == 0:
+            buf[0] = _new_bridge_cid(client)
+            intercomm.send(buf, 0, tag=-7)
+    else:
+        if local.rank == 0:
+            intercomm.recv(buf, 0, tag=-7)
+    cid = int(np.asarray(local.bcast(buf, root=0))[0])
+    # merged rank order: the group that passed high=False first; both
+    # sides must agree, so order by (my `high` flag exchanged via minimum
+    # world rank convention): low-world-rank group first unless IT set
+    # high=True — exchange the flags over the bridge
+    flag = np.array([1 if high else 0], np.int64)
+    oflag = np.zeros(1, np.int64)
+    if local.rank == 0:
+        if i_am_low:
+            intercomm.send(flag, 0, tag=-8)
+            intercomm.recv(oflag, 0, tag=-8)
+        else:
+            intercomm.recv(oflag, 0, tag=-8)
+            intercomm.send(flag, 0, tag=-8)
+    oflag = np.asarray(local.bcast(oflag, root=0))
+    mine = list(intercomm.group.world_ranks)
+    theirs = list(intercomm.remote_group.world_ranks)
+    if int(flag[0]) == int(oflag[0]):
+        # same flag: low-world-rank group first (MPI leaves it undefined;
+        # this is the reference's deterministic tie-break)
+        first = mine if my_min < other_min else theirs
+    else:
+        first = theirs if high else mine
+    second = theirs if first is mine else mine
+    merged = Comm(Group(first + second), cid, intercomm.rte,
+                  name=f"{intercomm.name}~merge", epoch=0,
+                  parent=local)
+    rt.reserve_cid(cid)
+    local._finish_create(merged)
+    return merged

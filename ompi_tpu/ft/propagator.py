@@ -46,10 +46,11 @@ def report_failure(rte, world_rank: int, origin: str = "unknown",
         pass  # coordination service gone: job teardown in progress
 
 
-def report_revoke(rte, cid: int, epoch: int) -> None:
-    ft_state.mark_revoked(cid, epoch)
+def report_revoke(rte, cid: int, epoch: int, job: str = "0") -> None:
+    ft_state.mark_revoked(cid, epoch, job)
     try:
-        rte.event_notify("comm_revoked", {"cid": cid, "epoch": epoch})
+        rte.event_notify("comm_revoked",
+                         {"cid": cid, "epoch": epoch, "job": job})
     except Exception:
         pass
 
@@ -101,7 +102,8 @@ class EventPoller:
                 ft_state.mark_failed(rank)
         elif name == "comm_revoked":
             ft_state.mark_revoked(int(payload["cid"]),
-                                  int(payload.get("epoch", 0)))
+                                  int(payload.get("epoch", 0)),
+                                  job=str(payload.get("job", "0")))
 
 
 _poller: Optional[EventPoller] = None

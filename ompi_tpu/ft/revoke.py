@@ -15,4 +15,5 @@ from ompi_tpu.ft import propagator
 
 def revoke(comm) -> None:
     comm.revoked = True
-    propagator.report_revoke(comm.rte, comm.cid, comm.epoch)
+    propagator.report_revoke(comm.rte, comm.cid, comm.epoch,
+                             job=comm.ft_scope)

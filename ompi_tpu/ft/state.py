@@ -13,7 +13,7 @@ from typing import Callable, Iterable
 _lock = threading.Lock()
 _failed: set[int] = set()
 _listeners: list[Callable[[int], None]] = []
-_revoked_cids: set[tuple[int, int]] = set()  # (cid, epoch)
+_revoked_cids: set[tuple] = set()  # (job, cid, epoch)
 
 
 def mark_failed(world_rank: int) -> None:
@@ -41,18 +41,18 @@ def on_failure(cb: Callable[[int], None]) -> None:
         _listeners.append(cb)
 
 
-def mark_revoked(cid: int, epoch: int = 0) -> None:
+def mark_revoked(cid: int, epoch: int = 0, job: str = "0") -> None:
     """Record a communicator revocation (``comm_ft_revoke.c``).
 
     Keyed by (cid, epoch) so a reused CID in a later epoch is not confused
     with the revoked incarnation (``comm_cid.c:73-78``).
     """
     with _lock:
-        _revoked_cids.add((cid, epoch))
+        _revoked_cids.add((job, cid, epoch))
 
 
-def is_comm_revoked(cid: int, epoch: int = 0) -> bool:
-    return (cid, epoch) in _revoked_cids
+def is_comm_revoked(cid: int, epoch: int = 0, job: str = "0") -> bool:
+    return (job, cid, epoch) in _revoked_cids
 
 
 def reset_for_testing() -> None:

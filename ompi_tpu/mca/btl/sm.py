@@ -17,6 +17,7 @@ import os
 import pickle
 import socket
 import struct
+import numpy as np
 from multiprocessing import shared_memory, resource_tracker
 from typing import Optional
 
@@ -30,7 +31,12 @@ _DATA_OFF = _HDR.size
 
 
 class _Ring:
-    """SPSC byte ring over a shared memory buffer."""
+    """SPSC byte ring over a shared memory buffer.
+
+    push/pop run through the native C++ twins (``ompi_tpu.native``
+    ring ops, the ``opal_fifo`` analog) when the library is built; the
+    layout is identical either way so mixed processes interoperate.
+    """
 
     def __init__(self, shm: shared_memory.SharedMemory, owner: bool):
         self.shm = shm
@@ -38,11 +44,28 @@ class _Ring:
         self.cap = len(shm.buf) - _DATA_OFF
         if owner:
             _HDR.pack_into(shm.buf, 0, 0, 0)
+        self._addr = None
+        self._popbuf = None
+        try:
+            from ompi_tpu import native
+
+            if native.available():
+                import ctypes
+
+                self._native = native
+                self._addr = ctypes.addressof(
+                    ctypes.c_char.from_buffer(shm.buf))
+        except Exception:
+            self._addr = None
 
     def _load(self) -> tuple[int, int]:
         return _HDR.unpack_from(self.shm.buf, 0)
 
     def push(self, payload: bytes) -> bool:
+        if self._addr is not None:
+            return self._native.ring_push(
+                self._addr, self.cap,
+                np.frombuffer(payload, np.uint8))
         head, tail = self._load()
         need = _LEN.size + len(payload)
         free = self.cap - (tail - head)
@@ -59,6 +82,13 @@ class _Ring:
         return True
 
     def pop(self) -> Optional[bytes]:
+        if self._addr is not None:
+            if self._popbuf is None:   # lazy: outbound rings never pop
+                self._popbuf = np.empty(self.cap, np.uint8)
+            n = self._native.ring_pop(self._addr, self.cap, self._popbuf)
+            if n < 0:
+                return None
+            return self._popbuf[:n].tobytes()
         head, tail = self._load()
         if tail - head < _LEN.size:
             return None
@@ -129,7 +159,10 @@ class SmBtl(Btl):
         job = os.environ.get("OTPU_COORD", "local").replace(":", "_") \
             .replace(".", "_")
         names = {}
-        for src in range(rte.world_size):
+        # inbound rings for my job's peers (global ranks under dpm); a
+        # cross-job peer has no preallocated ring and `reachable` declines
+        # it, falling back to btl/tcp
+        for src in getattr(rte, "job_ranks", range(rte.world_size)):
             if src == me:
                 continue
             name = f"otpu_{job}_{src}_{me}_{os.getpid() & 0xffff}"
@@ -144,9 +177,15 @@ class SmBtl(Btl):
     def reachable(self, world_rank: int, rte) -> Optional[Endpoint]:
         if self._rte is None or world_rank == rte.my_world_rank:
             return None
-        info = rte.modex_get(world_rank, "btl_sm_rings")
+        # non-blocking probe: same-job peers are guaranteed published by
+        # the init fence; a peer that hasn't published (a 1-rank dpm job
+        # never runs sm setup at all) must not stall the bml — tcp is the
+        # universal fallback
+        info = rte.modex_get(world_rank, "btl_sm_rings", wait=False)
         if info is None or info["host"] != self._hostname:
             return None
+        if rte.my_world_rank not in info["names"]:
+            return None   # peer has no inbound ring for me (cross-job)
         return Endpoint(self, world_rank, addr=info)
 
     def _ring_to(self, rank: int, info: dict) -> _Ring:
@@ -193,6 +232,25 @@ class SmBtl(Btl):
         return events
 
     def close(self) -> None:
+        # Flush queued writes before teardown: a request may complete once
+        # its frags are packed, so exiting with a non-empty pending queue
+        # would silently drop delivered-but-unsent data (the receiver is
+        # still draining its ring — give it a bounded window).
+        import time as _time
+
+        from ompi_tpu.ft import state as _ft_state
+
+        def _undeliverable(rank: int) -> bool:
+            return _ft_state.is_failed(rank)
+
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            live_pending = {r: f for r, f in self._pending.items()
+                            if len(f) and not _undeliverable(r)}
+            if not live_pending:
+                break
+            if self.progress() == 0:
+                _time.sleep(0.0005)
         for ring in self._rings_out.values():
             try:
                 ring.shm.close()

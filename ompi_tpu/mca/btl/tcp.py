@@ -60,11 +60,18 @@ class TcpBtl(Btl):
 
     # -- lifecycle -------------------------------------------------------
     def setup(self, rte) -> bool:
-        """Listen + publish our address (pre-fence), multi-process only."""
-        if rte.is_device_world or rte.world_size <= 1:
+        """Listen + publish our address (pre-fence).
+
+        Runs even in a 1-rank job: under dpm a singleton spawned job has
+        no same-job peers but MUST be reachable from its parent job, and
+        tcp is the universal transport that guarantees it.
+        """
+        if rte.is_device_world:
             return False
         if not hasattr(rte, "modex_put"):
             return False
+        if getattr(rte, "client", None) is None:
+            return False   # no coord service (singleton): nobody can dial in
         self._rte = rte
         self._listener = socket.create_server(("127.0.0.1", 0), backlog=64)
         self._listener.setblocking(False)
@@ -109,6 +116,11 @@ class TcpBtl(Btl):
             except (BlockingIOError, InterruptedError):
                 return
             except OSError:
+                # hard error (EPIPE/ECONNRESET): the bytes can never be
+                # delivered — drop them so close()'s flush loop terminates
+                conn.outbuf.clear()
+                if conn.rank is not None:
+                    self._by_rank.pop(conn.rank, None)
                 return
             if n == 0:
                 return
@@ -176,6 +188,16 @@ class TcpBtl(Btl):
                 events += 1
 
     def close(self) -> None:
+        # flush queued outbound bytes before closing (same delivered-but-
+        # unsent exit hazard as btl/sm — see its close())
+        deadline = time.monotonic() + 30.0
+        while (any(c.outbuf for c in self._by_rank.values())
+               and time.monotonic() < deadline):
+            for conn in list(self._by_rank.values()):
+                if conn.outbuf:
+                    self._flush(conn)
+            if any(c.outbuf for c in self._by_rank.values()):
+                time.sleep(0.0005)
         for conn in list(self._by_rank.values()):
             try:
                 self._sel.unregister(conn.sock)

@@ -61,6 +61,10 @@ def comm_select(comm) -> None:
         from ompi_tpu.base.output import show_help
 
         show_help("help-coll", "none-available", comm=comm.name)
+    # coll/monitoring interposition (records per-collective counters)
+    from ompi_tpu.runtime import monitoring
+
+    monitoring.wrap_coll_table(comm)
 
 
 from ompi_tpu.base.output import register_help as _rh

@@ -37,17 +37,35 @@ class LocalModule:
         return np.array(self._bases[target][offset:offset + count], copy=True)
 
     def accumulate(self, win, arr, target: int, offset: int, op) -> None:
-        view = self._bases[target][offset:offset + arr.size]
-        op(arr.astype(view.dtype, copy=False), view)
+        base = self._bases[target]
+        if win.byte_addressed and arr.dtype != base.dtype:
+            # byte-addressed heap window: typed view at byte offset
+            view = base[offset:offset + arr.nbytes].view(arr.dtype)
+            op(arr, view)
+        else:
+            view = base[offset:offset + arr.size]
+            op(arr.astype(base.dtype, copy=False), view)
 
     def get_accumulate(self, win, arr, target: int, offset: int,
                        op) -> np.ndarray:
-        old = self.get(win, arr.size, target, offset)
+        base = self._bases[target]
+        if win.byte_addressed and arr.dtype != base.dtype:
+            old = np.array(base[offset:offset + arr.nbytes].view(arr.dtype),
+                           copy=True)
+        else:
+            old = self.get(win, arr.size, target, offset)
         self.accumulate(win, arr, target, offset, op)
         return old
 
     def compare_and_swap(self, win, value, compare, target: int, offset: int):
         base = self._bases[target]
+        value = np.asarray(value)
+        if win.byte_addressed and value.dtype != base.dtype:
+            view = base[offset:offset + value.dtype.itemsize].view(value.dtype)
+            old = view[0]
+            if old == compare:
+                view[0] = value
+            return old
         old = base[offset]
         if old == compare:
             base[offset] = value

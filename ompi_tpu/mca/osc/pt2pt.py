@@ -273,16 +273,32 @@ class Pt2ptModule:
                 base[req["off"]:req["off"] + req["count"]], copy=True)
             _send_reply(win.comm, source, req["rt"], out)
         elif kind == "acc":
-            self._apply(base, req["off"], req["data"], req["op"])
+            self._apply(base, req["off"], req["data"], req["op"],
+                        win.byte_addressed)
         elif kind == "gacc":
-            old = np.array(
-                base[req["off"]:req["off"] + req["data"].size], copy=True)
-            self._apply(base, req["off"], req["data"], req["op"])
+            data = req["data"]
+            if win.byte_addressed and data.dtype != base.dtype:
+                old = np.array(base[req["off"]:req["off"] + data.nbytes]
+                               .view(data.dtype), copy=True)
+            else:
+                old = np.array(
+                    base[req["off"]:req["off"] + data.size], copy=True)
+            self._apply(base, req["off"], data, req["op"],
+                        win.byte_addressed)
             _send_reply(win.comm, source, req["rt"], old)
         elif kind == "cas":
-            old = base[req["off"]]
-            if old == req["compare"]:
-                base[req["off"]] = req["value"]
+            value = np.asarray(req["value"])
+            if win.byte_addressed and value.dtype != base.dtype:
+                # typed CAS on a byte-addressed heap window
+                view = base[req["off"]:req["off"] + value.dtype.itemsize] \
+                    .view(value.dtype)
+                old = view[0]
+                if old == req["compare"]:
+                    view[0] = value
+            else:
+                old = base[req["off"]]
+                if old == req["compare"]:
+                    base[req["off"]] = req["value"]
             _send_reply(win.comm, source, req["rt"], old)
         elif kind == "flush":
             _send_reply(win.comm, source, req["rt"], True)
@@ -308,10 +324,16 @@ class Pt2ptModule:
 
     @staticmethod
     def _apply(base: np.ndarray, off: int, data: np.ndarray,
-               op_name: str) -> None:
+               op_name: str, byte_addressed: bool = False) -> None:
         op = getattr(op_mod, op_name)
-        view = base[off:off + data.size]
-        op(data.astype(base.dtype, copy=False), view)
+        if byte_addressed and data.dtype != base.dtype:
+            # typed accumulate into a byte-addressed heap window: ``off``
+            # is a byte offset and the view carries the origin type
+            view = base[off:off + data.nbytes].view(data.dtype)
+            op(data, view)
+        else:
+            view = base[off:off + data.size]
+            op(data.astype(base.dtype, copy=False), view)
 
 
 class Pt2ptComponent(Component):

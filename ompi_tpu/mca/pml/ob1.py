@@ -158,7 +158,9 @@ class Ob1Pml:
                     if req.source == ANY_SOURCE:
                         continue
                     try:
-                        src_w = req.comm.group.world_rank(req.source)
+                        grp = (req.comm.remote_group if req.comm.is_inter
+                               else req.comm.group)
+                        src_w = grp.world_rank(req.source)
                     except Exception:
                         continue
                     if src_w == world_rank:
@@ -237,7 +239,8 @@ class Ob1Pml:
             st = self._match.setdefault(key, _MatchState())
             # check the unexpected queue first (arrival order)
             for i, frag in enumerate(st.unexpected):
-                comm_src = comm.group.rank_of(frag.src)
+                comm_src = (comm.remote_group if comm.is_inter
+                            else comm.group).rank_of(frag.src)
                 if req.matches(frag, comm_src):
                     st.unexpected.pop(i)
                     self._deliver_to_request(req, frag)
@@ -260,7 +263,8 @@ class Ob1Pml:
             with self._lock:
                 st = self._match.setdefault(key, _MatchState())
                 for frag in st.unexpected:
-                    comm_src = comm.group.rank_of(frag.src)
+                    comm_src = (comm.remote_group if comm.is_inter
+                            else comm.group).rank_of(frag.src)
                     if probe_req.matches(frag, comm_src):
                         status = Status(source=comm_src, tag=frag.tag,
                                         _nbytes=frag.total_len or len(frag.data))
@@ -270,7 +274,8 @@ class Ob1Pml:
                 with self._lock:
                     st = self._match.setdefault(key, _MatchState())
                     for frag in st.unexpected:
-                        comm_src = comm.group.rank_of(frag.src)
+                        comm_src = (comm.remote_group if comm.is_inter
+                            else comm.group).rank_of(frag.src)
                         if probe_req.matches(frag, comm_src):
                             status = Status(
                                 source=comm_src, tag=frag.tag,
@@ -289,7 +294,8 @@ class Ob1Pml:
             with self._lock:
                 st = self._match.setdefault(key, _MatchState())
                 for i, frag in enumerate(st.unexpected):
-                    comm_src = comm.group.rank_of(frag.src)
+                    comm_src = (comm.remote_group if comm.is_inter
+                            else comm.group).rank_of(frag.src)
                     if probe_req.matches(frag, comm_src):
                         st.unexpected.pop(i)
                         status = Status(source=comm_src, tag=frag.tag,
@@ -346,7 +352,8 @@ class Ob1Pml:
         """Match one in-sequence frag against posted recvs (recvfrag.c:831)."""
         comm = None
         for i, req in enumerate(st.posted):
-            comm_src = req.comm.group.rank_of(frag.src)
+            comm_src = (req.comm.remote_group if req.comm.is_inter
+                    else req.comm.group).rank_of(frag.src)
             if req.matches(frag, comm_src):
                 st.posted.pop(i)
                 spc.record("matched_msgs")
@@ -356,7 +363,8 @@ class Ob1Pml:
         st.unexpected.append(frag)
 
     def _deliver_to_request(self, req: RecvRequest, frag: Frag) -> None:
-        comm_src = req.comm.group.rank_of(frag.src)
+        comm_src = (req.comm.remote_group if req.comm.is_inter
+                    else req.comm.group).rank_of(frag.src)
         req.matched_src = frag.src
         req.total = frag.total_len or len(frag.data)
         req.status.source = comm_src

@@ -1,0 +1,115 @@
+"""ompi_tpu.native — C++ twins of the hot host-path loops.
+
+Lazy ctypes binding over ``otpu_native.cc`` (datatype pack/unpack element
+loops + the btl/sm SPSC ring).  The library is compiled on first use with
+the in-image g++ into a per-source-hash cache path; if the toolchain or
+compile is unavailable every caller silently stays on its numpy fallback —
+``available()`` reports which world you are in.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "otpu_native.cc")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build_path() -> str:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get("OTPU_NATIVE_CACHE",
+                           os.path.join(tempfile.gettempdir(),
+                                        "otpu_native_cache"))
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, f"libotpu_native_{tag}.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            so = _build_path()
+            if not os.path.exists(so):
+                tmp = so + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+        except Exception:
+            return None
+        lib.otpu_pack_elems.restype = ctypes.c_int64
+        lib.otpu_pack_elems.argtypes = [
+            _U8P, _U8P, _I64P, _I64P,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.otpu_unpack_elems.restype = ctypes.c_int64
+        lib.otpu_unpack_elems.argtypes = [
+            _U8P, _U8P, _I64P, _I64P,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.otpu_ring_push.restype = ctypes.c_int
+        lib.otpu_ring_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, _U8P, ctypes.c_uint64]
+        lib.otpu_ring_pop.restype = ctypes.c_int64
+        lib.otpu_ring_pop.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, _U8P, ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# -- datatype engine entry points ----------------------------------------
+
+def pack_elems(mem: np.ndarray, out: np.ndarray, seg_off: np.ndarray,
+               seg_len: np.ndarray, extent: int, base_offset: int,
+               first_elem: int, nelem: int) -> int:
+    """Gather ``nelem`` whole elements into ``out``; returns bytes."""
+    lib = _load()
+    return int(lib.otpu_pack_elems(
+        mem, out, seg_off, seg_len, len(seg_off), extent, base_offset,
+        first_elem, nelem))
+
+
+def unpack_elems(mem: np.ndarray, chunk: np.ndarray, seg_off: np.ndarray,
+                 seg_len: np.ndarray, extent: int, base_offset: int,
+                 first_elem: int, nelem: int) -> int:
+    lib = _load()
+    return int(lib.otpu_unpack_elems(
+        mem, chunk, seg_off, seg_len, len(seg_off), extent, base_offset,
+        first_elem, nelem))
+
+
+# -- sm ring entry points -------------------------------------------------
+
+def ring_push(buf_addr: int, cap: int, payload: np.ndarray) -> bool:
+    lib = _load()
+    return bool(lib.otpu_ring_push(buf_addr, cap, payload, len(payload)))
+
+
+def ring_pop(buf_addr: int, cap: int, out: np.ndarray) -> int:
+    """Returns payload length, -1 if empty/incomplete, -2 if out too small."""
+    lib = _load()
+    return int(lib.otpu_ring_pop(buf_addr, cap, out, len(out)))

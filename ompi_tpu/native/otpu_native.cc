@@ -1,0 +1,127 @@
+// otpu_native — C++ twins of the hot host-path loops.
+//
+// The reference implements these in C for the same reason (the datatype
+// pack engine `opal/datatype/opal_datatype_pack.c` and the sm fifo
+// `opal/class/opal_fifo.h`): per-element gather/scatter and ring ops are
+// tight loops the interpreter cannot keep up with.  The Python layers
+// (ompi_tpu/datatype/convertor.py, ompi_tpu/mca/btl/sm.py) call these
+// through ctypes when the shared library is available and fall back to
+// their numpy implementations otherwise.
+//
+// Build: g++ -O3 -shared -fPIC otpu_native.cc -o libotpu_native.so
+// (driven lazily by ompi_tpu/native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---- datatype engine: whole-element gather/scatter ---------------------
+//
+// Stream layout: element e of the datatype contributes its segments in
+// type-map order; segment j lives at base_offset + e*extent + seg_off[j]
+// in memory and occupies seg_len[j] bytes of the packed stream.
+
+int64_t otpu_pack_elems(const uint8_t *base, uint8_t *out,
+                        const int64_t *seg_off, const int64_t *seg_len,
+                        int64_t nseg, int64_t extent, int64_t base_offset,
+                        int64_t first_elem, int64_t nelem) {
+    uint8_t *dst = out;
+    for (int64_t e = first_elem; e < first_elem + nelem; ++e) {
+        const uint8_t *ebase = base + base_offset + e * extent;
+        for (int64_t j = 0; j < nseg; ++j) {
+            std::memcpy(dst, ebase + seg_off[j], (size_t)seg_len[j]);
+            dst += seg_len[j];
+        }
+    }
+    return dst - out;
+}
+
+int64_t otpu_unpack_elems(uint8_t *base, const uint8_t *in,
+                          const int64_t *seg_off, const int64_t *seg_len,
+                          int64_t nseg, int64_t extent, int64_t base_offset,
+                          int64_t first_elem, int64_t nelem) {
+    const uint8_t *src = in;
+    for (int64_t e = first_elem; e < first_elem + nelem; ++e) {
+        uint8_t *ebase = base + base_offset + e * extent;
+        for (int64_t j = 0; j < nseg; ++j) {
+            std::memcpy(ebase + seg_off[j], src, (size_t)seg_len[j]);
+            src += seg_len[j];
+        }
+    }
+    return src - in;
+}
+
+// ---- btl/sm: SPSC byte ring -------------------------------------------
+//
+// Layout (matches ompi_tpu/mca/btl/sm.py `_Ring`):
+//   [ head u64 | tail u64 | data[cap] ]
+// frames are <u32 length><payload>, wrapping modulo cap.  Single producer
+// advances tail, single consumer advances head (acquire/release pairs —
+// the property the reference's opal_fifo gets from its atomics).
+
+static inline uint64_t load_acq(const uint8_t *p) {
+    return __atomic_load_n((const uint64_t *)p, __ATOMIC_ACQUIRE);
+}
+static inline void store_rel(uint8_t *p, uint64_t v) {
+    __atomic_store_n((uint64_t *)p, v, __ATOMIC_RELEASE);
+}
+
+static void ring_write(uint8_t *data, uint64_t cap, uint64_t pos,
+                       const uint8_t *src, uint64_t n) {
+    uint64_t p = pos % cap;
+    uint64_t first = n < cap - p ? n : cap - p;
+    std::memcpy(data + p, src, (size_t)first);
+    if (first < n)
+        std::memcpy(data, src + first, (size_t)(n - first));
+}
+
+int otpu_ring_push(uint8_t *buf, uint64_t cap, const uint8_t *payload,
+                   uint64_t n) {
+    uint64_t head = load_acq(buf);
+    uint64_t tail = load_acq(buf + 8);
+    uint64_t need = 4 + n;
+    if (need > cap - (tail - head))
+        return 0;
+    uint8_t *data = buf + 16;
+    uint32_t len32 = (uint32_t)n;
+    ring_write(data, cap, tail, (const uint8_t *)&len32, 4);
+    ring_write(data, cap, tail + 4, payload, n);
+    store_rel(buf + 8, tail + need);
+    return 1;
+}
+
+int64_t otpu_ring_pop(uint8_t *buf, uint64_t cap, uint8_t *out,
+                      uint64_t out_cap) {
+    uint64_t head = load_acq(buf);
+    uint64_t tail = load_acq(buf + 8);
+    if (tail - head < 4)
+        return -1;
+    const uint8_t *data = buf + 16;
+    uint32_t len32;
+    {   // read the length header (may wrap)
+        uint64_t p = head % cap;
+        uint8_t tmp[4];
+        uint64_t first = 4 < cap - p ? 4 : cap - p;
+        std::memcpy(tmp, data + p, (size_t)first);
+        if (first < 4)
+            std::memcpy(tmp + first, data, (size_t)(4 - first));
+        std::memcpy(&len32, tmp, 4);
+    }
+    uint64_t n = len32;
+    if (tail - head < 4 + n)
+        return -1;          // producer mid-frame
+    if (n > out_cap)
+        return -2;          // caller buffer too small
+    {   // read the payload (may wrap)
+        uint64_t p = (head + 4) % cap;
+        uint64_t first = n < cap - p ? n : cap - p;
+        std::memcpy(out, data + p, (size_t)first);
+        if (first < n)
+            std::memcpy(out + first, data, (size_t)(n - first));
+    }
+    store_rel(buf, head + 4 + n);
+    return (int64_t)n;
+}
+
+}  // extern "C"

@@ -58,6 +58,10 @@ class CoordServer:
         self._event_cond = threading.Condition()
         self._aborted: Optional[int] = None
         self._failed: set[int] = set()
+        self._fence_expect: dict[str, tuple] = {}
+        self._next_rank = nprocs          # global rank allocator (dpm spawn)
+        self._spawn_handler = None        # set by the launcher (tpurun)
+        self._spawn_seq = 0
         self._srv = socket.create_server((host, port))
         self.addr = self._srv.getsockname()
         self._threads: list[threading.Thread] = []
@@ -123,6 +127,9 @@ class CoordServer:
                 elif op == "fence":
                     fid = req["id"]
                     with self._fence_cond:
+                        if "expect" in req and req["expect"] is not None:
+                            self._fence_expect.setdefault(
+                                fid, tuple(req["expect"]))
                         # per-rank contribution tracking: a fence completes
                         # when every rank has either arrived or died — a
                         # dead rank's earlier arrival must not release the
@@ -157,6 +164,30 @@ class CoordServer:
                     with self._fence_cond:
                         self._fence_cond.notify_all()
                     _send_frame(conn, {"ok": True})
+                elif op == "spawn":
+                    # MPI_Comm_spawn's PMIx_Spawn analog: allocate fresh
+                    # global ranks, hand the launch to the launcher's
+                    # registered handler (it owns process management)
+                    if self._spawn_handler is None:
+                        _send_frame(conn, {"ok": False,
+                                           "error": "no spawn support "
+                                                    "(launcher too old?)"})
+                        continue
+                    n = int(req["n"])
+                    with self._kv_cond:
+                        ranks = list(range(self._next_rank,
+                                           self._next_rank + n))
+                        self._next_rank += n
+                        self._spawn_seq += 1
+                        job = f"job{self._spawn_seq}"
+                    try:
+                        self._spawn_handler(
+                            req["cmd"], ranks, job,
+                            req.get("env") or {})
+                        _send_frame(conn, {"ok": True, "ranks": ranks,
+                                           "job": job})
+                    except Exception as exc:
+                        _send_frame(conn, {"ok": False, "error": str(exc)})
                 elif op == "ping":
                     _send_frame(conn, {"ok": True, "nprocs": self.nprocs,
                                        "aborted": self._aborted})
@@ -168,14 +199,19 @@ class CoordServer:
     def _fence_satisfied(self, fid: str) -> bool:
         # caller holds _fence_cond
         arrived = self._fence_ranks.get(fid, set())
-        return all(r in arrived or r in self._failed
-                   for r in range(self.nprocs))
+        expected = self._fence_expect.get(fid, range(self.nprocs))
+        return all(r in arrived or r in self._failed for r in expected)
 
     def _complete_fence(self, fid: str) -> None:
         # caller holds _fence_cond
         self._fence_ranks[fid] = set()
         self._fence_gen[fid] = self._fence_gen.get(fid, 0) + 1
         self._fence_cond.notify_all()
+
+    def set_spawn_handler(self, fn) -> None:
+        """Launcher registers how to exec spawned ranks:
+        ``fn(cmd, global_ranks, job_id, extra_env)``."""
+        self._spawn_handler = fn
 
     def publish(self, name: str, payload: Any) -> None:
         """Server-side event injection (launcher-detected failures)."""
@@ -244,7 +280,13 @@ class CoordClient:
         return self._rpc(op="get", rank=rank, key=key, wait=wait,
                          timeout=timeout)["value"]
 
-    def fence(self, fence_id: str, *, rank: int) -> None:
+    def spawn(self, cmd: list, n: int, env: Optional[dict] = None) -> tuple:
+        """Ask the launcher to start ``n`` new ranks; returns
+        (global_ranks, job_id)."""
+        r = self._rpc(op="spawn", cmd=list(cmd), n=n, env=env or {})
+        return list(r["ranks"]), r["job"]
+
+    def fence(self, fence_id: str, *, rank: int, expect=None) -> None:
         """Enter a named fence as ``rank``.
 
         ``rank`` is mandatory: the server's completion rule is per-rank
@@ -252,7 +294,7 @@ class CoordClient:
         """
         if rank < 0:
             raise ValueError("fence requires the caller's world rank")
-        self._rpc(op="fence", id=fence_id, rank=rank)
+        self._rpc(op="fence", id=fence_id, rank=rank, expect=expect)
 
     def event_publish(self, name: str, payload: Any) -> None:
         self._rpc(op="event_pub", name=name, payload=payload)

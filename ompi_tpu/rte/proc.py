@@ -23,6 +23,16 @@ class ProcRte(Rte):
     def __init__(self) -> None:
         self.my_world_rank = int(os.environ["OTPU_RANK"])
         self.world_size = int(os.environ["OTPU_NPROCS"])
+        # dpm job identity: a spawned job has its own COMM_WORLD built from
+        # GLOBAL ranks allocated by the coord server (OTPU_JOB_RANKS); the
+        # primary job is job "0" with ranks 0..nprocs-1
+        self.job = os.environ.get("OTPU_JOB", "0")
+        jr = os.environ.get("OTPU_JOB_RANKS", "")
+        self.job_ranks = ([int(x) for x in jr.split(",")] if jr
+                          else list(range(self.world_size)))
+        pr = os.environ.get("OTPU_PARENT_RANKS", "")
+        self.parent_ranks = [int(x) for x in pr.split(",")] if pr else None
+        self.parent_cid = int(os.environ.get("OTPU_PARENT_CID", "-1"))
         self.client = CoordClient()
         self._hostname = socket.gethostname()
         # node identity for the hierarchy (coll/han): hostname by default,
@@ -41,7 +51,10 @@ class ProcRte(Rte):
 
     def fence(self) -> None:
         self._fence_counter += 1
-        self.client.fence(f"f{self._fence_counter}", rank=self.my_world_rank)
+        # fence ids are job-scoped and carry explicit membership so a
+        # spawned job's fences never collide with the primary job's
+        self.client.fence(f"{self.job}:f{self._fence_counter}",
+                          rank=self.my_world_rank, expect=self.job_ranks)
 
     def locality_color(self, split_type: str) -> int:
         # 'shared' → same node (the sm/ICI domain)

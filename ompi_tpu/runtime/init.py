@@ -125,6 +125,11 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
             raise RuntimeError("no pml component available")
         pml_module = pml_comp.get_module(_rte)
 
+        # pml/monitoring interposition (per-peer traffic matrices)
+        from ompi_tpu.runtime import monitoring
+
+        pml_module = monitoring.maybe_wrap_pml(pml_module)
+
         # modex exchange of endpoints (ompi_mpi_init.c:682-701)
         _rte.fence()
 
@@ -132,7 +137,10 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
         from ompi_tpu.api.comm import Comm
         from ompi_tpu.api.group import Group
 
-        world_group = Group(range(_rte.world_size))
+        # a dpm-spawned job's COMM_WORLD is its own rank set (global ranks
+        # allocated by the coord server), not 0..size-1
+        world_group = Group(getattr(_rte, "job_ranks",
+                                    range(_rte.world_size)))
         _world = Comm(world_group, cid=0, rte=_rte, name="COMM_WORLD")
         reserve_cid(0)
         my = _rte.my_world_rank

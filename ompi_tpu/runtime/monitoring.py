@@ -1,0 +1,184 @@
+"""monitoring — per-peer traffic matrices (pml/coll/osc interposition).
+
+Re-design of ``/root/reference/ompi/mca/common/monitoring/
+common_monitoring.h:48-91`` and the pml/coll/osc ``monitoring``
+interposition components: when enabled (``otpu_monitoring_enable``), every
+point-to-point send is recorded into a per-(src, dst) byte/message matrix,
+and every collective invocation into per-collective counters — the data the
+reference exports through MPI_T pvars and dumps at finalize.
+
+The interposition points are the pml module (wrapped at selection time,
+the ``pml/monitoring`` slot) and the per-comm c_coll table (wrapped after
+``comm_select``, the ``coll/monitoring`` slot).
+"""
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.base.var import VarType, registry
+
+_enable_var = registry.register(
+    "monitoring", None, "enable", vtype=VarType.BOOL, default=False,
+    help="Record per-peer p2p byte/message matrices and per-collective "
+         "counters (pml/coll monitoring interposition)")
+_dump_var = registry.register(
+    "monitoring", None, "dump_at_exit", vtype=VarType.BOOL, default=False,
+    help="Print the monitoring matrices at finalize (stderr)")
+
+_lock = threading.Lock()
+# (src_world, dst_world) -> [messages, bytes]
+_p2p: dict[tuple[int, int], list] = {}
+# (coll_name) -> [calls, bytes]
+_coll: dict[str, list] = {}
+_osc: dict[str, list] = {}
+
+
+def enabled() -> bool:
+    return bool(_enable_var.value)
+
+
+def record_p2p(src: int, dst: int, nbytes: int) -> None:
+    with _lock:
+        cell = _p2p.setdefault((src, dst), [0, 0])
+        cell[0] += 1
+        cell[1] += nbytes
+
+
+def record_coll(name: str, nbytes: int) -> None:
+    with _lock:
+        cell = _coll.setdefault(name, [0, 0])
+        cell[0] += 1
+        cell[1] += nbytes
+
+
+def record_osc(op: str, nbytes: int) -> None:
+    with _lock:
+        cell = _osc.setdefault(op, [0, 0])
+        cell[0] += 1
+        cell[1] += nbytes
+
+
+def p2p_matrix(n: Optional[int] = None):
+    """(msgs, bytes) matrices as dense numpy arrays over world ranks."""
+    with _lock:
+        if not _p2p and not n:
+            return np.zeros((0, 0), np.int64), np.zeros((0, 0), np.int64)
+        size = n or (max(max(s, d) for s, d in _p2p) + 1)
+        msgs = np.zeros((size, size), np.int64)
+        byts = np.zeros((size, size), np.int64)
+        for (s, d), (m, b) in _p2p.items():
+            if s < size and d < size:
+                msgs[s, d] = m
+                byts[s, d] = b
+        return msgs, byts
+
+
+def coll_counters() -> dict:
+    with _lock:
+        return {k: tuple(v) for k, v in _coll.items()}
+
+
+def osc_counters() -> dict:
+    with _lock:
+        return {k: tuple(v) for k, v in _osc.items()}
+
+
+def reset() -> None:
+    with _lock:
+        _p2p.clear()
+        _coll.clear()
+        _osc.clear()
+
+
+def summary() -> str:
+    lines = ["monitoring: per-peer p2p matrix (src -> dst: msgs/bytes)"]
+    with _lock:
+        for (s, d) in sorted(_p2p):
+            m, b = _p2p[(s, d)]
+            lines.append(f"  {s} -> {d}: {m} msgs, {b} bytes")
+        for name in sorted(_coll):
+            c, b = _coll[name]
+            lines.append(f"  coll {name}: {c} calls, {b} bytes")
+        for name in sorted(_osc):
+            c, b = _osc[name]
+            lines.append(f"  osc {name}: {c} calls, {b} bytes")
+    return "\n".join(lines)
+
+
+class MonitoringPml:
+    """pml/monitoring: records, then forwards to the real pml module."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _record(self, comm, buf, dest) -> None:
+        grp = comm.remote_group if comm.is_inter else comm.group
+        try:
+            dst_world = grp.world_rank(dest)
+        except Exception:
+            return
+        record_p2p(comm.world_rank(comm.rank), dst_world,
+                   int(np.asarray(buf).nbytes))
+
+    def send(self, comm, buf, dest, tag):
+        self._record(comm, buf, dest)
+        return self._inner.send(comm, buf, dest, tag)
+
+    def isend(self, comm, buf, dest, tag):
+        self._record(comm, buf, dest)
+        return self._inner.isend(comm, buf, dest, tag)
+
+
+_COLL_BYTES_ARG = {"bcast", "allreduce", "reduce", "allgather", "alltoall",
+                   "reduce_scatter", "gather", "scatter", "scan", "exscan",
+                   "allreduce_array", "bcast_array", "allgather_array",
+                   "reduce_scatter_array", "alltoall_array"}
+
+
+def wrap_coll_table(comm) -> None:
+    """coll/monitoring: wrap every selected c_coll slot with a recorder."""
+    if not enabled():
+        return
+
+    def make(name, fn):
+        def wrapped(comm_arg, *args, **kw):
+            nbytes = 0
+            if name in _COLL_BYTES_ARG and args:
+                try:
+                    nbytes = int(np.asarray(args[0]).nbytes)
+                except Exception:
+                    nbytes = 0
+            record_coll(name, nbytes)
+            return fn(comm_arg, *args, **kw)
+
+        wrapped.__monitored__ = True
+        wrapped.__self__ = getattr(fn, "__self__", None)
+        return wrapped
+
+    for name, fn in list(comm.c_coll.items()):
+        if not getattr(fn, "__monitored__", False):
+            comm.c_coll[name] = make(name, fn)
+
+
+def maybe_wrap_pml(pml_module):
+    """Interpose the pml when monitoring is on (pml/monitoring slot)."""
+    if enabled():
+        return MonitoringPml(pml_module)
+    return pml_module
+
+
+def _atexit_dump() -> None:
+    if enabled() and bool(_dump_var.value):
+        import sys
+
+        print(summary(), file=sys.stderr, flush=True)
+
+
+atexit.register(_atexit_dump)

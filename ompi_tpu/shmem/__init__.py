@@ -1,0 +1,285 @@
+"""OpenSHMEM-style PGAS layer over the osc windows.
+
+Re-design of ``/root/reference/oshmem/`` (43k LoC: spml put/get transport,
+memheap symmetric allocator, scoll collectives, atomic framework) against
+this framework's own layers, the way the reference's OSHMEM rides OMPI
+internals:
+
+- **memheap** (``oshmem/mca/memheap/``): one symmetric heap per PE — a
+  byte-typed osc window of identical size everywhere, with a collective
+  first-fit allocator, so any symmetric object has the same offset on
+  every PE (the property all of SHMEM rests on).
+- **spml** (``oshmem/mca/spml/spml.h:60``): put/get/atomics lower onto the
+  osc module (active-message or, in the device world, direct local copy).
+- **scoll** (``oshmem/mca/scoll/mpi``): barrier/broadcast/collect/
+  reductions reuse the coll framework through COMM_WORLD, exactly like the
+  reference's scoll/mpi component delegates to MPI collectives.
+
+Usage::
+
+    import ompi_tpu.shmem as shmem
+    shmem.init()
+    x = shmem.array(8, np.float64)        # symmetric allocation
+    x.local[:] = shmem.my_pe()
+    shmem.barrier_all()
+    row = shmem.get(x, 8, pe=(shmem.my_pe() + 1) % shmem.n_pes())
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.base.var import VarType, registry
+
+_heap_var = registry.register(
+    "shmem", None, "heap_size", vtype=VarType.SIZE, default="16m",
+    help="Symmetric heap size per PE (SHMEM_SYMMETRIC_SIZE analog)")
+
+_lock = threading.Lock()
+_ctx: Optional["_Shmem"] = None
+
+
+class SymArray:
+    """A symmetric allocation: same heap offset on every PE.
+
+    ``local`` is this PE's view; remote access goes through put/get/
+    atomics with this object as the address.
+    """
+
+    __slots__ = ("offset", "nbytes", "dtype", "count", "local")
+
+    def __init__(self, offset: int, nbytes: int, dtype, count: int,
+                 local: np.ndarray) -> None:
+        self.offset = offset
+        self.nbytes = nbytes
+        self.dtype = np.dtype(dtype)
+        self.count = count
+        self.local = local
+
+    def byte_offset(self, index: int = 0) -> int:
+        return self.offset + index * self.dtype.itemsize
+
+
+class _Shmem:
+    def __init__(self, heap_bytes: int) -> None:
+        import ompi_tpu
+        from ompi_tpu.api.win import Win
+
+        self.world = ompi_tpu.init()
+        self.heap_bytes = heap_bytes
+        self.win = Win.create(self.world, size=heap_bytes, dtype=np.uint8,
+                              name="shmem_heap")
+        self.win.byte_addressed = True   # offsets are bytes; RMA is typed
+        # first-fit free list of (offset, size) — collective symmetric
+        # calls keep it identical on every PE (memheap invariant)
+        self.free_list: list[tuple[int, int]] = [(0, heap_bytes)]
+
+    # -- memheap allocator ----------------------------------------------
+    def alloc(self, nbytes: int, align: int = 16) -> int:
+        for i, (off, size) in enumerate(self.free_list):
+            start = (off + align - 1) & ~(align - 1)
+            used = start - off + nbytes
+            if used <= size:
+                rest = []
+                if start > off:
+                    rest.append((off, start - off))
+                if size > used:
+                    rest.append((start + nbytes, size - (used)))
+                self.free_list[i:i + 1] = rest
+                return start
+        raise MpiError(ErrorClass.ERR_NO_MEM
+                       if hasattr(ErrorClass, "ERR_NO_MEM")
+                       else ErrorClass.ERR_OTHER,
+                       f"symmetric heap exhausted ({nbytes} bytes)")
+
+    def release(self, off: int, nbytes: int) -> None:
+        self.free_list.append((off, nbytes))
+        # coalesce adjacent runs
+        self.free_list.sort()
+        merged = []
+        for o, s in self.free_list:
+            if merged and merged[-1][0] + merged[-1][1] == o:
+                merged[-1] = (merged[-1][0], merged[-1][1] + s)
+            else:
+                merged.append((o, s))
+        self.free_list = [tuple(t) for t in merged]
+
+
+def _get() -> _Shmem:
+    if _ctx is None:
+        raise MpiError(ErrorClass.ERR_OTHER, "shmem.init() not called")
+    return _ctx
+
+
+# -- setup / teardown ---------------------------------------------------
+
+def init(heap_size: Optional[int] = None):
+    """``shmem_init``: collective; sets up the symmetric heap."""
+    global _ctx
+    with _lock:
+        if _ctx is None:
+            _ctx = _Shmem(int(heap_size or _heap_var.value))
+    return _ctx
+
+
+def finalize() -> None:
+    global _ctx
+    with _lock:
+        if _ctx is not None:
+            _ctx.win.free()
+            _ctx = None
+
+
+def my_pe() -> int:
+    return _get().world.rank
+
+
+def n_pes() -> int:
+    return _get().world.size
+
+
+# -- symmetric allocation ------------------------------------------------
+
+def array(count: int, dtype=np.float64) -> SymArray:
+    """``shmem_malloc``: collective; identical offset on every PE."""
+    ctx = _get()
+    dt = np.dtype(dtype)
+    nbytes = count * dt.itemsize
+    off = ctx.alloc(nbytes)
+    local = ctx.win.local[off:off + nbytes].view(dt)
+    return SymArray(off, nbytes, dt, count, local)
+
+
+def free(sym: SymArray) -> None:
+    """``shmem_free``: collective."""
+    _get().release(sym.offset, sym.nbytes)
+
+
+# -- spml: put / get ------------------------------------------------------
+
+def put(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    """``shmem_put``: write ``value`` into ``sym`` on PE ``pe``."""
+    ctx = _get()
+    arr = np.ascontiguousarray(value, dtype=sym.dtype)
+    ctx.win.put(arr.view(np.uint8).reshape(-1), pe, sym.byte_offset(index))
+
+
+def get(sym: SymArray, count: int, pe: int, index: int = 0) -> np.ndarray:
+    """``shmem_get``: read ``count`` elements of ``sym`` from PE ``pe``."""
+    ctx = _get()
+    raw = ctx.win.get(count * sym.dtype.itemsize, pe,
+                      sym.byte_offset(index))
+    return np.asarray(raw).view(sym.dtype)
+
+
+def p(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    """``shmem_p``: single-element put."""
+    put(sym, np.asarray([value], dtype=sym.dtype), pe, index)
+
+
+def g(sym: SymArray, pe: int, index: int = 0):
+    """``shmem_g``: single-element get."""
+    return get(sym, 1, pe, index)[0]
+
+
+# -- atomics --------------------------------------------------------------
+
+def atomic_add(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    ctx = _get()
+    ctx.win.accumulate(np.asarray([value], dtype=sym.dtype), pe,
+                       sym.byte_offset(index), op_mod.SUM)
+
+
+def atomic_fetch_add(sym: SymArray, value, pe: int, index: int = 0):
+    ctx = _get()
+    out = ctx.win.get_accumulate(np.asarray([value], dtype=sym.dtype), pe,
+                                 sym.byte_offset(index), op_mod.SUM)
+    return np.asarray(out).view(sym.dtype)[0] \
+        if np.asarray(out).dtype != sym.dtype else np.asarray(out)[0]
+
+
+def atomic_inc(sym: SymArray, pe: int, index: int = 0) -> None:
+    atomic_add(sym, 1, pe, index)
+
+
+def atomic_fetch(sym: SymArray, pe: int, index: int = 0):
+    return atomic_fetch_add(sym, 0, pe, index)
+
+
+def atomic_swap(sym: SymArray, value, pe: int, index: int = 0):
+    ctx = _get()
+    out = ctx.win.get_accumulate(
+        np.asarray([value], dtype=sym.dtype), pe, sym.byte_offset(index),
+        op_mod.REPLACE)
+    return np.asarray(out).view(sym.dtype)[0] \
+        if np.asarray(out).dtype != sym.dtype else np.asarray(out)[0]
+
+
+def atomic_compare_swap(sym: SymArray, cond, value, pe: int,
+                        index: int = 0):
+    ctx = _get()
+    return ctx.win.compare_and_swap(
+        np.asarray(value, dtype=sym.dtype)[()],
+        np.asarray(cond, dtype=sym.dtype)[()], pe, sym.byte_offset(index))
+
+
+# -- ordering / sync ------------------------------------------------------
+
+def fence() -> None:
+    """``shmem_fence``: order my puts per target (flush_all here)."""
+    _get().win.flush_all()
+
+
+def quiet() -> None:
+    """``shmem_quiet``: complete all my outstanding puts everywhere."""
+    _get().win.flush_all()
+
+
+def barrier_all() -> None:
+    """``shmem_barrier_all``: quiet + world barrier."""
+    quiet()
+    _get().world.barrier()
+
+
+# -- scoll: collectives over the comm layer (scoll/mpi) ------------------
+
+def broadcast(sym: SymArray, root: int = 0) -> None:
+    """``shmem_broadcast``: root's content lands in every PE's ``sym``."""
+    ctx = _get()
+    out = ctx.world.bcast(np.array(sym.local, copy=True), root=root)
+    sym.local[:] = np.asarray(out).reshape(sym.local.shape)
+
+
+def collect(sym: SymArray) -> np.ndarray:
+    """``shmem_collect``: concatenation of every PE's ``sym``."""
+    ctx = _get()
+    out = np.asarray(ctx.world.allgather(np.array(sym.local, copy=True)))
+    return out.reshape(-1).view(sym.dtype)
+
+
+def sum_to_all(sym: SymArray) -> None:
+    """``shmem_sum_to_all`` (wor): allreduce-SUM into ``sym`` everywhere."""
+    _reduce_to_all(sym, op_mod.SUM)
+
+
+def max_to_all(sym: SymArray) -> None:
+    _reduce_to_all(sym, op_mod.MAX)
+
+
+def min_to_all(sym: SymArray) -> None:
+    _reduce_to_all(sym, op_mod.MIN)
+
+
+def _reduce_to_all(sym: SymArray, op) -> None:
+    ctx = _get()
+    out = ctx.world.allreduce(np.array(sym.local, copy=True), op)
+    sym.local[:] = np.asarray(out).reshape(sym.local.shape)
+
+
+def reset_for_testing() -> None:
+    global _ctx
+    _ctx = None

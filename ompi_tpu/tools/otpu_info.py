@@ -1,0 +1,114 @@
+"""otpu_info — the ``ompi_info`` equivalent: dump frameworks, components,
+priorities, MCA variables (with values and sources), and pvars.
+
+Re-design of ``/root/reference/ompi/tools/ompi_info/ompi_info.c:1-198`` +
+``param.c``: the reference walks every registered framework and the MCA var
+registry and prints one ``key: value`` line per item; ``--all`` shows
+everything, ``--param <fw> <comp>`` filters, ``--parsable`` emits
+machine-readable ``:``-separated output.
+
+Usage::
+
+    python -m ompi_tpu.tools.otpu_info [--all] [--param FW [COMP]]
+                                       [--parsable] [--pvars]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+# the static component universe (the autogen.pl role: every framework the
+# build knows about, discovered via import so registration side-effects run)
+_FRAMEWORK_NAMES = ("pml", "bml", "btl", "coll", "osc", "io", "topo",
+                    "accelerator")
+
+
+def _discover_all():
+    from ompi_tpu.base import mca
+
+    for name in _FRAMEWORK_NAMES:
+        fw = mca.framework(name, "")
+        fw.discover()
+        # register vars without requiring a full runtime init
+        for comp in fw.components.values():
+            if not getattr(comp, "_vars_registered", False):
+                try:
+                    comp.register_vars(fw)
+                    comp._vars_registered = True
+                except Exception:
+                    pass
+    return mca.all_frameworks()
+
+
+def _fmt(key: str, value, parsable: bool) -> str:
+    if parsable:
+        return f"{key}:{value}"
+    return f"{key + ':':>40} {value}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="otpu_info",
+        description="Show installed frameworks, components, and MCA vars")
+    ap.add_argument("--all", action="store_true",
+                    help="Show everything (components + vars + pvars)")
+    ap.add_argument("--param", nargs="+", metavar=("FW", "COMP"),
+                    help="Show variables of one framework (and component)")
+    ap.add_argument("--parsable", action="store_true",
+                    help="Machine-readable colon-separated output")
+    ap.add_argument("--pvars", action="store_true",
+                    help="Show performance variables (MPI_T pvar analog)")
+    args = ap.parse_args(argv)
+
+    import ompi_tpu
+    from ompi_tpu.base.var import registry
+
+    out = []
+    p = args.parsable
+    out.append(_fmt("package", "ompi_tpu (TPU-native MPI)", p))
+    out.append(_fmt("version", ompi_tpu.__version__, p))
+
+    frameworks = _discover_all()
+
+    if args.all or not args.param:
+        for fw in frameworks:
+            if not fw.components:
+                continue
+            for comp in sorted(fw.components.values(),
+                               key=lambda c: c.name):
+                prio = getattr(comp, "priority", "")
+                out.append(_fmt(f"mca {fw.name}",
+                                f"{comp.name} (priority {prio})", p))
+
+    if args.all or args.param:
+        want_fw = args.param[0] if args.param else None
+        want_comp = args.param[1] if args.param and len(args.param) > 1 \
+            else None
+        for var in registry.all_vars():
+            group = var.group.split("/")
+            if want_fw and group[0] != want_fw:
+                continue
+            if want_comp and (len(group) < 2 or group[1] != want_comp):
+                continue
+            origin = var.source.name.lower()
+            detail = f" [{var.source_detail}]" if var.source_detail else ""
+            out.append(_fmt(
+                f"mca var {var.name}",
+                f"{var.value!r} (type {var.vtype.name.lower()}, "
+                f"source {origin}{detail})", p))
+
+    if args.all or args.pvars:
+        for pv in registry.all_pvars():
+            out.append(_fmt(
+                f"pvar {pv.name}",
+                f"{pv.read()} ({pv.pclass.name.lower()}) — {pv.help}", p))
+
+    try:
+        print("\n".join(out))
+    except BrokenPipeError:
+        pass   # output piped into head & friends
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

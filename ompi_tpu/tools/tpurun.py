@@ -75,6 +75,7 @@ def main(argv=None) -> int:
         env_base["OTPU_MCA_" + name.removeprefix("otpu_")] = value
 
     procs: list[subprocess.Popen] = []
+    proc_rank: dict = {}            # Popen -> global rank
     pumps: list[threading.Thread] = []
 
     def _pump(rank: int, stream) -> None:
@@ -82,31 +83,53 @@ def main(argv=None) -> int:
             sys.stdout.write(f"[{rank}] {line.decode(errors='replace')}")
             sys.stdout.flush()
 
+    def _launch(rank: int, env: dict, argv=None) -> subprocess.Popen:
+        p = subprocess.Popen(argv or cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        proc_rank[p] = rank       # before append: the monitor loop reads
+        procs.append(p)           # proc_rank for any proc it can see
+        t = threading.Thread(target=_pump, args=(rank, p.stdout), daemon=True)
+        t.start()
+        pumps.append(t)
+        return p
+
+    def _spawn_handler(spawn_cmd, ranks, job, extra_env) -> None:
+        """MPI_Comm_spawn execution: launch new global ranks as their own
+        job (their own COMM_WORLD), wired to the same coord server."""
+        for rank in ranks:
+            env = dict(env_base)
+            env.update({k: str(v) for k, v in extra_env.items()})
+            env["OTPU_RANK"] = str(rank)
+            env["OTPU_JOB"] = job
+            env["OTPU_JOB_RANKS"] = ",".join(str(r) for r in ranks)
+            env["OTPU_NPROCS"] = str(len(ranks))
+            if args.fake_nodes > 0:
+                env["OTPU_NODE_ID"] = f"node{rank % args.fake_nodes}"
+            _launch(rank, env, argv=list(spawn_cmd))
+
+    server.set_spawn_handler(_spawn_handler)
+
     for rank in range(args.nprocs):
         env = dict(env_base)
         env["OTPU_RANK"] = str(rank)
         if args.fake_nodes > 0:
             env["OTPU_NODE_ID"] = f"node{rank * args.fake_nodes // args.nprocs}"
         try:
-            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                                 stderr=subprocess.STDOUT)
+            _launch(rank, env)
         except OSError as exc:
             print(f"tpurun: cannot launch {cmd[0]!r}: {exc}", file=sys.stderr)
             for q in procs:
                 q.kill()
             server.close()
             return 127
-        procs.append(p)
-        t = threading.Thread(target=_pump, args=(rank, p.stdout), daemon=True)
-        t.start()
-        pumps.append(t)
 
     exit_code = 0
     reported_failed: set = set()
     try:
         while True:
-            alive = [p for p in procs if p.poll() is None]
-            failed = [p for p in procs
+            snapshot = list(procs)
+            alive = [p for p in snapshot if p.poll() is None]
+            failed = [p for p in snapshot
                       if p.poll() is not None and p.returncode != 0]
             if server.aborted is not None:
                 exit_code = server.aborted
@@ -115,8 +138,9 @@ def main(argv=None) -> int:
                 if args.enable_recovery:
                     # ULFM: report the death, keep the job running — the
                     # PRRTE-daemon-detects-child-death path of the reference
-                    for rank, p in enumerate(procs):
-                        if p in failed and rank not in reported_failed:
+                    for p in failed:
+                        rank = proc_rank[p]
+                        if rank not in reported_failed:
                             reported_failed.add(rank)
                             print(f"tpurun: rank {rank} failed (exit "
                                   f"{p.returncode}); continuing (recovery)",
@@ -128,7 +152,7 @@ def main(argv=None) -> int:
                     break
             if not alive:
                 if args.enable_recovery and not any(
-                        p.returncode == 0 for p in procs):
+                        p.returncode == 0 for p in snapshot):
                     # recovery mode, but nothing survived to completion:
                     # the job as a whole failed
                     exit_code = next(p.returncode for p in procs

@@ -1,0 +1,113 @@
+"""otpu_info introspection tool + monitoring interposition components."""
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run_info(*args, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.otpu_info", *args],
+        capture_output=True, text=True, timeout=60, cwd=REPO, env=env)
+
+
+def test_info_all_lists_components_and_vars():
+    r = _run_info("--all")
+    assert r.returncode == 0, r.stderr
+    # frameworks + components with priorities
+    for needle in ("mca coll: tuned (priority 30)",
+                   "mca coll: xla (priority 90)",
+                   "mca btl: sm",
+                   "mca pml: ob1",
+                   "mca io: ompio",
+                   "mca coll: han (priority 40)"):
+        assert needle in r.stdout, needle
+    # vars with values and sources
+    assert "otpu_coll_tuned_allreduce_algorithm" in r.stdout
+    assert "source default" in r.stdout
+
+
+def test_info_param_filter_and_source_tracking():
+    r = _run_info("--param", "coll", "tuned",
+                  env_extra={"OTPU_MCA_coll_tuned_priority": "77"})
+    assert r.returncode == 0, r.stderr
+    assert "otpu_coll_tuned_priority: 77" in r.stdout.replace("  ", " ") \
+        or "77 (type int, source env" in r.stdout
+    # filtered: no btl vars in coll/tuned output
+    assert "otpu_btl_sm" not in r.stdout
+
+
+def test_info_parsable():
+    r = _run_info("--all", "--parsable")
+    assert r.returncode == 0
+    assert any(line.startswith("mca coll:") for line in r.stdout.splitlines())
+
+
+def _tpurun(n, args, timeout=120, extra=()):
+    env = dict(os.environ)
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+         *extra, *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_monitoring_p2p_matrix_and_coll_counters(tmp_path):
+    """pml/coll monitoring records per-peer byte matrices the way the
+    reference's common/monitoring does (common_monitoring.h:48-91)."""
+    script = tmp_path / "mon.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.runtime import monitoring
+        w = ompi_tpu.init()
+        r = w.rank
+        assert monitoring.enabled()
+        # directed traffic: rank 0 -> 1 (two msgs), 1 -> 0 (one msg)
+        if r == 0:
+            w.send(np.zeros(100, np.uint8), 1, tag=1)
+            w.send(np.zeros(28, np.uint8), 1, tag=2)
+            buf = np.zeros(4, np.uint8)
+            w.recv(buf, 1, tag=3)
+        else:
+            b1 = np.zeros(100, np.uint8); w.recv(b1, 0, tag=1)
+            b2 = np.zeros(28, np.uint8); w.recv(b2, 0, tag=2)
+            w.send(np.zeros(4, np.uint8), 0, tag=3)
+        w.allreduce(np.ones(16, np.float32))
+        msgs, byts = monitoring.p2p_matrix(2)
+        if r == 0:
+            assert msgs[0, 1] >= 2 and byts[0, 1] >= 128, (msgs, byts)
+        else:
+            assert msgs[1, 0] >= 1 and byts[1, 0] >= 4, (msgs, byts)
+        colls = monitoring.coll_counters()
+        assert colls.get("allreduce", (0, 0))[0] == 1, colls
+        assert colls["allreduce"][1] == 64   # 16 x float32
+        print(f"monitoring OK rank {r}")
+    """))
+    r = _tpurun(2, [sys.executable, str(script)],
+                extra=("--mca", "monitoring_enable", "1"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("monitoring OK") == 2
+
+
+def test_monitoring_disabled_by_default(tmp_path):
+    script = tmp_path / "nomon.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.runtime import monitoring
+        w = ompi_tpu.init()
+        assert not monitoring.enabled()
+        w.allreduce(np.ones(1))
+        assert monitoring.coll_counters() == {}
+        print("nomon OK")
+    """))
+    r = _tpurun(2, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("nomon OK") == 2

@@ -1,0 +1,111 @@
+"""OpenSHMEM-style PGAS layer: symmetric heap, put/get, atomics, scoll."""
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _tpurun(n, args, timeout=120, extra=()):
+    env = dict(os.environ)
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+         *extra, *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_symmetric_heap_allocator():
+    """memheap invariant: collective allocs give identical offsets, and
+    free+coalesce reclaims the space."""
+    from ompi_tpu.shmem import _Shmem
+
+    heap = _Shmem.__new__(_Shmem)
+    heap.heap_bytes = 1 << 12
+    heap.free_list = [(0, 1 << 12)]
+    a = heap.alloc(100)
+    b = heap.alloc(200)
+    assert a != b and a % 16 == 0 and b % 16 == 0
+    heap.release(a, 100)
+    heap.release(b, 200)
+    c = heap.alloc(1 << 12 - 1)   # coalesced space serves a big block
+    assert c == 0
+
+
+def test_pgas_ring_example():
+    r = _tpurun(4, [sys.executable, str(REPO / "examples" / "pgas_ring.py")])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pgas ring OK: 4 PEs, counter 10" in r.stdout
+
+
+def test_shmem_put_get_atomics_colls(tmp_path):
+    script = tmp_path / "shmem_all.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import ompi_tpu.shmem as shmem
+        shmem.init()
+        me, n = shmem.my_pe(), shmem.n_pes()
+
+        x = shmem.array(4, np.float64)
+        x.local[:] = me * 10.0
+        shmem.barrier_all()
+
+        # get from right neighbor
+        got = shmem.get(x, 4, (me + 1) % n)
+        assert got.tolist() == [((me + 1) % n) * 10.0] * 4, got
+        shmem.barrier_all()   # everyone done reading before anyone writes
+
+        # put into left neighbor's second element
+        shmem.p(x, 500.0 + me, (me - 1) % n, index=1)
+        shmem.barrier_all()
+        assert x.local[1] == 500.0 + (me + 1) % n, x.local
+
+        # typed atomics on a shared int64 counter at PE 0
+        c = shmem.array(1, np.int64)
+        c.local[0] = 0
+        shmem.barrier_all()
+        old = shmem.atomic_fetch_add(c, 1, 0)
+        assert 0 <= old < n
+        shmem.barrier_all()
+        if me == 0:
+            assert c.local[0] == n, c.local
+
+        # compare-and-swap: exactly one PE wins the election slot
+        e = shmem.array(1, np.int64)
+        e.local[0] = -1
+        shmem.barrier_all()
+        prev = shmem.atomic_compare_swap(e, -1, me, 0)
+        shmem.barrier_all()
+        winner = int(shmem.g(e, 0))
+        assert 0 <= winner < n
+        got_it = (prev == -1)
+        wins = np.asarray(shmem._get().world.allgather(
+            np.array([1 if got_it else 0], np.int64)))
+        assert wins.sum() == 1, wins
+
+        # scoll: reductions + collect
+        y = shmem.array(2, np.float64)
+        y.local[:] = [me + 1.0, me * 2.0]
+        shmem.sum_to_all(y)
+        assert y.local[0] == n * (n + 1) / 2
+        z = shmem.array(1, np.int64)
+        z.local[0] = me * me
+        coll = shmem.collect(z)
+        assert coll.tolist() == [i * i for i in range(n)], coll
+        # broadcast
+        b = shmem.array(3, np.float64)
+        b.local[:] = me
+        shmem.broadcast(b, root=2)
+        assert b.local.tolist() == [2.0, 2.0, 2.0]
+
+        shmem.barrier_all()
+        print(f"shmem OK pe {me}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("shmem OK") == 4
