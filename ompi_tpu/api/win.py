@@ -39,11 +39,14 @@ class Win(AttributeHost):
     # -- creation (collective) ------------------------------------------
     @classmethod
     def create(cls, comm, size: Optional[int] = None, base=None,
-               dtype=np.float64, name: str = "") -> "Win":
+               dtype=np.float64, name: str = "",
+               device: bool = False) -> "Win":
         """``MPI_Win_create`` / ``MPI_Win_allocate``.
 
         ``base``: expose an existing 1-D array; or ``size``: allocate a
         zero-filled region of ``size`` elements of ``dtype``.
+        ``device=True`` in a device world allocates the window in HBM
+        (osc/device: a sharded ``jax.Array`` exposure region per rank).
         """
         if base is None:
             if size is None:
@@ -56,6 +59,8 @@ class Win(AttributeHost):
                 raise MpiError(ErrorClass.ERR_WIN,
                                "window base must be 1-D")
         win = cls(comm.dup(), base, name=name)
+        win.dtype = base.dtype     # survives device windows (local=None)
+        win.device = device
         from ompi_tpu.mca.osc import win_select
 
         win_select(win)
@@ -101,7 +106,7 @@ class Win(AttributeHost):
                      op: op_mod.Op = op_mod.SUM):
         self._check()
         out = self.module.get_accumulate(
-            self, np.asarray([value], dtype=self.local.dtype), target,
+            self, np.asarray([value], dtype=self.dtype), target,
             offset, op)
         return out[0]
 
@@ -180,5 +185,5 @@ class Win(AttributeHost):
         self.freed = True
 
     def __repr__(self) -> str:
-        return (f"Win({self.name}, rank={self.rank}/{self.size}, "
-                f"len={self.local.size})")
+        n = self.local.size if self.local is not None else "device"
+        return f"Win({self.name}, rank={self.rank}/{self.size}, len={n})"

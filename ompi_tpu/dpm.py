@@ -172,11 +172,23 @@ def connect(comm, port: str, root: int = 0) -> Comm:
     info = np.zeros(1, np.int64)
     if comm.rank == root:
         client = _client(comm)
-        other = None
-        while other is None:   # block past the KV's 60 s get timeout
-            other = client.get(-1, f"__dpm_accept__:{port}", wait=True)
-        client.put(-1, f"__dpm_connect__:{port}",
-                   {"ranks": list(comm.group.world_ranks)})
+        token = client.fetch_add(-1, "__dpm_conn_seq__", 1)
+        while True:
+            other = None
+            while other is None:   # block past the KV's 60 s get timeout
+                other = client.get(-1, f"__dpm_accept__:{port}", wait=True)
+            # first connector wins the pairing (put_new is atomic); a
+            # loser waits for the acceptor to consume the pair and
+            # retries against the NEXT accept on this port
+            mine = {"ranks": list(comm.group.world_ranks), "token": token}
+            got = client.put_new(-1, f"__dpm_connect__:{port}", mine)
+            if got.get("token") == token:
+                break
+            import time as _time
+
+            while client.get(-1, f"__dpm_connect__:{port}",
+                             wait=False) is not None:
+                _time.sleep(0.01)
         info[0] = other["cid"]
         remote = other["ranks"]
     else:

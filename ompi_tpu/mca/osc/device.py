@@ -1,0 +1,145 @@
+"""osc/device — RMA windows on TPU-resident buffers (HBM windows).
+
+The device half of the one-sided story (SURVEY Phase 4): each rank's
+exposure region is a row of a ``jax.Array`` sharded over the communicator's
+device mesh, so window memory lives in HBM.  put/get/accumulate are
+expressed as XLA updates on the global array — the reference-semantics
+implementation whose ops a later Pallas ``make_async_remote_copy`` kernel
+can replace one-for-one (the device analog of the BTL put/get the
+reference's osc/rdma rides).
+
+Single-controller model: the conductor issues every rank's operations, so
+epochs are ordered by construction and fences compile to nothing; what
+this module pins down is the *data path* — which buffers constitute the
+window, where updates land, and the at-offset update semantics.
+
+Select with ``Win.create(comm, ..., device=True)`` in a device world.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+
+
+class DeviceModule:
+    """Window = (size, n) jax.Array, row r on device-rank r's HBM."""
+
+    def attach(self, win) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rte = win.comm.rte
+        self._mesh = rte.mesh
+        self._sharding = NamedSharding(self._mesh, P(self._mesh.axis_names[0]))
+        base = np.broadcast_to(np.asarray(win.local),
+                               (win.size, win.local.size))
+        self._win_array = jax.device_put(np.array(base), self._sharding)
+        win.device_array = self._win_array
+        # the exposure region lives in HBM: drop the host alias so stores
+        # to a stale win.local cannot silently diverge from RMA (put/get
+        # are the window API; rdma re-points win.local instead because its
+        # mapped memory CAN alias)
+        win.local = None
+
+    def detach(self, win) -> None:
+        self._win_array = None
+        win.device_array = None
+
+    # -- data path (XLA updates; Pallas remote-DMA swap point) -----------
+    def put(self, win, arr, target: int, offset: int) -> None:
+        import jax.numpy as jnp
+
+        vals = jnp.asarray(np.asarray(arr), self._win_array.dtype)
+        self._win_array = self._win_array.at[target,
+                                             offset:offset + vals.size
+                                             ].set(vals)
+        win.device_array = self._win_array
+
+    def get(self, win, count: int, target: int, offset: int) -> np.ndarray:
+        return np.asarray(
+            self._win_array[target, offset:offset + count])
+
+    def accumulate(self, win, arr, target: int, offset: int, op) -> None:
+        import jax.numpy as jnp
+
+        vals = jnp.asarray(np.asarray(arr), self._win_array.dtype)
+        sl = (target, slice(offset, offset + vals.size))
+        if op is op_mod.SUM:
+            self._win_array = self._win_array.at[sl].add(vals)
+        elif op is op_mod.MAX:
+            self._win_array = self._win_array.at[sl].max(vals)
+        elif op is op_mod.MIN:
+            self._win_array = self._win_array.at[sl].min(vals)
+        elif op is op_mod.PROD:
+            self._win_array = self._win_array.at[sl].mul(vals)
+        elif op is op_mod.REPLACE:
+            self._win_array = self._win_array.at[sl].set(vals)
+        else:
+            raise MpiError(ErrorClass.ERR_OP,
+                           f"device window accumulate: unsupported {op}")
+        win.device_array = self._win_array
+
+    def get_accumulate(self, win, arr, target: int, offset: int,
+                       op) -> np.ndarray:
+        old = self.get(win, np.asarray(arr).size, target, offset)
+        self.accumulate(win, arr, target, offset, op)
+        return old
+
+    def compare_and_swap(self, win, value, compare, target: int,
+                         offset: int):
+        old = self.get(win, 1, target, offset)[0]
+        if old == compare:
+            self.put(win, np.asarray([value]), target, offset)
+        return old
+
+    # -- sync: single thread of control orders everything -----------------
+    def fence(self, win) -> None:
+        pass
+
+    def flush(self, win, target: int) -> None:
+        pass
+
+    def lock(self, win, target: int, lock_type: str) -> None:
+        pass
+
+    def unlock(self, win, target: int) -> None:
+        pass
+
+    def post(self, win, group) -> None:
+        pass
+
+    def start(self, win, group) -> None:
+        pass
+
+    def complete(self, win) -> None:
+        pass
+
+    def wait(self, win) -> None:
+        pass
+
+
+class DeviceOscComponent(Component):
+    name = "device"
+    priority = 90     # above osc/local: explicit device=True windows only
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=90,
+            help="Selection priority of osc/device (HBM windows)")
+
+    def win_query(self, win):
+        rte = win.comm.rte
+        if rte is None or not rte.is_device_world:
+            return None
+        if not getattr(win, "device", False):
+            return None
+        if rte.mesh is None:
+            return None
+        return self._prio.value, DeviceModule()
+
+
+COMPONENT = DeviceOscComponent()

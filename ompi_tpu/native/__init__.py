@@ -73,6 +73,26 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.otpu_ring_pop.restype = ctypes.c_int64
         lib.otpu_ring_pop.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, _U8P, ctypes.c_uint64]
+        # osc/rdma window atomics
+        for name in ("otpu_lock_excl_try", "otpu_lock_shared_try"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p]
+        for name in ("otpu_lock_excl_release", "otpu_lock_shared_release"):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.c_void_p]
+        lib.otpu_atomic_add_i64.restype = ctypes.c_int64
+        lib.otpu_atomic_add_i64.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.otpu_atomic_cas_i64.restype = ctypes.c_int64
+        lib.otpu_atomic_cas_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.otpu_atomic_load_u64.restype = ctypes.c_uint64
+        lib.otpu_atomic_load_u64.argtypes = [ctypes.c_void_p]
+        lib.otpu_atomic_store_u64.restype = None
+        lib.otpu_atomic_store_u64.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_uint64]
         _lib = lib
         return _lib
 
@@ -100,6 +120,45 @@ def unpack_elems(mem: np.ndarray, chunk: np.ndarray, seg_off: np.ndarray,
     return int(lib.otpu_unpack_elems(
         mem, chunk, seg_off, seg_len, len(seg_off), extent, base_offset,
         first_elem, nelem))
+
+
+# -- osc/rdma window atomics ---------------------------------------------
+
+def lock_excl_try(addr: int) -> bool:
+    return bool(_load().otpu_lock_excl_try(addr))
+
+
+def lock_excl_release(addr: int) -> None:
+    _load().otpu_lock_excl_release(addr)
+
+
+def lock_shared_try(addr: int) -> bool:
+    return bool(_load().otpu_lock_shared_try(addr))
+
+
+def lock_shared_release(addr: int) -> None:
+    _load().otpu_lock_shared_release(addr)
+
+
+def atomic_add_i64(addr: int, delta: int) -> int:
+    """Fetch-and-add on a mapped int64; returns the old value."""
+    return int(_load().otpu_atomic_add_i64(addr, delta))
+
+
+def atomic_cas_i64(addr: int, expected: int, desired: int) -> tuple:
+    """(old_value, swapped) CAS on a mapped int64."""
+    ok = ctypes.c_int32(0)
+    old = _load().otpu_atomic_cas_i64(addr, expected, desired,
+                                      ctypes.byref(ok))
+    return int(old), bool(ok.value)
+
+
+def atomic_load_u64(addr: int) -> int:
+    return int(_load().otpu_atomic_load_u64(addr))
+
+
+def atomic_store_u64(addr: int, v: int) -> None:
+    _load().otpu_atomic_store_u64(addr, v)
 
 
 # -- sm ring entry points -------------------------------------------------

@@ -124,4 +124,64 @@ int64_t otpu_ring_pop(uint8_t *buf, uint64_t cap, uint8_t *out,
     return (int64_t)n;
 }
 
+// ---- osc/rdma: cross-process atomics on mapped windows ------------------
+//
+// The reference's osc/rdma implements locks and accumulates via remote
+// atomic CAS over the BTL (`osc_rdma_accumulate.c:26-71`).  On a same-host
+// mapped window the "remote" atomic is a plain shared-memory atomic; the
+// lock word lives in the window segment header.  Layout of the lock word:
+// bit 63 = exclusive held, bits 0..62 = shared-reader count.
+
+static const uint64_t EXCL_BIT = 1ull << 63;
+
+int otpu_lock_excl_try(uint8_t *word) {
+    uint64_t expected = 0;
+    return __atomic_compare_exchange_n(
+        (uint64_t *)word, &expected, EXCL_BIT, false,
+        __ATOMIC_ACQUIRE, __ATOMIC_RELAXED) ? 1 : 0;
+}
+
+void otpu_lock_excl_release(uint8_t *word) {
+    __atomic_store_n((uint64_t *)word, 0, __ATOMIC_RELEASE);
+}
+
+int otpu_lock_shared_try(uint8_t *word) {
+    uint64_t cur = __atomic_load_n((uint64_t *)word, __ATOMIC_RELAXED);
+    while (!(cur & EXCL_BIT)) {
+        if (__atomic_compare_exchange_n(
+                (uint64_t *)word, &cur, cur + 1, false,
+                __ATOMIC_ACQUIRE, __ATOMIC_RELAXED))
+            return 1;
+        // cur reloaded by the failed CAS; loop unless exclusive appeared
+    }
+    return 0;
+}
+
+void otpu_lock_shared_release(uint8_t *word) {
+    __atomic_fetch_sub((uint64_t *)word, 1, __ATOMIC_RELEASE);
+}
+
+int64_t otpu_atomic_add_i64(uint8_t *ptr, int64_t delta) {
+    return __atomic_fetch_add((int64_t *)ptr, delta, __ATOMIC_ACQ_REL);
+}
+
+// returns the OLD value; *ok set to 1 when the swap happened
+int64_t otpu_atomic_cas_i64(uint8_t *ptr, int64_t expected, int64_t desired,
+                            int32_t *ok) {
+    int64_t exp = expected;
+    int swapped = __atomic_compare_exchange_n(
+        (int64_t *)ptr, &exp, desired, false,
+        __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE);
+    *ok = swapped ? 1 : 0;
+    return exp;  // old value on failure, `expected` (== old) on success
+}
+
+uint64_t otpu_atomic_load_u64(const uint8_t *ptr) {
+    return __atomic_load_n((const uint64_t *)ptr, __ATOMIC_ACQUIRE);
+}
+
+void otpu_atomic_store_u64(uint8_t *ptr, uint64_t v) {
+    __atomic_store_n((uint64_t *)ptr, v, __ATOMIC_RELEASE);
+}
+
 }  // extern "C"

@@ -1,0 +1,41 @@
+"""osc/device — HBM window semantics on the 8-device CPU mesh."""
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.api.win import Win
+
+
+def _world():
+    return ompi_tpu.init()
+
+
+def test_device_window_put_get_accumulate():
+    w = _world()
+    if not w.rte.is_device_world or w.size < 2:
+        import pytest
+
+        pytest.skip("needs a device world")
+    win = Win.create(w, size=8, dtype=np.float32, device=True)
+    assert type(win.module).__name__ == "DeviceModule"
+    assert win.device_array.shape == (w.size, 8)
+
+    win.put(np.array([3.5, 4.5], np.float32), 1, offset=2)
+    got = win.get(2, 1, offset=2)
+    assert got.tolist() == [3.5, 4.5]
+
+    win.accumulate(np.array([1.0], np.float32), 1, offset=2)
+    assert win.get(1, 1, offset=2)[0] == 4.5
+
+    old = win.get_accumulate(np.array([10.0], np.float32), 0, offset=0)
+    assert old[0] == 0.0
+    assert win.get(1, 0, offset=0)[0] == 10.0
+
+    old = win.compare_and_swap(7.0, 10.0, 0, offset=0)
+    assert old == 10.0 and win.get(1, 0, offset=0)[0] == 7.0
+
+    # the window stays a device array (HBM residency)
+    import jax
+
+    assert isinstance(win.device_array, jax.Array)
+    win.fence()
+    win.free()
