@@ -9,6 +9,7 @@ from ompi_tpu.api.errors import ErrorClass
 ANY_SOURCE = -1
 ANY_TAG = -1
 PROC_NULL = -2
+ROOT = -4          # intercomm collective root sentinel (MPI_ROOT)
 UNDEFINED = -32766
 
 

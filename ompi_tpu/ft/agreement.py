@@ -41,6 +41,25 @@ def _key(instance: tuple, kind: str) -> str:
     return f"ftagree:{kind}:" + ":".join(str(x) for x in instance)
 
 
+def _setup_instance(rte, instance: tuple, contribution: Any,
+                    prev_instance: Optional[tuple]):
+    """Common preamble: require the coord client, GC the read-complete
+    prior instance (see agree_kv's seq-2 contract), publish my
+    contribution as the fallback/takeover anchor."""
+    client = getattr(rte, "client", None)
+    if client is None:
+        raise AgreementError(
+            "agreement requires the coordination service (ProcRte)")
+    if prev_instance is not None:
+        try:
+            client.delete(rte.my_world_rank, _key(prev_instance, "c"))
+            client.delete(-1, _key(prev_instance, "d"))
+        except Exception:
+            pass
+    rte.modex_put(_key(instance, "c"), contribution)
+    return client
+
+
 def agree_kv(
     rte,
     instance: tuple,
@@ -70,21 +89,8 @@ def agree_kv(
     """
     participants = sorted(participants)
     me = rte.my_world_rank
-    ckey = _key(instance, "c")
     dkey = _key(instance, "d")
-    client = getattr(rte, "client", None)
-    if client is None:
-        raise AgreementError(
-            "kv agreement requires the coordination service (ProcRte)")
-    if prev_instance is not None:
-        # my contribution to the previous instance + its decision slot
-        # (idempotent: every participant deletes the shared slot)
-        try:
-            client.delete(me, _key(prev_instance, "c"))
-            client.delete(-1, _key(prev_instance, "d"))
-        except Exception:
-            pass
-    rte.modex_put(ckey, contribution)
+    client = _setup_instance(rte, instance, contribution, prev_instance)
     deadline = time.monotonic() + timeout
 
     while True:
@@ -112,6 +118,166 @@ def agree_kv(
             got = None
         if got is not None:
             return got
+
+
+def agree_tree(
+    comm,
+    instance: tuple,
+    contribution: Any,
+    participants: Iterable[int],
+    combine: Callable[[Any, Any], Any],
+    timeout: float = 60.0,
+    prev_instance: Optional[tuple] = None,
+) -> tuple[Any, frozenset]:
+    """ERA-shaped agreement: binomial-tree p2p reduce + uniform KV slot.
+
+    The reference's ERA (``coll_ftagree_earlyreturning.c``) reduces
+    contributions up a resilient tree and rebalances around failures.
+    Here the tree is STATIC over the participants list (identical on every
+    rank — divergent failure views must not produce divergent trees) and
+    carries *coverage-tagged partials* — ``(member_set, partial)`` — so
+    the root knows which members a partial represents; coverage a failure
+    knocked out of the tree is recovered from the members' published KV
+    contributions, and orphans whose parent died fall back to the
+    per-instance atomic first-writer-wins decision slot, which every
+    waiter polls (the early return) and which makes the outcome uniform
+    no matter which path computed it.
+
+    Messaging bypasses the Comm wrappers (pml direct): agreement must
+    keep working on a revoked communicator and with failed peers — the
+    two cases ``Comm._check_state`` turns into exceptions.
+
+    ``combine`` must be associative AND commutative (partials fold in
+    coverage order, not rank order).
+    """
+    rte = comm.rte
+    me = rte.my_world_rank
+    participants = sorted(participants)
+    ckey = _key(instance, "c")
+    dkey = _key(instance, "d")
+    client = _setup_instance(rte, instance, contribution, prev_instance)
+    deadline = time.monotonic() + timeout
+
+    # STATIC binomial tree over participants: parent clears the lowest
+    # set bit; vrank v owns children v + 2^k for k below v's lowest set
+    # bit (all bits for the root) — the coll_base_topo binomial shape
+    n = len(participants)
+    idx = participants.index(me) if me in participants else 0
+    max_k = _lowbit(idx) if idx else max(1, n - 1).bit_length()
+    children = [participants[idx + (1 << k)] for k in range(max_k)
+                if idx + (1 << k) < n]
+    parent = None if idx == 0 else participants[idx & (idx - 1)]
+
+    coverage = {me}
+    acc = contribution
+    # deterministic across processes (hash() is salted per interpreter)
+    import zlib
+
+    tag = -(1 << 23) - (zlib.crc32(repr(instance).encode()) % (1 << 20))
+    pml = comm.pml
+
+    def _slot() -> Optional[tuple]:
+        return client.get(-1, dkey, wait=False)
+
+    def _recv_obj_raw(src_world: int):
+        """recv_obj without Comm._check_state (revoked/failed-safe)."""
+        import pickle
+
+        import numpy as np
+
+        src = comm.group.rank_of(src_world)
+        hdr = np.zeros(1, np.int64)
+        pml.recv(comm, hdr, src, tag)
+        payload = np.zeros(int(hdr[0]), np.uint8)
+        pml.recv(comm, payload, src, tag)
+        return pickle.loads(payload.tobytes())
+
+    def _send_obj_raw(obj, dst_world: int) -> None:
+        import pickle
+
+        import numpy as np
+
+        dst = comm.group.rank_of(dst_world)
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        pml.send(comm, np.array([payload.size], np.int64), dst, tag)
+        pml.send(comm, payload, dst, tag)
+
+    # phase up: collect each child's coverage-tagged partial; a dead
+    # child's subtree is recovered from the KV by whoever roots
+    decided = None
+    last_slot_poll = 0.0
+    for child in children:
+        while decided is None:
+            ok, _st = pml.probe(comm, comm.group.rank_of(child), tag,
+                                blocking=False)
+            if ok:
+                try:
+                    cov, val = _recv_obj_raw(child)
+                except Exception:
+                    break          # child died mid-message: KV recovery
+                coverage |= set(cov)
+                acc = combine(acc, val)
+                break
+            if ft_state.is_failed(child):
+                break
+            now = time.monotonic()
+            if now - last_slot_poll > 0.1:
+                last_slot_poll = now
+                decided = _slot()  # someone already decided: early return
+            if now > deadline:
+                raise AgreementError(f"tree agree {instance} timed out")
+        if decided is not None:
+            return decided
+
+    if parent is not None and not ft_state.is_failed(parent):
+        try:
+            _send_obj_raw((sorted(coverage), acc), parent)
+        except Exception:
+            pass    # parent died mid-send: the slot path covers us
+        # park on the uniform decision slot (the root's early return)
+        while True:
+            try:
+                got = client.get(-1, dkey, wait=True, timeout=0.5)
+            except Exception:
+                got = None
+            if got is not None:
+                return got
+            if time.monotonic() > deadline:
+                raise AgreementError(f"tree agree {instance} timed out")
+            # root chain may have died: lowest live rank takes over
+            live = [r for r in participants if not ft_state.is_failed(r)]
+            if live and live[0] == me:
+                decision = _decide(rte, instance, participants, combine,
+                                   deadline, 0.02)
+                return client.put_new(-1, dkey, decision)
+    # I root this agreement (or my parent died): fill missing coverage
+    # from the KV contributions
+    missing = [r for r in participants
+               if r not in coverage and not ft_state.is_failed(r)]
+    while missing:
+        got = _slot()
+        if got is not None:
+            return got
+        still = []
+        for r in missing:
+            val = rte.modex_get(r, ckey, wait=False)
+            if val is not None:
+                acc = combine(acc, val)
+                coverage.add(r)
+            elif not ft_state.is_failed(r):
+                still.append(r)
+        missing = still
+        if missing:
+            if time.monotonic() > deadline:
+                raise AgreementError(
+                    f"tree agree {instance}: missing {missing}")
+            time.sleep(0.02)
+    failed = frozenset(r for r in participants if ft_state.is_failed(r))
+    return client.put_new(-1, dkey, (acc, failed))
+
+
+def _lowbit(x: int) -> int:
+    return (x & -x).bit_length() - 1
 
 
 def _decide(rte, instance, participants, combine, deadline, poll):

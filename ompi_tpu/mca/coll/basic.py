@@ -266,8 +266,8 @@ class BasicCollComponent(Component):
     def comm_query(self, comm):
         if comm.rte is not None and comm.rte.is_device_world:
             return None  # conductor model handles host collectives there
-        if comm.size == 1:
-            return None
+        if comm.size == 1 or comm.is_inter:
+            return None  # intercomms take coll/inter's two-group protocol
         return self._prio.value, BasicCollModule()
 
 

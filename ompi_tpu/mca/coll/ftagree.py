@@ -22,9 +22,12 @@ from ompi_tpu.ft import state as ft_state
 
 
 class FtAgreeModule:
+    def __init__(self, component: "FtAgreeComponent") -> None:
+        self._c = component
+
     def agree(self, comm, flag: int) -> int:
         from ompi_tpu.api.errors import ProcFailedError
-        from ompi_tpu.ft.agreement import agree_kv
+        from ompi_tpu.ft.agreement import agree_kv, agree_tree
 
         members = list(comm.group.world_ranks)
         live = [r for r in members if not ft_state.is_failed(r)]
@@ -38,15 +41,19 @@ class FtAgreeModule:
         known_failed = ft_state.failed_ranks()
         my_unacked = any(r in known_failed and r not in acked
                          for r in members)
-        (agreed_flag, agreed_failed, any_unacked), _ = agree_kv(
-            comm.rte,
-            ("agree", comm.cid, comm.epoch, seq),
-            (int(flag), known_failed, my_unacked),
-            live,
-            lambda a, b: (a[0] & b[0], a[1] | b[1], a[2] or b[2]),
-            prev_instance=(("agree", comm.cid, comm.epoch, seq - 2)
-                           if seq > 2 else None),
-        )
+        instance = ("agree", comm.cid, comm.epoch, seq)
+        prev = (("agree", comm.cid, comm.epoch, seq - 2)
+                if seq > 2 else None)
+        combine = lambda a, b: (a[0] & b[0], a[1] | b[1], a[2] or b[2])
+        contribution = (int(flag), known_failed, my_unacked)
+        if (self._c.alg_var.value or "era").strip() == "era":
+            (agreed_flag, agreed_failed, any_unacked), _ = agree_tree(
+                comm, instance, contribution, live, combine,
+                prev_instance=prev)
+        else:
+            (agreed_flag, agreed_failed, any_unacked), _ = agree_kv(
+                comm.rte, instance, contribution, live, combine,
+                prev_instance=prev)
         if any_unacked:
             in_group_failed = [r for r in members if r in agreed_failed]
             err = ProcFailedError(
@@ -71,6 +78,11 @@ class FtAgreeComponent(Component):
         self._prio = self.register_var(
             "priority", vtype=VarType.INT, default=30,
             help="Selection priority of coll/ftagree")
+        self.alg_var = self.register_var(
+            "algorithm", vtype=VarType.STRING, default="era",
+            help="Agreement algorithm: 'era' (binomial-tree p2p reduce "
+                 "with KV-anchored uniform decision) or 'kv' "
+                 "(coordinator-decides over the coordination service)")
 
     def comm_query(self, comm):
         # the consensus needs the out-of-band KV service: multi-process only
@@ -80,7 +92,7 @@ class FtAgreeComponent(Component):
             return None
         if comm.size == 1:
             return None
-        return self._prio.value, FtAgreeModule()
+        return self._prio.value, FtAgreeModule(self)
 
 
 COMPONENT = FtAgreeComponent()

@@ -1,0 +1,30 @@
+"""hook — init/finalize interposition framework.
+
+Re-design of ``/root/reference/ompi/mca/hook/`` (the framework whose one
+shipping component, ``hook/comm_method``, dumps the selected transport
+matrix at init): components register callbacks that the runtime invokes at
+well-known points (post-init, pre-finalize).
+"""
+from __future__ import annotations
+
+from ompi_tpu.base import mca
+
+
+def hook_framework() -> mca.Framework:
+    return mca.framework("hook", "init/finalize interposition",
+                         multi_select=True)
+
+
+def run_hooks(point: str, *args) -> None:
+    """Invoke every component's ``at_<point>`` callback."""
+    fw = hook_framework()
+    for comp in fw.select_all():
+        fn = getattr(comp, f"at_{point}", None)
+        if fn is not None:
+            try:
+                fn(*args)
+            except Exception as exc:
+                from ompi_tpu.base import output as _o
+
+                _o.output(fw.stream, 1, "hook %s/%s failed: %s",
+                          comp.name, point, exc)

@@ -1,0 +1,47 @@
+"""hook/comm_method — print the selected transport matrix at init.
+
+Re-design of ``/root/reference/ompi/mca/hook/comm_method/`` (1,904 LoC):
+when ``otpu_hook_comm_method_display`` is set, each rank (or just rank 0
+with the full matrix) reports which BTL reaches every peer — the tool for
+answering "is this job actually using sm or falling back to tcp?".
+"""
+from __future__ import annotations
+
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+
+
+class CommMethodComponent(Component):
+    name = "comm_method"
+    priority = 10
+
+    def register_vars(self, fw) -> None:
+        self.display_var = self.register_var(
+            "display", vtype=VarType.BOOL, default=False,
+            help="Print the per-peer transport (BTL) matrix after init "
+                 "(hook/comm_method's mca_hook_comm_method_enable_mpi_init)")
+
+    def at_init(self, world) -> None:
+        if not bool(self.display_var.value):
+            return
+        pml = world.pml
+        bml = getattr(pml, "bml", None)
+        if bml is None:         # monitoring wrapper interposed
+            bml = getattr(getattr(pml, "_inner", None), "bml", None)
+        if bml is None:
+            return
+        me = world.rank
+        cells = []
+        for r in range(world.size):
+            w = world.world_rank(r)
+            if w == world.rte.my_world_rank:
+                cells.append("self*")
+                continue
+            eps = bml.endpoints(w)
+            cells.append(eps[0].btl.name if eps else "none")
+        print(f"[comm_method] rank {me}: " +
+              " ".join(f"{r}:{c}" for r, c in enumerate(cells)),
+              flush=True)
+
+
+COMPONENT = CommMethodComponent()

@@ -171,6 +171,11 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
 
                 propagator.start(_rte, with_detector=bool(_ft_detector.value))
 
+        # hook framework: post-init interposition (hook/comm_method dump)
+        from ompi_tpu.mca.hook import run_hooks
+
+        run_hooks("init", _world)
+
         mark_runtime_initialized(True)
         _state = State.INIT_COMPLETED
         atexit.register(_atexit_finalize)

@@ -1,0 +1,59 @@
+"""mpisync — clock-offset measurement across ranks.
+
+Re-design of ``/root/reference/ompi/tools/mpisync/`` (the HPE/MVAPICH-
+lineage ``mpigclock`` tool): rank 0 exchanges ping-pong timestamps with
+every other rank, estimates each peer's clock offset as
+``theirs - (t_send + rtt/2)``, and prints one line per rank — the data
+needed to merge per-rank trace timelines.
+
+Run:  python -m ompi_tpu.tools.tpurun -n 4 python -m ompi_tpu.tools.mpisync
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def measure(comm, iters: int = 10) -> list:
+    """Rank 0 returns [(rank, offset_s, rtt_s)] for every peer."""
+    results = []
+    if comm.rank == 0:
+        for peer in range(1, comm.size):
+            best_rtt, best_off = float("inf"), 0.0
+            for _ in range(iters):
+                t0 = time.time()
+                comm.send(np.array([t0]), peer, tag=91)
+                buf = np.zeros(1)
+                comm.recv(buf, peer, tag=92)
+                t1 = time.time()
+                rtt = t1 - t0
+                if rtt < best_rtt:     # min-RTT filter, like the tool
+                    best_rtt = rtt
+                    best_off = float(buf[0]) - (t0 + rtt / 2)
+            results.append((peer, best_off, best_rtt))
+    else:
+        for _ in range(iters):
+            buf = np.zeros(1)
+            comm.recv(buf, 0, tag=91)
+            comm.send(np.array([time.time()]), 0, tag=92)
+    comm.barrier()
+    return results
+
+
+def main(argv=None) -> int:
+    import ompi_tpu
+
+    world = ompi_tpu.init()
+    results = measure(world)
+    if world.rank == 0:
+        print("rank offset_us rtt_us")
+        print("0 0.0 0.0   # reference clock")
+        for rank, off, rtt in results:
+            print(f"{rank} {off * 1e6:.1f} {rtt * 1e6:.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
