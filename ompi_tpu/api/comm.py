@@ -574,12 +574,40 @@ class Comm(AttributeHost):
         else:
             color = 0 if self.rank < grid else -1
             key = self.rank
+            if reorder:
+                # treematch-style hardware mapping (the reference's
+                # topo/treematch, topo_treematch_dist_graph_create.c):
+                # order ranks by node so row-major cart neighbors — the
+                # highest-traffic pairs in halo patterns — land on the
+                # same node wherever possible.  The reorder decision must
+                # be COLLECTIVE: a rank with unresolved locality must not
+                # fall back alone while its peers reorder (membership of
+                # the grid would diverge)
+                order = self._node_major_order()
+                ok = 1 if order is not None else 0
+                from ompi_tpu.api import op as _op
+
+                all_ok = int(np.asarray(self.allreduce(
+                    np.array([ok], np.int64), op_mod.MIN)).ravel()[0])
+                if all_ok and order is not None:
+                    key = order.index(self.rank)
+                    color = 0 if key < grid else -1
         sub = self.split(color, key)
         if sub is None:
             return None
         sub.topo = CartTopo(dims, periods)
         sub.name = f"{self.name}~cart"
         return sub
+
+    def _node_major_order(self) -> Optional[list]:
+        """Comm ranks sorted by (node, rank); None if locality unknown."""
+        rte = self.rte
+        if rte is None:
+            return None
+        nodes = [rte.node_of(w) for w in self.group.world_ranks]
+        if any(n is None for n in nodes):
+            return None
+        return sorted(range(self.size), key=lambda r: (str(nodes[r]), r))
 
     def cart_coords(self, rank: Optional[int] = None) -> list:
         self._require_topo("cart")

@@ -293,9 +293,8 @@ class HanCollComponent(Component):
         self._node_cache: dict[int, object] = {}
 
     def _node_of_world_rank(self, rte, w: int):
-        if w not in self._node_cache:
-            self._node_cache[w] = rte.modex_get(w, "node")
-        return self._node_cache[w]
+        # shared cached locality lookup (published before the init fence)
+        return rte.node_of(w)
 
     def comm_query(self, comm):
         rte = comm.rte

@@ -1,0 +1,221 @@
+"""coll/sm — shared-memory collectives on a mapped segment.
+
+Re-design of ``/root/reference/ompi/mca/coll/sm/`` (2,813 LoC): same-node
+ranks of a communicator map one shared segment and run bcast / allreduce /
+barrier through it directly — one copy in, one copy out, no per-fragment
+pickling through the btl rings.  Synchronization uses monotonically
+increasing shared counters (native C++ atomics), so no reset races exist:
+round ``k`` of an operation waits for its counter to reach ``k * n``.
+
+Segment layout::
+
+    [ bar_arrive u64 | bc_gen u64 | bc_readers u64 | ar_arrive u64 |
+      ar_done u64 | pad to 64 ]
+    [ bcast buffer: slot ]
+    [ n contribution slots: slot each ]
+
+Payloads larger than the slot (``otpu_coll_sm_coll_slot_size``) fall back
+to the rank-ordered basic algorithms.  Selected between tuned (30) and
+han (40) when every member shares this node and the native library is
+available.
+"""
+from __future__ import annotations
+
+import os
+import time
+from multiprocessing import shared_memory
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+from ompi_tpu.mca.btl.sm import _attach
+from ompi_tpu.mca.coll.basic import BasicCollModule
+
+_HDR = 64
+_BAR_ARRIVE = 0
+_BC_GEN = 8
+_BC_READERS = 16
+_AR_ARRIVE = 24
+_AR_DONE = 32
+
+
+class SmCollModule:
+    def __init__(self, component: "SmCollComponent") -> None:
+        self._c = component
+        self._fallback = BasicCollModule()
+        self._seg = None
+        self._addr = 0
+        self._slot = int(component.slot_var.value)
+        self._rounds = {"bar": 0, "bc": 0, "ar": 0}
+
+    # -- lifecycle -------------------------------------------------------
+    def comm_enable(self, comm) -> None:
+        from ompi_tpu import native
+
+        self._native = native
+        n = comm.size
+        size = _HDR + self._slot * (n + 1)
+        tag = os.environ.get("OTPU_COORD", "l").replace(":", "_") \
+            .replace(".", "_")
+        rte = comm.rte
+        # job-qualified: a spawned job's cid-0 world must not collide with
+        # the parent job's
+        name = f"otpu_csm_{tag}_{getattr(rte, 'job', '0')}_{comm.cid}"
+        if comm.rank == 0:
+            shm = shared_memory.SharedMemory(name=name, create=True,
+                                             size=size)
+            shm.buf[:_HDR] = b"\0" * _HDR
+            rte.modex_put(f"coll_sm_{comm.cid}", name)
+        else:
+            # rank 0 publishes during ITS comm_enable; comm creation is
+            # collective so the blocking get cannot deadlock
+            got = rte.modex_get(comm.group.world_rank(0),
+                                f"coll_sm_{comm.cid}")
+            shm = _attach(got)
+        import ctypes
+
+        self._seg = shm
+        self._addr = ctypes.addressof(ctypes.c_char.from_buffer(shm.buf))
+        self._buf = np.frombuffer(shm.buf, np.uint8, offset=_HDR)
+        self._owner = comm.rank == 0
+
+    def comm_unquery(self, comm) -> None:
+        if self._seg is not None:
+            try:
+                self._buf = None
+                self._seg.close()
+            except Exception:
+                pass
+            if self._owner:
+                try:
+                    self._seg.unlink()
+                except Exception:
+                    pass
+            self._seg = None
+
+    # -- shared-counter helpers ------------------------------------------
+    def _wait_at_least(self, off: int, target: int,
+                       comm=None) -> None:
+        """Spin until the shared counter reaches ``target``; a failed comm
+        member turns the wait into ProcFailedError instead of a hang
+        (the basic algorithms get this from pml request completion)."""
+        from ompi_tpu.ft import state as ft_state
+
+        spins = 0
+        while self._native.atomic_load_u64(self._addr + off) < target:
+            spins += 1
+            if comm is not None and spins % 2048 == 0:
+                dead = [r for r in comm.group.world_ranks
+                        if ft_state.is_failed(r)]
+                if dead:
+                    from ompi_tpu.api.errors import ProcFailedError
+
+                    raise ProcFailedError(
+                        f"peer(s) {dead} failed during a coll/sm "
+                        f"operation", tuple(dead))
+            time.sleep(0)
+
+    def _bump(self, off: int) -> None:
+        self._native.atomic_add_i64(self._addr + off, 1)
+
+    def _bc_buf(self) -> np.ndarray:
+        return self._buf[:self._slot]
+
+    def _slot_buf(self, rank: int) -> np.ndarray:
+        start = self._slot * (rank + 1)
+        return self._buf[start:start + self._slot]
+
+    # -- collectives ------------------------------------------------------
+    def barrier(self, comm) -> None:
+        self._rounds["bar"] += 1
+        self._bump(_BAR_ARRIVE)
+        self._wait_at_least(_BAR_ARRIVE, self._rounds["bar"] * comm.size,
+                            comm)
+
+    def bcast(self, comm, buf, root=0):
+        arr = np.ascontiguousarray(buf)
+        if arr.nbytes > self._slot:
+            return self._fallback.bcast(comm, arr, root)
+        self._rounds["bc"] += 1
+        rnd, n = self._rounds["bc"], comm.size
+        if comm.rank == root:
+            # previous round's readers must be done before overwriting
+            self._wait_at_least(_BC_READERS, (rnd - 1) * (n - 1), comm)
+            self._bc_buf()[:arr.nbytes] = arr.view(np.uint8).reshape(-1)
+            self._native.atomic_store_u64(self._addr + _BC_GEN, rnd)
+            return arr
+        self._wait_at_least(_BC_GEN, rnd, comm)
+        out = np.empty_like(arr)
+        out.view(np.uint8).reshape(-1)[:] = self._bc_buf()[:arr.nbytes]
+        self._bump(_BC_READERS)
+        return out
+
+    def allreduce(self, comm, sendbuf, op: op_mod.Op = op_mod.SUM):
+        arr = np.ascontiguousarray(sendbuf)
+        if arr.nbytes > self._slot:
+            return self._fallback.allreduce(comm, arr, op)
+        self._rounds["ar"] += 1
+        rnd, n = self._rounds["ar"], comm.size
+        # everyone from the previous round must have finished reading the
+        # slots before this round's writes
+        self._wait_at_least(_AR_DONE, (rnd - 1) * n, comm)
+        me = self._slot_buf(comm.rank)
+        me[:arr.nbytes] = arr.view(np.uint8).reshape(-1)
+        self._bump(_AR_ARRIVE)
+        self._wait_at_least(_AR_ARRIVE, rnd * n, comm)
+        # fold in rank order (non-commutative safe), each rank locally —
+        # the coll/sm tradeoff: n-fold small compute for zero messages
+        acc = np.array(self._slot_buf(n - 1)[:arr.nbytes]
+                       .view(arr.dtype), copy=True)
+        for r in range(n - 2, -1, -1):
+            contrib = np.array(self._slot_buf(r)[:arr.nbytes]
+                               .view(arr.dtype), copy=True)
+            op(contrib, acc)
+        self._bump(_AR_DONE)
+        return acc.reshape(arr.shape)
+
+    def reduce(self, comm, sendbuf, op: op_mod.Op = op_mod.SUM, root=0):
+        out = self.allreduce(comm, sendbuf, op)
+        return out if comm.rank == root else None
+
+
+class SmCollComponent(Component):
+    name = "sm_coll"
+    priority = 35
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=35,
+            help="Selection priority of coll/sm (mapped-segment colls)")
+        self.slot_var = self.register_var(
+            "slot_size", vtype=VarType.SIZE, default="256k",
+            help="Per-rank shared slot size; larger payloads fall back")
+
+    def comm_query(self, comm):
+        rte = comm.rte
+        if rte is None or rte.is_device_world:
+            return None
+        if comm.size < 2 or comm.is_inter:
+            return None
+        if getattr(rte, "client", None) is None:
+            return None
+        try:
+            from ompi_tpu import native
+
+            if not native.available():
+                return None
+            my_node = rte.node_of(rte.my_world_rank)
+            if my_node is None:
+                return None
+            for w in comm.group.world_ranks:
+                if rte.node_of(w) != my_node:
+                    return None
+        except Exception:
+            return None
+        return self._prio.value, SmCollModule(self)
+
+
+COMPONENT = SmCollComponent()

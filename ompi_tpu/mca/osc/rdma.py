@@ -278,13 +278,12 @@ class RdmaOscComponent(Component):
         except Exception:
             return None
         # every member must share my node (mapped memory reach)
-        try:
-            my_node = rte.modex_get(rte.my_world_rank, "node", wait=False)
-            for w in win.comm.group.world_ranks:
-                if rte.modex_get(w, "node", wait=False) != my_node:
-                    return None
-        except Exception:
+        my_node = rte.node_of(rte.my_world_rank)
+        if my_node is None:
             return None
+        for w in win.comm.group.world_ranks:
+            if rte.node_of(w) != my_node:
+                return None
         return self._prio.value, RdmaModule(self)
 
 

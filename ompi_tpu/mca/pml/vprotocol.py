@@ -1,0 +1,127 @@
+"""pml/v + vprotocol/pessimist — message-event logging for replay FT.
+
+Re-design of ``/root/reference/ompi/mca/pml/v`` (the interposition shell)
+and ``ompi/mca/vprotocol/pessimist`` (3,218 LoC): pessimistic message
+logging records, to stable storage, every nondeterministic event a rank
+observes — most importantly the DELIVERY ORDER of receives (any-source
+matches are where replay diverges) — plus send envelopes, so a restarted
+rank can be re-driven to its pre-failure state by replaying the log
+against re-sent messages.
+
+Enable with ``otpu_vprotocol_pessimist_log=<dir>``: each rank appends
+JSONL events to ``<dir>/events.<world_rank>.log``.  Payload hashes make
+the log auditable without storing data; ``log_payloads`` stores the bytes
+too (full sender-based logging).
+
+The interposition mirrors pml/monitoring: the selected pml module is
+wrapped at init, transparently for every caller.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.base.var import VarType, registry
+
+_log_var = registry.register(
+    "vprotocol", "pessimist", "log", vtype=VarType.STRING, default="",
+    help="Directory for pessimistic message-event logs (empty = disabled)")
+_payload_var = registry.register(
+    "vprotocol", "pessimist", "log_payloads", vtype=VarType.BOOL,
+    default=False,
+    help="Store full payload bytes (sender-based logging), not just hashes")
+
+
+def enabled() -> bool:
+    return bool((_log_var.value or "").strip())
+
+
+class PessimistPml:
+    """Interposition pml recording send envelopes + delivery order."""
+
+    def __init__(self, inner, rte) -> None:
+        self._inner = inner
+        self._dir = (_log_var.value or "").strip()
+        os.makedirs(self._dir, exist_ok=True)
+        self._path = os.path.join(self._dir,
+                                  f"events.{rte.my_world_rank}.log")
+        self._fh = open(self._path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._payloads = bool(_payload_var.value)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _event(self, kind: str, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            fields.update(kind=kind, ev=self._seq)
+            self._fh.write(json.dumps(fields) + "\n")
+
+    def _digest(self, buf) -> str:
+        try:
+            return hashlib.sha1(np.ascontiguousarray(buf)
+                                .view(np.uint8)).hexdigest()[:16]
+        except Exception:
+            return "?"
+
+    # -- send side: envelope (+ payload when sender-based logging) -------
+    def _log_send(self, comm, buf, dest, tag) -> None:
+        arr = np.asarray(buf)
+        rec = dict(cid=comm.cid, dst=int(dest), tag=int(tag),
+                   nbytes=int(arr.nbytes), sha=self._digest(arr))
+        if self._payloads:
+            rec["payload"] = np.ascontiguousarray(arr).view(np.uint8) \
+                .tobytes().hex()
+        self._event("send", **rec)
+
+    def send(self, comm, buf, dest, tag):
+        self._log_send(comm, buf, dest, tag)
+        return self._inner.send(comm, buf, dest, tag)
+
+    def isend(self, comm, buf, dest, tag):
+        self._log_send(comm, buf, dest, tag)
+        return self._inner.isend(comm, buf, dest, tag)
+
+    # -- recv side: the nondeterministic event is the MATCH --------------
+    def _log_match(self, comm, req) -> None:
+        st = req.status
+        self._event("recv", cid=comm.cid, src=int(st.source),
+                    tag=int(st.tag))
+
+    def recv(self, comm, buf, source, tag):
+        st = self._inner.recv(comm, buf, source, tag)
+        self._event("recv", cid=comm.cid, src=int(st.source),
+                    tag=int(st.tag))
+        return st
+
+    def irecv(self, comm, buf, source, tag):
+        req = self._inner.irecv(comm, buf, source, tag)
+        req.on_complete(lambda r: self._log_match(comm, r))
+        return req
+
+    def finalize(self):
+        try:
+            self._fh.close()
+        except Exception:
+            pass
+        return self._inner.finalize()
+
+
+def maybe_wrap_pml(pml_module, rte):
+    if enabled() and getattr(rte, "client", None) is not None:
+        return PessimistPml(pml_module, rte)
+    return pml_module
+
+
+def read_log(directory: str, rank: int) -> list:
+    """Parse one rank's event log (the replay driver's input)."""
+    path = os.path.join(directory, f"events.{rank}.log")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

@@ -36,6 +36,11 @@ class Rte:
     def locality_color(self, split_type: str) -> int:
         return 0  # single host / single slice
 
+    def node_of(self, world_rank: int) -> Optional[Any]:
+        """Node identity of a peer (None if unknown) — the shared
+        locality lookup han/coll-sm/osc-rdma/treematch all need."""
+        return None
+
     def event_notify(self, event: str, payload: Any) -> None:
         pass
 

@@ -60,6 +60,23 @@ class ProcRte(Rte):
         # 'shared' → same node (the sm/ICI domain)
         return abs(hash(self._node)) % (1 << 30)
 
+    def node_of(self, world_rank: int):
+        """Cached node identity of a peer (published at its init)."""
+        if world_rank == self.my_world_rank:
+            return self._node
+        cache = getattr(self, "_node_cache", None)
+        if cache is None:
+            cache = self._node_cache = {}
+        if world_rank not in cache:
+            try:
+                val = self.modex_get(world_rank, "node", wait=False)
+            except Exception:
+                return None
+            if val is None:
+                return None     # not cached: may appear later
+            cache[world_rank] = val
+        return cache[world_rank]
+
     def event_notify(self, event: str, payload: Any) -> None:
         self.client.event_publish(event, payload)
 

@@ -130,6 +130,11 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
 
         pml_module = monitoring.maybe_wrap_pml(pml_module)
 
+        # vprotocol/pessimist interposition (message-event logging)
+        from ompi_tpu.mca.pml import vprotocol
+
+        pml_module = vprotocol.maybe_wrap_pml(pml_module, _rte)
+
         # modex exchange of endpoints (ompi_mpi_init.c:682-701)
         _rte.fence()
 
