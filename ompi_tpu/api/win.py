@@ -80,27 +80,39 @@ class Win(AttributeHost):
         if self.freed:
             raise MpiError(ErrorClass.ERR_WIN, "window was freed")
 
+    def _mon(self, op: str, nbytes: int) -> None:
+        # osc/monitoring interposition (common_monitoring.h's osc slot)
+        from ompi_tpu.runtime import monitoring
+
+        if monitoring.enabled():
+            monitoring.record_osc(op, nbytes)
+
     # -- RMA ops ---------------------------------------------------------
     def put(self, arr, target: int, offset: int = 0) -> None:
         self._check()
-        self.module.put(self, np.ascontiguousarray(arr), target, offset)
+        arr = np.ascontiguousarray(arr)
+        self._mon("put", arr.nbytes)
+        self.module.put(self, arr, target, offset)
 
     def get(self, count: int, target: int, offset: int = 0) -> np.ndarray:
         self._check()
+        self._mon("get", count * self.dtype.itemsize)
         return self.module.get(self, count, target, offset)
 
     def accumulate(self, arr, target: int, offset: int = 0,
                    op: op_mod.Op = op_mod.SUM) -> None:
         self._check()
-        self.module.accumulate(self, np.ascontiguousarray(arr), target,
-                               offset, op)
+        arr = np.ascontiguousarray(arr)
+        self._mon("accumulate", arr.nbytes)
+        self.module.accumulate(self, arr, target, offset, op)
 
     def get_accumulate(self, arr, target: int, offset: int = 0,
                        op: op_mod.Op = op_mod.SUM) -> np.ndarray:
         """Atomically fetch the old contents and apply ``arr (op) target``."""
         self._check()
-        return self.module.get_accumulate(self, np.ascontiguousarray(arr),
-                                          target, offset, op)
+        arr = np.ascontiguousarray(arr)
+        self._mon("get_accumulate", arr.nbytes)
+        return self.module.get_accumulate(self, arr, target, offset, op)
 
     def fetch_and_op(self, value, target: int, offset: int = 0,
                      op: op_mod.Op = op_mod.SUM):
@@ -112,6 +124,7 @@ class Win(AttributeHost):
 
     def compare_and_swap(self, value, compare, target: int, offset: int = 0):
         self._check()
+        self._mon("compare_and_swap", np.asarray(value).nbytes)
         return self.module.compare_and_swap(self, value, compare, target,
                                             offset)
 

@@ -103,10 +103,16 @@ class SmCollModule:
         member turns the wait into ProcFailedError instead of a hang
         (the basic algorithms get this from pml request completion)."""
         from ompi_tpu.ft import state as ft_state
+        from ompi_tpu.runtime.progress import progress
 
         spins = 0
         while self._native.atomic_load_u64(self._addr + off) < target:
             spins += 1
+            # keep the transports moving: a peer may be unable to reach
+            # this collective until our queued btl output (pending
+            # rendezvous frags) drains — spinning without progress would
+            # deadlock the pair
+            progress()
             if comm is not None and spins % 2048 == 0:
                 dead = [r for r in comm.group.world_ranks
                         if ft_state.is_failed(r)]

@@ -203,13 +203,27 @@ class Ob1Pml:
             ep.btl.send(ep, frag)
             req.complete()
         else:
-            # rendezvous: RNDV head now, stream on ACK
-            head = req.convertor.pack(ep.btl.rndv_eager_limit)
-            self._send_reqs[req.req_id] = req
-            frag = Frag(comm.cid, src_world, dst_world, tag, seq, RNDV,
-                        head, total_len=req.nbytes,
-                        meta={"req_id": req.req_id})
-            ep.btl.send(ep, frag)
+            # rendezvous: RNDV head now, stream on ACK.  The user buffer
+            # stays MPI-owned until completion — memchecker freezes it so
+            # a racy write fails loudly (memchecker.h:25-52 analog)
+            from ompi_tpu.runtime import memchecker
+
+            memchecker.protect_send(req, buf)
+            try:
+                head = req.convertor.pack(ep.btl.rndv_eager_limit)
+                self._send_reqs[req.req_id] = req
+                frag = Frag(comm.cid, src_world, dst_world, tag, seq, RNDV,
+                            head, total_len=req.nbytes,
+                            meta={"req_id": req.req_id})
+                ep.btl.send(ep, frag)
+            except Exception:
+                # failed setup: the request will never complete, so the
+                # guard's release callback must fire here or the user's
+                # buffer stays read-only forever
+                self._send_reqs.pop(req.req_id, None)
+                req.complete(MpiError(ErrorClass.ERR_OTHER,
+                                      "rendezvous setup failed"))
+                raise
         return req
 
     def send(self, comm, buf, dest: int, tag: int) -> None:
