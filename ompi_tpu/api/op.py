@@ -114,27 +114,30 @@ def create(fn: Callable, commute: bool) -> Op:
     return Op(f"user_{id(fn):x}", fn, commute=commute)
 
 
-def jax_fold(op: Op):
+def jax_stack_reduce(op: Op, dtype=None):
+    """Fused device reduction of a (k, ...) stack along axis 0, if any
+    op component provides one (pallas_vpu's ``reduce_stack`` on TPU);
+    None otherwise.  Callers fall back to chained :func:`jax_fold`."""
+    from ompi_tpu.mca.op import base as op_base
+
+    if op.name not in BUILTIN_OPS:
+        return None
+    return op_base.select_stack(op.name, dtype)
+
+
+def jax_fold(op: Op, dtype=None, fusable: bool = False):
     """A jax-traceable two-operand fold for device-side reductions.
 
     Used by coll/xla for ops without a native collective lowering (tree
-    reduction over gathered shards) and by scan/exscan.
+    reduction over gathered shards) and by scan/exscan.  The kernel comes
+    from the MCA ``op`` framework (``ompi_tpu/mca/op/``): on TPU the
+    Pallas VPU component wins (the op/avx analog), elsewhere plain XLA —
+    the reference's per-op function-table selection
+    (``ompi/mca/op/base/op_base_op_select.c``).
     """
-    import jax.numpy as jnp
+    from ompi_tpu.mca.op import base as op_base
 
-    table = {
-        "SUM": jnp.add,
-        "PROD": jnp.multiply,
-        "MAX": jnp.maximum,
-        "MIN": jnp.minimum,
-        "LAND": lambda a, b: (a.astype(bool) & b.astype(bool)).astype(a.dtype),
-        "LOR": lambda a, b: (a.astype(bool) | b.astype(bool)).astype(a.dtype),
-        "LXOR": lambda a, b: (a.astype(bool) ^ b.astype(bool)).astype(a.dtype),
-        "BAND": jnp.bitwise_and,
-        "BOR": jnp.bitwise_or,
-        "BXOR": jnp.bitwise_xor,
-    }
-    fn = table.get(op.name)
+    fn = op_base.select_fold(op.name, dtype, fusable=fusable)
     if fn is None:
         raise MpiError(ErrorClass.ERR_OP,
                        f"op {op.name} has no device lowering")

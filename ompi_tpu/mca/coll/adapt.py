@@ -98,7 +98,13 @@ class AdaptModule:
 
                 def forward(_r, seg=seg, k=k):
                     # the segment just landed: push it onward NOW —
-                    # adapt's event-driven property (no round lockstep)
+                    # adapt's event-driven property (no round lockstep).
+                    # An errored recv (truncation, dead peer) must NOT be
+                    # forwarded: the latch already records the error, and
+                    # descendants recover via FT propagation rather than
+                    # receiving garbage marked success.
+                    if _r.error is not None:
+                        return
                     for c in children:
                         latch.arm(pml.isend(comm, seg, c,
                                             _seg_tag(tag, k)))
@@ -156,6 +162,12 @@ class AdaptModule:
                 rreq = pml.irecv(comm, cb, c, _seg_tag(tag, k))
 
                 def fold(_r, c=c, k=k):
+                    # an errored child recv contributes nothing: folding
+                    # the uninitialised buffer would corrupt the segment
+                    # and seg_done would ship it upward as success.  The
+                    # latch records the error; the op completes in error.
+                    if _r.error is not None:
+                        return
                     cb = child_bufs[(c, k)]
                     with plock:
                         # the fold itself is inside the lock: completions

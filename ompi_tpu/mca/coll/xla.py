@@ -150,10 +150,14 @@ class XlaCollModule:
             return lambda t: jax.lax.pmax(t, ax)
         if op.jax_reduce == "pmin":
             return lambda t: jax.lax.pmin(t, ax)
-        fold = op_mod.jax_fold(op)
-
         def body(t):
             gathered = jax.lax.all_gather(t, ax)  # (n, *S)
+            # fused one-pass stack reduction (pallas on TPU) when a
+            # component provides one; else chained folds
+            stack = op_mod.jax_stack_reduce(op, t.dtype)
+            if stack is not None:
+                return stack(gathered)
+            fold = op_mod.jax_fold(op, t.dtype)
             acc = gathered[0]
             for i in range(1, self.n):
                 acc = fold(gathered[i], acc)
@@ -358,9 +362,10 @@ class XlaCollModule:
         import jax
 
         P = self._P
-        fold = op_mod.jax_fold(op)
 
         def body(t):  # (1, *S)
+            # scans want a fold XLA can fuse into associative_scan
+            fold = op_mod.jax_fold(op, t.dtype, fusable=True)
             g = jax.lax.all_gather(t[0], self.axis)        # (n, *S)
             # fold convention: acc = in (op) acc, rank-ordered
             s = jax.lax.associative_scan(lambda a, b: fold(a, b), g, axis=0)
@@ -378,9 +383,9 @@ class XlaCollModule:
         import jax.numpy as jnp
 
         P = self._P
-        fold = op_mod.jax_fold(op)
 
         def body(t):
+            fold = op_mod.jax_fold(op, t.dtype, fusable=True)
             g = jax.lax.all_gather(t[0], self.axis)
             s = jax.lax.associative_scan(lambda a, b: fold(a, b), g, axis=0)
             i = jax.lax.axis_index(self.axis)

@@ -1,0 +1,9 @@
+"""MCA ``op`` framework — device reduction-kernel components.
+
+Reference: ``ompi/mca/op/`` — the framework whose components (base C
+loops, ``op/avx`` SIMD) compete to fill each ``ompi_op_t``'s per-type
+function table at init (``ompi/mca/op/base/op_base_op_select.c``).  Here
+components compete to provide the jax-traceable two-operand fold used by
+coll/xla's device reductions (tree folds, scan/exscan) for each
+(op, dtype).
+"""

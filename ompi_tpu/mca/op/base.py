@@ -1,0 +1,72 @@
+"""op framework base: per-(op, dtype) kernel selection.
+
+Mirrors ``ompi/mca/op/base/op_base_op_select.c``: every available
+component is queried for a fold covering the (op, dtype) pair; the
+highest-priority non-None answer wins and is cached (the reference caches
+by filling the op's function table once).  Selection honours the usual
+``otpu_op`` include/exclude var, so ``--mca op ^pallas_vpu`` forces the
+plain-XLA path exactly like ``--mca op ^avx`` in the reference.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ompi_tpu.base import mca
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def _framework() -> mca.Framework:
+    fw = mca.framework("op", "reduction kernel components", multi_select=True)
+    if not fw.opened:
+        fw.open()
+    return fw
+
+
+def select_fold(op_name: str, dtype,
+                fusable: bool = False) -> Optional[Callable]:
+    """Highest-priority device fold for (op, dtype), or None.
+
+    ``fusable=True`` asks for a fold XLA can fuse into surrounding
+    computation (scans, fori bodies) — opaque-kernel components decline.
+    """
+    key = ("fold", op_name, str(dtype), fusable)
+    with _lock:
+        if key in _cache:
+            return _cache[key]
+    fw = _framework()
+    best = None
+    for comp in sorted(fw.available, key=lambda c: -c.priority):
+        fold = comp.query_fold(op_name, dtype, fusable=fusable)
+        if fold is not None:
+            best = fold
+            break
+    with _lock:
+        _cache[key] = best
+    return best
+
+
+def select_stack(op_name: str, dtype) -> Optional[Callable]:
+    """Fused (k, ...)-stack axis-0 reduction for (op, dtype), or None."""
+    key = ("stack", op_name, str(dtype))
+    with _lock:
+        if key in _cache:
+            return _cache[key]
+    fw = _framework()
+    best = None
+    for comp in sorted(fw.available, key=lambda c: -c.priority):
+        q = getattr(comp, "query_stack", None)
+        red = q(op_name, dtype) if q else None
+        if red is not None:
+            best = red
+            break
+    with _lock:
+        _cache[key] = best
+    return best
+
+
+def reset_cache() -> None:
+    with _lock:
+        _cache.clear()

@@ -1,0 +1,43 @@
+"""op/xla — plain-XLA (jnp) reduction folds, the always-available base.
+
+Reference analog: the base C loops every op falls back to when no SIMD
+component covers the (op, type) pair (``ompi/mca/op/base``).  XLA fuses
+these into surrounding computations, so off-TPU this is also the fastest
+choice.
+"""
+from __future__ import annotations
+
+from ompi_tpu.base import mca
+
+
+class XlaOpComponent(mca.Component):
+    name = "xla"
+    priority = 10
+
+    def close(self) -> None:
+        from ompi_tpu.mca.op import base as op_base
+
+        op_base.reset_cache()
+
+    def query_fold(self, op_name: str, dtype, fusable: bool = False):
+        import jax.numpy as jnp
+
+        table = {
+            "SUM": jnp.add,
+            "PROD": jnp.multiply,
+            "MAX": jnp.maximum,
+            "MIN": jnp.minimum,
+            "LAND": lambda a, b: (a.astype(bool) & b.astype(bool)
+                                  ).astype(a.dtype),
+            "LOR": lambda a, b: (a.astype(bool) | b.astype(bool)
+                                 ).astype(a.dtype),
+            "LXOR": lambda a, b: (a.astype(bool) ^ b.astype(bool)
+                                  ).astype(a.dtype),
+            "BAND": jnp.bitwise_and,
+            "BOR": jnp.bitwise_or,
+            "BXOR": jnp.bitwise_xor,
+        }
+        return table.get(op_name)
+
+
+COMPONENT = XlaOpComponent()

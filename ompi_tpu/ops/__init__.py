@@ -1,0 +1,15 @@
+"""TPU compute kernels (Pallas) for the framework's hot ops.
+
+The reference keeps its SIMD reduction kernels in an MCA op component
+(``ompi/mca/op/avx/op_avx_functions.c`` — AVX2/AVX-512 sum/min/max/...);
+the TPU analog is Pallas kernels driving the VPU (elementwise reductions)
+and MXU (attention blocks).  The MCA ``op`` framework
+(``ompi_tpu/mca/op/``) selects these when running on a TPU backend and
+falls back to plain XLA (jnp) elsewhere, mirroring the reference's
+runtime CPU-capability dispatch (``op_avx_component.c``).
+"""
+from ompi_tpu.ops.pallas_reduce import (  # noqa: F401
+    combine2,
+    reduce_stack,
+    supported_ops,
+)

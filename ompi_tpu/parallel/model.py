@@ -18,7 +18,13 @@ def rmsnorm(x, eps: float = 1e-6):
     return x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
 
 
-def ring_attention(q, k, v, axis: str, n_shards: int):
+def _use_flash_default() -> bool:
+    import jax as _jax
+
+    return _jax.default_backend() == "tpu"
+
+
+def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None):
     """Flash-style ring attention over the sequence-parallel axis.
 
     q/k/v local: (b, h_local, s_local, hd).  K/V blocks rotate around the
@@ -26,9 +32,16 @@ def ring_attention(q, k, v, axis: str, n_shards: int):
     SURVEY.md §2.6) while the numerator/denominator accumulate with the
     running-max rescaling, so memory stays O(s_local) regardless of the
     global sequence length — long context is a first-class mesh axis.
+
+    The per-step block combine (two MXU matmuls + online-softmax rescale)
+    is the hot op: on TPU it drops into the fused Pallas kernel
+    (``ompi_tpu/ops/flash_attention.py``); the ring structure itself stays
+    at the XLA level so the compiler schedules the ICI ppermute.
     """
     hd = q.shape[-1]
     scale = 1.0 / math.sqrt(hd)
+    if use_flash is None:
+        use_flash = _use_flash_default()
     m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
     num0 = jnp.zeros_like(q)
     den0 = jnp.zeros(q.shape[:-1], q.dtype)
@@ -36,12 +49,17 @@ def ring_attention(q, k, v, axis: str, n_shards: int):
 
     def body(carry, _):
         k_blk, v_blk, m, num, den = carry
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
-        new_m = jnp.maximum(m, s.max(axis=-1))
-        c = jnp.exp(m - new_m)
-        p = jnp.exp(s - new_m[..., None])
-        num = num * c[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
-        den = den * c + p.sum(axis=-1)
+        if use_flash:
+            from ompi_tpu.ops.flash_attention import flash_block_update
+
+            new_m, num, den = flash_block_update(q, k_blk, v_blk, m, num, den)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+            new_m = jnp.maximum(m, s.max(axis=-1))
+            c = jnp.exp(m - new_m)
+            p = jnp.exp(s - new_m[..., None])
+            num = num * c[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+            den = den * c + p.sum(axis=-1)
         if n_shards > 1:
             k_blk = jax.lax.ppermute(k_blk, axis, perm)
             v_blk = jax.lax.ppermute(v_blk, axis, perm)

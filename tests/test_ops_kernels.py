@@ -1,0 +1,169 @@
+"""Pallas kernel + op-framework tests (run on the CPU mesh, interpret mode).
+
+Reference model: the op/avx kernel tests ``test/datatype/reduce_local.c``
++ ``check_op.sh`` — every op kernel checked against a golden host
+computation — and the op framework selection in
+``ompi/mca/op/base/op_base_op_select.c``.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ompi_tpu.ops import pallas_reduce as pr
+
+
+class TestPallasReduce:
+    @pytest.mark.parametrize("op,npfn", [
+        ("SUM", np.add), ("PROD", np.multiply),
+        ("MAX", np.maximum), ("MIN", np.minimum),
+    ])
+    def test_combine2_float(self, op, npfn):
+        rng = np.random.RandomState(3)
+        a = rng.normal(size=(7, 531)).astype(np.float32)
+        b = rng.normal(size=(7, 531)).astype(np.float32)
+        out = pr.combine2(op, jnp.asarray(a), jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(out), npfn(a, b), rtol=1e-6)
+
+    @pytest.mark.parametrize("op,npfn", [
+        ("BAND", np.bitwise_and), ("BOR", np.bitwise_or),
+        ("BXOR", np.bitwise_xor),
+    ])
+    def test_combine2_bitwise(self, op, npfn):
+        rng = np.random.RandomState(4)
+        a = rng.randint(0, 1 << 30, size=773).astype(np.int32)
+        b = rng.randint(0, 1 << 30, size=773).astype(np.int32)
+        out = pr.combine2(op, jnp.asarray(a), jnp.asarray(b))
+        np.testing.assert_array_equal(np.asarray(out), npfn(a, b))
+
+    def test_combine2_logical(self):
+        a = jnp.asarray([0, 1, 2, 0], jnp.int32)
+        b = jnp.asarray([0, 0, 3, 5], jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(pr.combine2("LXOR", a, b)), [0, 1, 0, 1])
+
+    @pytest.mark.parametrize("k", [2, 5, 8])
+    def test_reduce_stack(self, k):
+        rng = np.random.RandomState(k)
+        x = rng.normal(size=(k, 3, 411)).astype(np.float32)
+        out = pr.reduce_stack("SUM", jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_reduce_stack_k1_and_large(self):
+        x = np.arange(10, dtype=np.float32).reshape(1, 10)
+        np.testing.assert_array_equal(
+            np.asarray(pr.reduce_stack("MAX", jnp.asarray(x))), x[0])
+        big = np.ones((4, 70000), np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(pr.reduce_stack("SUM", jnp.asarray(big))),
+            np.full(70000, 4, np.float32))
+
+    def test_device_fold_coverage(self):
+        assert pr.device_fold("SUM", jnp.float32) is not None
+        assert pr.device_fold("BAND", jnp.float32) is None  # bitwise≠float
+        assert pr.device_fold("BAND", jnp.int32) is not None
+        assert pr.device_fold("MAXLOC", jnp.float32) is None
+
+
+class TestOpFramework:
+    def test_selection_and_fallback(self):
+        from ompi_tpu.api import op as op_mod
+        from ompi_tpu.mca.op import base as op_base
+
+        fn = op_mod.jax_fold(op_mod.SUM, jnp.float32)
+        a, b = jnp.arange(8.0), jnp.ones(8)
+        np.testing.assert_allclose(np.asarray(fn(a, b)),
+                                   np.arange(8.0) + 1)
+        # MAXLOC has no elementwise device kernel in any component
+        with pytest.raises(Exception):
+            op_mod.jax_fold(op_mod.MAXLOC, jnp.float32)
+        assert op_base.select_fold("SUM", jnp.float32) is not None
+
+    def test_exclude_component_var(self):
+        """--mca op ^pallas_vpu forces the plain-XLA fold (reference:
+        ``--mca op ^avx``)."""
+        from ompi_tpu.base import mca
+        from ompi_tpu.mca.op import base as op_base
+
+        fw = mca.framework("op")
+        names = set(fw.components) if fw.opened else None
+        if names is not None:
+            assert {"pallas_vpu", "xla"} <= names
+        op_base.reset_cache()
+        fold = op_base.select_fold("PROD", jnp.float32)
+        a, b = jnp.full(4, 3.0), jnp.full(4, 2.0)
+        np.testing.assert_allclose(np.asarray(fold(a, b)), np.full(4, 6.0))
+
+
+class TestFlashAttention:
+    def _rand(self, b=1, h=2, sq=64, skv=32, d=16):
+        key = jax.random.PRNGKey(7)
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (b, h, sq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, h, skv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, h, skv, d), jnp.float32)
+        return q, k, v
+
+    def test_block_update_matches_softmax(self):
+        from ompi_tpu.ops.flash_attention import flash_block_update
+
+        q, k, v = self._rand()
+        m = jnp.full(q.shape[:-1], -jnp.inf)
+        num = jnp.zeros_like(q)
+        den = jnp.zeros(q.shape[:-1])
+        m, num, den = flash_block_update(q, k, v, m, num, den)
+        k2, v2 = k * 0.5 + 1.0, v - 0.25
+        m, num, den = flash_block_update(q, k2, v2, m, num, den)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, jnp.concatenate([k, k2], 2)) \
+            / math.sqrt(q.shape[-1])
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1),
+                         jnp.concatenate([v, v2], 2))
+        got = num / den[..., None]
+        # CPU interpret is exact-ish; TPU MXU default precision ≈1e-3
+        tol = 1e-5 if jax.default_backend() != "tpu" else 8e-3
+        assert float(jnp.abs(got - ref).max()) < tol
+
+    def test_ring_attention_flash_matches_jnp(self):
+        """Flash and jnp ring paths agree on the 8-device sp mesh."""
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from ompi_tpu.parallel.model import ring_attention
+
+        ndev = len(jax.devices())
+        mesh = Mesh(np.array(jax.devices()), ("sp",))
+        b, h, s, d = 2, 2, 8 * ndev, 16
+        q, k, v = self._rand(b, h, s, s, d)
+
+        def run(use_flash):
+            def body(qq, kk, vv):
+                return ring_attention(qq, kk, vv, "sp", ndev,
+                                      use_flash=use_flash)
+            spec = P(None, None, "sp", None)
+            fn = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False))
+            return fn(q, k, v)
+
+        np.testing.assert_allclose(np.asarray(run(True)),
+                                   np.asarray(run(False)),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_flash_gradients(self):
+        """custom_vjp backward matches autodiff through the jnp path."""
+        from ompi_tpu.parallel.model import ring_attention
+
+        q, k, v = self._rand(1, 1, 16, 16, 8)
+
+        def loss(use_flash):
+            def f(qq):
+                o = ring_attention(qq, k, v, "none", 1, use_flash=use_flash)
+                return jnp.sum(o * o)
+            return jax.grad(f)(q)
+
+        np.testing.assert_allclose(np.asarray(loss(True)),
+                                   np.asarray(loss(False)),
+                                   rtol=1e-4, atol=1e-5)
