@@ -765,16 +765,27 @@ class Comm(AttributeHost):
         waitall(reqs)
         return out
 
+    def release_coll_modules(self) -> None:
+        """Tear down per-comm coll module state (shared segments etc.).
+
+        Called from free(); also from runtime finalize for WORLD/SELF,
+        which the user never frees (ompi_mpi_finalize does the same)."""
+        for mod in self.coll_modules:
+            close = getattr(mod, "comm_unquery", None)
+            if close is not None:
+                try:
+                    close(self)
+                except Exception:
+                    pass
+        self.coll_modules = []
+
     def free(self) -> None:
         if self.freed:
             # double-free must not touch a newer communicator's state
             # (release/del_comm are keyed by bare cid)
             return
         self._attrs_delete_all()
-        for mod in self.coll_modules:
-            close = getattr(mod, "comm_unquery", None)
-            if close is not None:
-                close(self)
+        self.release_coll_modules()
         if self.pml is not None:
             del_comm = getattr(self.pml, "del_comm", None)
             if del_comm is not None:

@@ -136,7 +136,11 @@ class SmBtl(Btl):
         self._rings_in: dict[int, _Ring] = {}    # per-sender, I own these
         self._rings_out: dict[int, _Ring] = {}   # per-receiver, attached
         self._pending: dict[int, Fifo] = {}
-        self._hostname = socket.gethostname()
+        # node identity, not raw hostname: OTPU_NODE_ID partitions ranks
+        # into emulated nodes (tpurun --fake-nodes / multi-host launchers),
+        # and shared memory must not be offered across that boundary so
+        # inter-node traffic honestly exercises the DCN (tcp) path
+        self._hostname = os.environ.get("OTPU_NODE_ID", socket.gethostname())
         self._ring_size = 1 << 20
 
     def register_vars(self, fw) -> None:

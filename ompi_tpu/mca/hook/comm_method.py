@@ -31,14 +31,37 @@ class CommMethodComponent(Component):
         if bml is None:
             return
         me = world.rank
+        rte = world.rte
+        from ompi_tpu.base import hwloc
+
+        my_node = getattr(rte, "_node", None)
+        my_cpus = None
+        topo = hwloc.host_topology()
+        loc_names = {hwloc.LOC_DIFFERENT_NODE: "inter",
+                     hwloc.LOC_SAME_NODE: "node",
+                     hwloc.LOC_SAME_NUMA: "numa",
+                     hwloc.LOC_SAME_CORE: "core"}
+        if hasattr(rte, "modex_get"):
+            my_cpus = rte.modex_get(rte.my_world_rank, "cpus", wait=False)
         cells = []
         for r in range(world.size):
             w = world.world_rank(r)
-            if w == world.rte.my_world_rank:
+            if w == rte.my_world_rank:
                 cells.append("self*")
                 continue
             eps = bml.endpoints(w)
-            cells.append(eps[0].btl.name if eps else "none")
+            cell = eps[0].btl.name if eps else "none"
+            # locality tier from the peer's modexed topology facts
+            # (hwloc analog — what the reference reads from PMIx locality)
+            if my_node is not None and hasattr(rte, "node_of"):
+                peer_node = rte.node_of(w)
+                peer_cpus = rte.modex_get(w, "cpus", wait=False) \
+                    if hasattr(rte, "modex_get") else None
+                tier = hwloc.locality(
+                    my_node, peer_node or "?", my_cpus, peer_cpus,
+                    topo.numa_nodes, ncpus=topo.ncpus_online)
+                cell += f"/{loc_names[tier]}"
+            cells.append(cell)
         print(f"[comm_method] rank {me}: " +
               " ".join(f"{r}:{c}" for r, c in enumerate(cells)),
               flush=True)

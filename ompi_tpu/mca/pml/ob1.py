@@ -28,7 +28,7 @@ from ompi_tpu.base.var import VarType
 from ompi_tpu.datatype import Convertor
 from ompi_tpu.mca.bml import Bml
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RNDV, Frag
-from ompi_tpu.runtime import spc
+from ompi_tpu.runtime import peruse, spc
 
 
 class SendRequest(Request):
@@ -193,6 +193,11 @@ class Ob1Pml:
         if ep is None:
             raise MpiError(ErrorClass.ERR_INTERN,
                            f"no transport reaches world rank {dst_world}")
+        # activate fires only once the request is real (endpoint resolved)
+        # so activate/complete pairs always balance
+        if peruse.active():
+            peruse.fire(peruse.REQ_ACTIVATE, comm.cid, kind="send",
+                        dest=dest, tag=tag)
         seq = next(self._seq.setdefault(
             (comm.cid, src_world, dst_world), itertools.count()))
         spc.record("bytes_sent", req.nbytes)
@@ -202,6 +207,9 @@ class Ob1Pml:
                         req.convertor.pack(), total_len=req.nbytes)
             ep.btl.send(ep, frag)
             req.complete()
+            if peruse.active():
+                peruse.fire(peruse.REQ_COMPLETE, comm.cid, kind="send",
+                            dest=dest, tag=tag)
         else:
             # rendezvous: RNDV head now, stream on ACK.  The user buffer
             # stays MPI-owned until completion — memchecker freezes it so
@@ -223,6 +231,9 @@ class Ob1Pml:
                 self._send_reqs.pop(req.req_id, None)
                 req.complete(MpiError(ErrorClass.ERR_OTHER,
                                       "rendezvous setup failed"))
+                if peruse.active():
+                    peruse.fire(peruse.REQ_COMPLETE, comm.cid, kind="send",
+                                dest=dest, tag=tag)
                 raise
         return req
 
@@ -242,6 +253,9 @@ class Ob1Pml:
                                  offset=off, meta={"req_id": peer_req}))
         self._send_reqs.pop(req.req_id, None)
         req.complete()
+        if peruse.active():
+            peruse.fire(peruse.REQ_COMPLETE, ack.cid, kind="send",
+                        dest=req.dest, tag=req.tag)
 
     # -- recv path -------------------------------------------------------
     def irecv(self, comm, buf, source: int, tag: int) -> Request:
@@ -249,6 +263,12 @@ class Ob1Pml:
         req = RecvRequest(self, comm, buf, source, tag)
         dst_world = comm.world_rank(comm.rank)
         key = (comm.cid, dst_world)
+        if peruse.active():
+            peruse.fire(peruse.REQ_ACTIVATE, comm.cid, kind="recv",
+                        source=source, tag=tag)
+        # PERUSE events observed under self._lock are deferred and fired
+        # after release so a callback can never deadlock against the pml
+        events: list = []
         with self._lock:
             st = self._match.setdefault(key, _MatchState())
             # check the unexpected queue first (arrival order)
@@ -257,9 +277,20 @@ class Ob1Pml:
                             else comm.group).rank_of(frag.src)
                 if req.matches(frag, comm_src):
                     st.unexpected.pop(i)
-                    self._deliver_to_request(req, frag)
-                    return req
-            st.posted.append(req)
+                    if peruse.active():
+                        events.append((peruse.REQ_MATCH_UNEX, comm.cid,
+                                       dict(source=comm_src, tag=frag.tag,
+                                            unex_qlen=len(st.unexpected))))
+                    self._deliver_to_request(req, frag, events)
+                    break
+            else:
+                st.posted.append(req)
+                if peruse.active():
+                    events.append((peruse.REQ_INSERT_IN_POSTED_Q, comm.cid,
+                                   dict(source=source, tag=tag,
+                                        posted_qlen=len(st.posted))))
+        for ev, cid, info in events:
+            peruse.fire(ev, cid, **info)
         return req
 
     def recv(self, comm, buf, source: int, tag: int) -> Status:
@@ -344,6 +375,14 @@ class Ob1Pml:
                 handler(frag)
             return
         key = (frag.cid, frag.dst)
+        events: list = []
+        try:
+            self._recv_frag_locked(key, frag, events)
+        finally:
+            for ev, cid, info in events:
+                peruse.fire(ev, cid, **info)
+
+    def _recv_frag_locked(self, key, frag: Frag, events: list) -> None:
         with self._lock:
             st = self._match.setdefault(key, _MatchState())
             expected = st.expected_seq.get(frag.src, 0)
@@ -352,31 +391,51 @@ class Ob1Pml:
                 spc.record("out_of_sequence_msgs")
                 st.ooo.setdefault(frag.src, {})[frag.seq] = frag
                 return
-            self._match_one(st, frag)
+            self._match_one(st, frag, events)
             st.expected_seq[frag.src] = expected + 1
             # drain any now-in-order held frags
             held = st.ooo.get(frag.src, {})
             nxt = st.expected_seq[frag.src]
             while nxt in held:
-                self._match_one(st, held.pop(nxt))
+                self._match_one(st, held.pop(nxt), events)
                 nxt += 1
                 st.expected_seq[frag.src] = nxt
 
-    def _match_one(self, st: _MatchState, frag: Frag) -> None:
-        """Match one in-sequence frag against posted recvs (recvfrag.c:831)."""
-        comm = None
+    def _match_one(self, st: _MatchState, frag: Frag,
+                   events: Optional[list] = None) -> None:
+        """Match one in-sequence frag against posted recvs (recvfrag.c:831).
+
+        Runs under self._lock; PERUSE events append to ``events`` for the
+        caller to fire after release."""
+        if events is None:
+            events = []
+        if peruse.active():
+            events.append((peruse.MSG_ARRIVED, frag.cid,
+                           dict(source=frag.src, tag=frag.tag)))
         for i, req in enumerate(st.posted):
             comm_src = (req.comm.remote_group if req.comm.is_inter
                     else req.comm.group).rank_of(frag.src)
             if req.matches(frag, comm_src):
                 st.posted.pop(i)
                 spc.record("matched_msgs")
-                self._deliver_to_request(req, frag)
+                if peruse.active():
+                    events.append((peruse.MSG_MATCH_POSTED_REQ, frag.cid,
+                                   dict(source=frag.src, tag=frag.tag,
+                                        posted_qlen=len(st.posted))))
+                self._deliver_to_request(req, frag, events)
                 return
         spc.record("unexpected_msgs")
         st.unexpected.append(frag)
+        if peruse.active():
+            events.append((peruse.MSG_INSERT_IN_UNEX_Q, frag.cid,
+                           dict(source=frag.src, tag=frag.tag,
+                                unex_qlen=len(st.unexpected))))
 
-    def _deliver_to_request(self, req: RecvRequest, frag: Frag) -> None:
+    def _deliver_to_request(self, req: RecvRequest, frag: Frag,
+                            events: Optional[list] = None) -> None:
+        fire_now = events is None
+        if events is None:
+            events = []
         comm_src = (req.comm.remote_group if req.comm.is_inter
                     else req.comm.group).rank_of(frag.src)
         req.matched_src = frag.src
@@ -393,6 +452,7 @@ class Ob1Pml:
         req.received += n
         req.status._nbytes = min(req.total, req.received) if error else req.total
         spc.record("bytes_received", n)
+        done = False
         if frag.kind == RNDV and error is None:
             # register for FRAG continuation and ACK the sender
             self._recv_reqs[req.req_id] = req
@@ -403,11 +463,22 @@ class Ob1Pml:
             if req.received >= req.total:
                 self._recv_reqs.pop(req.req_id, None)
                 req.status._nbytes = req.received
-                req.complete()
-            return
-        if error is not None or req.received >= req.total:
+                done = True
+        elif error is not None or req.received >= req.total:
             req.status._nbytes = req.received
+            done = True
+        if done:
+            if peruse.active():
+                events.append((peruse.REQ_XFER_END, frag.cid,
+                               dict(source=frag.src, tag=req.status.tag,
+                                    nbytes=req.received)))
+                events.append((peruse.REQ_COMPLETE, frag.cid,
+                               dict(kind="recv", source=req.status.source,
+                                    tag=req.status.tag)))
             req.complete(error)
+        if fire_now:
+            for ev, cid, info in events:
+                peruse.fire(ev, cid, **info)
 
     def _recv_data_frag(self, frag: Frag) -> None:
         req = self._recv_reqs.get(frag.meta["req_id"])
@@ -420,6 +491,12 @@ class Ob1Pml:
         if req.received >= min(req.total, req.capacity):
             self._recv_reqs.pop(frag.meta["req_id"], None)
             req.status._nbytes = req.received
+            if peruse.active():
+                peruse.fire(peruse.REQ_XFER_END, frag.cid,
+                            source=req.status.source, tag=req.status.tag,
+                            nbytes=req.received)
+                peruse.fire(peruse.REQ_COMPLETE, frag.cid, kind="recv",
+                            source=req.status.source, tag=req.status.tag)
             req.complete()
 
 

@@ -57,8 +57,12 @@ class ProcRte(Rte):
                           rank=self.my_world_rank, expect=self.job_ranks)
 
     def locality_color(self, split_type: str) -> int:
-        # 'shared' → same node (the sm/ICI domain)
-        return abs(hash(self._node)) % (1 << 30)
+        # 'shared' → same node (the sm/ICI domain).  Stable cross-process
+        # hash: builtin hash() is PYTHONHASHSEED-randomised per process,
+        # which would give same-node ranks different colors
+        import zlib
+
+        return zlib.crc32(self._node.encode()) % (1 << 30)
 
     def node_of(self, world_rank: int):
         """Cached node identity of a peer (published at its init)."""

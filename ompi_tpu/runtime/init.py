@@ -118,6 +118,22 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
 
         spc.init()
 
+        # CPU binding + topology modex (hwloc analog; the reference does
+        # binding in PRRTE pre-exec, we do it first thing in init)
+        import os as _os
+
+        from ompi_tpu.base import hwloc
+
+        if _os.environ.get("OTPU_BIND_POLICY") == "core" and \
+                hasattr(_rte, "my_world_rank"):
+            local_n = int(_os.environ.get("OTPU_LOCAL_NRANKS", "1"))
+            cpus = hwloc.compute_binding(
+                _rte.my_world_rank % max(1, local_n), max(1, local_n))
+            hwloc.bind_self(cpus)
+        if hasattr(_rte, "modex_put"):
+            topo = hwloc.host_topology(refresh=True)
+            _rte.modex_put("cpus", list(topo.cpus_allowed))
+
         # pml selection (ompi_mpi_init.c:630)
         pml_fw = mca.framework("pml", "point-to-point messaging layer")
         pml_comp = pml_fw.select()
@@ -218,6 +234,12 @@ def finalize() -> None:
             from ompi_tpu.ft import propagator as _ft_prop
 
             _ft_prop.stop()
+            # release per-comm coll resources (shared segments etc.) for
+            # the built-in comms the user never frees — the reference
+            # destroys WORLD/SELF in ompi_mpi_finalize the same way
+            for c in (_world, _self):
+                if c is not None and not getattr(c, "freed", False):
+                    c.release_coll_modules()
             if _world is not None and _world.pml is not None:
                 fin = getattr(_world.pml, "finalize", None)
                 if fin is not None:

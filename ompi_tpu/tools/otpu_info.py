@@ -58,6 +58,9 @@ def main(argv=None) -> int:
                     help="Machine-readable colon-separated output")
     ap.add_argument("--pvars", action="store_true",
                     help="Show performance variables (MPI_T pvar analog)")
+    ap.add_argument("--topo", action="store_true",
+                    help="Show host + device topology (hwloc analog; "
+                         "lstopo-lite)")
     args = ap.parse_args(argv)
 
     import ompi_tpu
@@ -96,6 +99,12 @@ def main(argv=None) -> int:
                 f"mca var {var.name}",
                 f"{var.value!r} (type {var.vtype.name.lower()}, "
                 f"source {origin}{detail})", p))
+
+    if args.all or args.topo:
+        from ompi_tpu.base import hwloc
+
+        for line in hwloc.summary().splitlines():
+            out.append(_fmt("topo", line.strip(), p))
 
     if args.all or args.pvars:
         for pv in registry.all_pvars():

@@ -34,6 +34,11 @@ def main(argv=None) -> int:
                          "OTPU_NODE_ID=rank*K//nprocs per rank) so the "
                          "hierarchical coll/han path can be exercised on "
                          "one host, like mpirun --oversubscribe for han")
+    ap.add_argument("--bind-to", choices=("none", "core"), default="none",
+                    help="CPU binding policy: 'core' gives each rank a "
+                         "contiguous block of allowed cores via the hwloc "
+                         "analog (ompi_tpu.base.hwloc); 'none' (default) "
+                         "leaves ranks unbound, like --oversubscribe")
     ap.add_argument("--enable-recovery", action="store_true",
                     help="ULFM mode: a dying rank is reported as a "
                          "proc_failed event instead of tearing down the job "
@@ -112,6 +117,9 @@ def main(argv=None) -> int:
     for rank in range(args.nprocs):
         env = dict(env_base)
         env["OTPU_RANK"] = str(rank)
+        if args.bind_to != "none":
+            env["OTPU_BIND_POLICY"] = args.bind_to
+            env["OTPU_LOCAL_NRANKS"] = str(args.nprocs)
         if args.fake_nodes > 0:
             env["OTPU_NODE_ID"] = f"node{rank * args.fake_nodes // args.nprocs}"
         try:

@@ -1,0 +1,133 @@
+"""hwloc-analog topology/binding + PERUSE matching-event tests.
+
+Reference models: ``opal/mca/hwloc`` (topology + binding policy) and
+``ompi/peruse/peruse.h`` events fired from ``pml_ob1_recvfrag.c``.
+"""
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.base import hwloc
+from ompi_tpu.runtime import peruse
+
+
+class TestHwloc:
+    def test_host_topology(self):
+        t = hwloc.host_topology(refresh=True)
+        assert t.ncpus_online >= 1
+        assert len(t.cpus_allowed) >= 1
+        assert t.hostname
+
+    def test_device_topology(self):
+        devs = hwloc.device_topology()
+        assert len(devs) >= 1
+        assert devs[0].index == 0
+        # CPU test mesh has no ICI coords; shape must be None not garbage
+        if all(d.coords is None for d in devs):
+            assert hwloc.ici_mesh_shape() is None
+
+    def test_binding_partition(self):
+        topo = hwloc.HostTopology("h", 8, tuple(range(8)),
+                                  ((0, tuple(range(4))),
+                                   (1, tuple(range(4, 8)))))
+        b0 = hwloc.compute_binding(0, 2, topo)
+        b1 = hwloc.compute_binding(1, 2, topo)
+        assert b0 == (0, 1, 2, 3) and b1 == (4, 5, 6, 7)
+        # oversubscribed: more ranks than cores → unbound (all cpus)
+        over = hwloc.compute_binding(3, 16, topo)
+        assert over == tuple(range(8))
+
+    def test_locality_tiers(self):
+        numa = ((0, (0, 1)), (1, (2, 3)))
+        assert hwloc.locality("a", "b") == hwloc.LOC_DIFFERENT_NODE
+        assert hwloc.locality("a", "a") == hwloc.LOC_SAME_NODE
+        assert hwloc.locality("a", "a", (0,), (1,), numa, ncpus=4) == \
+            hwloc.LOC_SAME_NUMA
+        assert hwloc.locality("a", "a", (0, 1), (1,), numa, ncpus=4) == \
+            hwloc.LOC_SAME_CORE
+        assert hwloc.locality("a", "a", (0,), (2,), numa, ncpus=4) == \
+            hwloc.LOC_SAME_NODE
+        # unbound ranks (full mask) must NOT look core-local
+        assert hwloc.locality("a", "a", (0, 1, 2, 3), (0, 1, 2, 3), numa,
+                              ncpus=4) == hwloc.LOC_SAME_NODE
+
+    def test_summary_runs(self):
+        s = hwloc.summary()
+        assert "host:" in s and "device[0]" in s
+
+
+@pytest.fixture(scope="module")
+def world():
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    yield w
+    rt.reset_for_testing()
+
+
+class TestPeruse:
+    def test_posted_then_matched(self, world):
+        events = []
+        h = peruse.subscribe(peruse.REQ_INSERT_IN_POSTED_Q,
+                             lambda e, cid, **i: events.append((e, i)))
+        h2 = peruse.subscribe(peruse.MSG_MATCH_POSTED_REQ,
+                              lambda e, cid, **i: events.append((e, i)))
+        try:
+            r = world.as_rank(0)
+            buf = np.zeros(1)
+            req = r.irecv(buf, source=1, tag=77)
+            assert any(e == peruse.REQ_INSERT_IN_POSTED_Q and
+                       i["tag"] == 77 for e, i in events)
+            world.as_rank(1).send(np.array([3.0]), dest=0, tag=77)
+            req.wait()
+            assert any(e == peruse.MSG_MATCH_POSTED_REQ for e, _ in events)
+        finally:
+            h.release()
+            h2.release()
+        assert not peruse.active()
+
+    def test_unexpected_queue_events(self, world):
+        events = []
+        hs = [peruse.subscribe(ev,
+                               lambda e, cid, **i: events.append((e, i)))
+              for ev in (peruse.MSG_INSERT_IN_UNEX_Q, peruse.REQ_MATCH_UNEX,
+                         peruse.REQ_COMPLETE)]
+        try:
+            world.as_rank(2).send(np.array([9.0]), dest=3, tag=5)
+            # no recv posted yet: the message must hit the unexpected queue
+            assert any(e == peruse.MSG_INSERT_IN_UNEX_Q for e, _ in events)
+            buf = np.zeros(1)
+            world.as_rank(3).recv(buf, source=2, tag=5)
+            assert buf[0] == 9.0
+            assert any(e == peruse.REQ_MATCH_UNEX for e, _ in events)
+        finally:
+            for h in hs:
+                h.release()
+
+    def test_comm_scoped_subscription(self, world):
+        """A subscription scoped to one comm ignores other comms."""
+        events = []
+        h = peruse.subscribe(peruse.REQ_ACTIVATE,
+                             lambda e, cid, **i: events.append(cid),
+                             comm=world)
+        try:
+            world.as_rank(4).send(np.array([1.0]), dest=5, tag=1)
+            buf = np.zeros(1)
+            world.as_rank(5).recv(buf, source=4, tag=1)
+            assert events and all(c == world.cid for c in events)
+        finally:
+            h.release()
+
+    def test_callback_errors_are_swallowed(self, world):
+        def bad(e, cid, **i):
+            raise RuntimeError("introspection bug")
+
+        h = peruse.subscribe(peruse.REQ_ACTIVATE, bad)
+        try:
+            world.as_rank(6).send(np.array([1.0]), dest=7, tag=2)
+            buf = np.zeros(1)
+            world.as_rank(7).recv(buf, source=6, tag=2)  # must not raise
+            assert buf[0] == 1.0
+        finally:
+            h.release()
