@@ -26,6 +26,20 @@ from ompi_tpu.api.request import Request, waitall
 from ompi_tpu.api.status import ANY_SOURCE, ANY_TAG, PROC_NULL, Status
 from ompi_tpu.datatype import Datatype, from_numpy_dtype
 
+_ft_state_mod = None
+
+
+def _ft_state():
+    """Cached ft.state module ref (import is lazy to avoid a cycle, but a
+    sys.modules lookup per _check_state would cost ~0.2us on the device
+    fast path)."""
+    global _ft_state_mod
+    if _ft_state_mod is None:
+        from ompi_tpu.ft import state
+
+        _ft_state_mod = state
+    return _ft_state_mod
+
 # collective function slots a coll module can fill (``mca/coll/coll.h``
 # module struct equivalent; *_array are the TPU device-buffer entry points)
 COLL_FUNCTIONS = (
@@ -85,6 +99,7 @@ class Comm(AttributeHost):
         self.freed = False
         self.remote_group = remote_group  # inter-communicator remote side
         self.pml = None           # selected pml module (set at selection time)
+        self._rev_key = None      # lazy (ft_scope, cid, epoch) probe key
         self._rank = group.rank_of(rte.my_world_rank) if rte else 0
         if parent is not None:
             self.errhandler = parent.errhandler
@@ -873,10 +888,12 @@ class Comm(AttributeHost):
 
     def is_revoked(self) -> bool:
         if not self.revoked:
-            from ompi_tpu.ft import state as ft_state
-
-            if ft_state.is_comm_revoked(self.cid, self.epoch,
-                                        self.ft_scope):
+            # hot path (every _check_state): prebuilt key + cached module
+            # ref, one set-membership probe
+            key = self._rev_key
+            if key is None:
+                key = self._rev_key = (self.ft_scope, self.cid, self.epoch)
+            if _ft_state().is_revoked_key(key):
                 self.revoked = True
         return self.revoked
 

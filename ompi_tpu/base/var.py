@@ -200,6 +200,7 @@ class Pvar:
     bind: str = ""                 # object class this binds to ("comm", "win", ...)
     readonly: bool = True
     continuous: bool = True
+    on_read: Optional[Callable] = None   # pre-read hook (flush deferred adds)
     _value: float = 0
     _touched: bool = False
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
@@ -226,6 +227,8 @@ class Pvar:
             self._touched = True
 
     def read(self) -> float:
+        if self.on_read is not None:
+            self.on_read()
         return self._value
 
     def reset(self) -> None:

@@ -55,6 +55,12 @@ def is_comm_revoked(cid: int, epoch: int = 0, job: str = "0") -> bool:
     return (job, cid, epoch) in _revoked_cids
 
 
+def is_revoked_key(key: tuple) -> bool:
+    """Hot-path variant: membership probe on a prebuilt (job, cid, epoch)
+    key — comms cache their key so _check_state costs one set lookup."""
+    return key in _revoked_cids
+
+
 def reset_for_testing() -> None:
     with _lock:
         _failed.clear()

@@ -96,7 +96,9 @@ class DeviceWorldRte(Rte):
         with self._lock:
             self._kv[(self.my_world_rank if rank is None else rank, key)] = value
 
-    def modex_get(self, rank: int, key: str) -> Any:
+    def modex_get(self, rank: int, key: str, wait: bool = True) -> Any:
+        # wait is part of the modex signature (ProcRte blocks on missing
+        # keys); in-process KV has nothing to wait for
         with self._lock:
             return self._kv.get((rank, key))
 
@@ -116,7 +118,7 @@ class SingletonRte(Rte):
     def modex_put(self, key: str, value: Any) -> None:
         self._kv[(0, key)] = value
 
-    def modex_get(self, rank: int, key: str) -> Any:
+    def modex_get(self, rank: int, key: str, wait: bool = True) -> Any:
         return self._kv.get((rank, key))
 
     def fence(self) -> None:

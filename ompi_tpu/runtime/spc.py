@@ -22,6 +22,12 @@ def init() -> None:
         _pvars[name] = registry.register_pvar(
             "runtime", "spc", name, pclass=PvarClass.COUNTER,
             help=f"SPC counter: number/volume of {name}")
+    # device counters accumulate in module ints (bump_device) and fold in
+    # lazily; the pre-read hook keeps direct pvar readers (otpu_info
+    # --pvars via registry.all_pvars) coherent too
+    for name in ("device_collectives", "device_bytes"):
+        if name in _pvars:
+            _pvars[name].on_read = _flush_device
 
 
 def record(name: str, value: float = 1) -> None:
@@ -30,23 +36,33 @@ def record(name: str, value: float = 1) -> None:
         pv.add(value)
 
 
-_dev_calls = None
-_dev_bytes = None
+_dev_calls_n = 0
+_dev_bytes_n = 0
 
 
 def bump_device(nbytes: int) -> None:
-    """Hot-path SPC bump for device collectives: relaxed (unlocked) adds,
-    mirroring the reference's plain inline counter increments
-    (``ompi_spc.c`` — SPC counters are not atomic unless multithreaded
-    accuracy is requested)."""
-    global _dev_calls, _dev_bytes
-    if _dev_calls is None:
-        _dev_calls = _pvars.get("device_collectives")
-        _dev_bytes = _pvars.get("device_bytes")
-        if _dev_calls is None:
-            return
-    _dev_calls.add_relaxed(1)
-    _dev_bytes.add_relaxed(nbytes)
+    """Hot-path SPC bump for device collectives: two plain integer adds
+    on module globals (folded into the pvars at read time), mirroring the
+    reference's inline non-atomic counter increments (``ompi_spc.c`` —
+    SPC counters are not atomic unless multithreaded accuracy is
+    requested)."""
+    global _dev_calls_n, _dev_bytes_n
+    _dev_calls_n += 1
+    _dev_bytes_n += nbytes
+
+
+def _flush_device() -> None:
+    """Fold the relaxed device-counter accumulators into their pvars."""
+    global _dev_calls_n, _dev_bytes_n
+    if _dev_calls_n:
+        pv = _pvars.get("device_collectives")
+        if pv is not None:
+            pv.add(_dev_calls_n)
+            _dev_calls_n = 0
+        pv = _pvars.get("device_bytes")
+        if pv is not None:
+            pv.add(_dev_bytes_n)
+            _dev_bytes_n = 0
 
 
 def read(name: str) -> float:
