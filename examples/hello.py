@@ -1,0 +1,25 @@
+"""Hello world — the ``examples/hello_c.c`` equivalent.
+
+Prints rank/size plus the node and transport facts a user checks first.
+Run: ``python examples/hello.py`` (singleton / device world) or
+``tpurun -n 4 python examples/hello.py``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ompi_tpu
+
+
+def main() -> None:
+    world = ompi_tpu.init()
+    import ompi_tpu as pkg
+
+    print(f"Hello, world, I am {world.rank} of {world.size} "
+          f"(ompi_tpu {pkg.__version__}, comm {world.name})", flush=True)
+    ompi_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -232,7 +232,9 @@ class Pvar:
         return self._value
 
     def reset(self) -> None:
-        with self._lock:
+        if self.on_read is not None:
+            self.on_read()   # fold deferred adds in before zeroing, so
+        with self._lock:     # pre-reset bumps can't resurface later
             self._value = 0
             self._touched = False
 

@@ -178,10 +178,11 @@ class XlaCollModule:
         # the key build + probe sit in the try: a failure INSIDE the
         # dispatch must surface, not silently re-run the collective
         entry = None
-        try:
-            entry = self._cache[_ar_key(x, op)]
-        except (KeyError, AttributeError, TypeError):  # miss or np input
-            pass
+        if not isinstance(x, np.ndarray):   # host stacks need _check's
+            try:                            # explicit sharded placement
+                entry = self._cache[_ar_key(x, op)]
+            except (KeyError, AttributeError, TypeError):  # miss/host input
+                pass
         if entry is not None:
             spc.bump_device(entry[1])
             return entry[0](x)

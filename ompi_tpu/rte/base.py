@@ -26,7 +26,9 @@ class Rte:
     def modex_put(self, key: str, value: Any) -> None:
         raise NotImplementedError
 
-    def modex_get(self, rank: int, key: str) -> Any:
+    def modex_get(self, rank: int, key: str, wait: bool = True) -> Any:
+        """Fetch a peer's modexed value; ``wait=False`` returns None
+        instead of blocking when the key hasn't been published yet."""
         raise NotImplementedError
 
     def fence(self) -> None:

@@ -280,6 +280,160 @@ def _reduce_to_all(sym: SymArray, op) -> None:
     sym.local[:] = np.asarray(out).reshape(sym.local.shape)
 
 
+def prod_to_all(sym: SymArray) -> None:
+    _reduce_to_all(sym, op_mod.PROD)
+
+
+def and_to_all(sym: SymArray) -> None:
+    _reduce_to_all(sym, op_mod.BAND)
+
+
+def or_to_all(sym: SymArray) -> None:
+    _reduce_to_all(sym, op_mod.BOR)
+
+
+def xor_to_all(sym: SymArray) -> None:
+    _reduce_to_all(sym, op_mod.BXOR)
+
+
+def fcollect(sym: SymArray) -> np.ndarray:
+    """``shmem_fcollect``: fixed-size collect (same as collect here —
+    symmetric allocations are same-sized by construction)."""
+    return collect(sym)
+
+
+def alltoall(sym: SymArray) -> np.ndarray:
+    """``shmem_alltoall``: block i of my ``sym`` goes to PE i; returns
+    the n_pes blocks received (also written back into ``sym.local``)."""
+    ctx = _get()
+    n = ctx.world.size
+    if sym.count % n:
+        raise MpiError(ErrorClass.ERR_BUFFER,
+                       f"alltoall needs count % n_pes == 0, got "
+                       f"{sym.count} % {n}")
+    out = ctx.world.alltoall(np.array(sym.local, copy=True).reshape(n, -1))
+    flat = np.asarray(out).reshape(-1).view(sym.dtype)
+    sym.local[:] = flat
+    return flat
+
+
+# -- strided / nonblocking put-get (shmem_iput/iget, *_nbi) ---------------
+
+def iput(sym: SymArray, value, tst: int, sst: int, count: int,
+         pe: int) -> None:
+    """``shmem_iput``: strided put — element i of ``value`` (stride sst)
+    lands at target index i*tst.
+
+    Contiguous targets (tst == 1) go as ONE transfer; true strided
+    targets must stay per-element — a bulk read-modify-write of the
+    covering range would clobber concurrent writes to the gap elements.
+    """
+    src = np.ascontiguousarray(value, dtype=sym.dtype).reshape(-1)
+    strided = src[::sst][:count] if sst > 1 else src[:count]
+    if tst == 1:
+        put(sym, strided, pe)
+        return
+    for i in range(count):
+        p(sym, strided[i], pe, index=i * tst)
+
+
+def iget(sym: SymArray, tst: int, sst: int, count: int,
+         pe: int) -> np.ndarray:
+    """``shmem_iget``: strided get — returns ``count`` elements taken at
+    source stride sst (tst orders the local result).  One bulk get of
+    the covering range + a local stride slice (reads have no gap-clobber
+    hazard, so bulk is safe and ~count× fewer AM round trips)."""
+    span = (count - 1) * sst + 1
+    block = get(sym, span, pe)
+    return np.ascontiguousarray(block[::sst][:count])
+
+
+def put_nbi(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    """``shmem_put_nbi``: delivery is only guaranteed after quiet()."""
+    put(sym, value, pe, index)
+
+
+def get_nbi(sym: SymArray, count: int, pe: int, index: int = 0):
+    """``shmem_get_nbi`` analog: here gets complete on return (the
+    active-message spml has no split-phase read), which satisfies the
+    spec's 'complete by quiet' contract trivially."""
+    return get(sym, count, pe, index)
+
+
+# -- point-to-point synchronization (shmem_wait_until / test) -------------
+
+CMP_EQ = "=="
+CMP_NE = "!="
+CMP_GT = ">"
+CMP_GE = ">="
+CMP_LT = "<"
+CMP_LE = "<="
+
+_CMPS = {
+    CMP_EQ: lambda a, b: a == b,
+    CMP_NE: lambda a, b: a != b,
+    CMP_GT: lambda a, b: a > b,
+    CMP_GE: lambda a, b: a >= b,
+    CMP_LT: lambda a, b: a < b,
+    CMP_LE: lambda a, b: a <= b,
+}
+
+
+def test(sym: SymArray, cmp: str, value, index: int = 0) -> bool:
+    """``shmem_test``: one non-blocking check of a local symmetric word."""
+    from ompi_tpu.runtime.progress import progress
+
+    progress()        # let inbound AM puts land
+    return bool(_CMPS[cmp](sym.local[index], sym.dtype.type(value)))
+
+
+def wait_until(sym: SymArray, cmp: str, value, index: int = 0) -> None:
+    """``shmem_wait_until``: spin (with progress) until the local word
+    satisfies the comparison — the classic SHMEM point-to-point signal."""
+    from ompi_tpu.runtime.progress import progress
+
+    fn = _CMPS[cmp]
+    target = sym.dtype.type(value)
+    while not fn(sym.local[index], target):
+        progress()
+
+
+# -- distributed locks (shmem_set_lock / test_lock / clear_lock) ----------
+# The reference implements these over remote atomics in the lock owner's
+# symmetric word (oshmem/src/shmem_lock.c uses a ticket scheme); here:
+# test-and-set via atomic CAS on PE 0's copy, MCS-free but fair enough
+# for the API contract (mutual exclusion + eventual acquisition).
+
+def set_lock(lock: SymArray, index: int = 0) -> None:
+    """``shmem_set_lock``: acquire; spins with backoff on contention."""
+    import time as _time
+
+    me = my_pe() + 1          # 0 = unlocked; owner stored as pe+1
+    delay = 1e-5
+    while True:
+        prev = atomic_compare_swap(lock, 0, me, pe=0, index=index)
+        if prev == 0:
+            return
+        _time.sleep(delay)
+        delay = min(delay * 2, 2e-3)
+
+
+def test_lock(lock: SymArray, index: int = 0) -> bool:
+    """``shmem_test_lock``: try-acquire; True if the lock was taken."""
+    return bool(atomic_compare_swap(lock, 0, my_pe() + 1, pe=0,
+                                    index=index) == 0)
+
+
+def clear_lock(lock: SymArray, index: int = 0) -> None:
+    """``shmem_clear_lock``: release (must hold it); quiets first so
+    writes in the critical section are visible before the release."""
+    quiet()
+    prev = atomic_compare_swap(lock, my_pe() + 1, 0, pe=0, index=index)
+    if prev != my_pe() + 1:
+        raise MpiError(ErrorClass.ERR_RMA_SYNC,
+                       f"clear_lock by non-owner (lock word {prev})")
+
+
 def reset_for_testing() -> None:
     global _ctx
     _ctx = None

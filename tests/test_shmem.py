@@ -109,3 +109,91 @@ def test_shmem_put_get_atomics_colls(tmp_path):
     r = _tpurun(4, [sys.executable, str(script)])
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("shmem OK") == 4
+
+
+def test_shmem_sync_locks_strided(tmp_path):
+    """wait_until/test, distributed locks, iput/iget, nbi, alltoall,
+    bitwise/prod reductions (shmem_lock.c / shmem_iput / wait_until)."""
+    script = tmp_path / "shmem_sync.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import ompi_tpu.shmem as shmem
+        shmem.init()
+        me, n = shmem.my_pe(), shmem.n_pes()
+
+        # wait_until: PE0 signals each peer's flag word in turn
+        f = shmem.array(1, np.int64)
+        f.local[0] = 0
+        shmem.barrier_all()
+        if me == 0:
+            for pe in range(1, n):
+                shmem.p(f, pe * 7, pe)
+            shmem.quiet()
+        else:
+            shmem.wait_until(f, shmem.CMP_EQ, me * 7)
+            assert not shmem.test(f, shmem.CMP_NE, me * 7)
+
+        # distributed lock protects a read-modify-write on PE 0
+        lock = shmem.array(1, np.int64)
+        tot = shmem.array(1, np.int64)
+        lock.local[0] = 0
+        tot.local[0] = 0
+        shmem.barrier_all()
+        for _ in range(3):
+            shmem.set_lock(lock)
+            v = int(shmem.g(tot, 0))
+            shmem.p(tot, v + 1, 0)
+            shmem.quiet()
+            shmem.clear_lock(lock)
+        shmem.barrier_all()
+        if me == 0:
+            assert tot.local[0] == 3 * n, tot.local
+            # free lock: try-acquire succeeds; a second try fails until
+            # the holder clears it
+            assert shmem.test_lock(lock) is True
+            assert shmem.test_lock(lock) is False
+            shmem.clear_lock(lock)
+        shmem.barrier_all()
+
+        # strided iput/iget: write every 2nd slot of the right neighbor
+        s = shmem.array(8, np.float64)
+        s.local[:] = -1.0
+        shmem.barrier_all()
+        shmem.iput(s, np.array([me, me, me, me], float), tst=2, sst=1,
+                   count=4, pe=(me + 1) % n)
+        shmem.barrier_all()
+        left = (me - 1) % n
+        assert s.local[::2].tolist() == [left] * 4, s.local
+        back = shmem.iget(s, tst=1, sst=2, count=4, pe=me)
+        assert back.tolist() == [left] * 4
+
+        # nbi put completes by quiet
+        q = shmem.array(1, np.float64)
+        q.local[0] = 0
+        shmem.barrier_all()
+        shmem.put_nbi(q, np.array([me + 1.0]), (me + 1) % n)
+        shmem.quiet()
+        shmem.barrier_all()
+        assert q.local[0] == ((me - 1) % n) + 1.0
+
+        # alltoall + prod/bitwise reductions
+        a = shmem.array(n, np.int64)
+        a.local[:] = [me * n + j for j in range(n)]
+        out = shmem.alltoall(a)
+        assert out.tolist() == [j * n + me for j in range(n)], out
+        pr = shmem.array(1, np.int64)
+        pr.local[0] = me + 1
+        shmem.prod_to_all(pr)
+        import math
+        assert pr.local[0] == math.factorial(n)
+        bw = shmem.array(1, np.int64)
+        bw.local[0] = 1 << me
+        shmem.or_to_all(bw)
+        assert bw.local[0] == (1 << n) - 1
+
+        shmem.barrier_all()
+        print(f"shmem sync OK pe {me}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("shmem sync OK") == 4
