@@ -170,6 +170,63 @@ def host_ring_smoke() -> dict:
             "wall_s": round(dt, 2)}
 
 
+_HOST_OSU = """
+import json, statistics, sys, time
+import numpy as np
+import ompi_tpu
+
+w = ompi_tpu.init()
+out = []
+for nbytes in (4096, 262144, 4 << 20):
+    x = np.ones(nbytes // 4, np.float32)
+    for _ in range(3):
+        w.allreduce(x)
+    lat = []
+    iters = 20 if nbytes <= 262144 else 8
+    for _ in range(iters):
+        w.barrier()
+        t0 = time.perf_counter()
+        w.allreduce(x)
+        lat.append(time.perf_counter() - t0)
+    out.append((nbytes, statistics.median(lat)))
+if w.rank == 0:
+    print("OSU_HOST " + json.dumps(out))
+ompi_tpu.finalize()
+"""
+
+
+def host_allreduce_points(n: int = 4) -> list:
+    """BASELINE config #2: OSU allreduce over the host path (pml/sm +
+    coll/tuned ladder), n CPU ranks under tpurun."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_HOST_OSU)
+        script = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+             sys.executable, script],
+            capture_output=True, text=True, timeout=240,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if "OSU_HOST" in ln), None)
+        if proc.returncode or line is None:
+            print(f"host allreduce bench failed (rc={proc.returncode}):\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            return [{"coll": "allreduce_host_tuned", "ok": False}]
+        pts = _json.loads(line.split("OSU_HOST ", 1)[1])
+        f_bus = _bus_factor("allreduce", n)
+        return [{"coll": "allreduce_host_tuned", "nbytes": nb,
+                 "fw_lat_us": round(t * 1e6, 1),
+                 "fw_bw_gbs": round(f_bus * nb / t / 1e9, 4)}
+                for nb, t in pts]
+    finally:
+        os.unlink(script)
+
+
 def main() -> None:
     fast = os.environ.get("OTPU_BENCH_FAST", "") not in ("", "0")
     try:
@@ -220,6 +277,10 @@ def main() -> None:
             results.append(host_ring_smoke())
         except Exception as exc:
             print(f"ring smoke failed: {exc}", file=sys.stderr)
+        try:
+            results.extend(host_allreduce_points())
+        except Exception as exc:
+            print(f"host allreduce failed: {exc}", file=sys.stderr)
 
         here = os.path.dirname(os.path.abspath(__file__))
         with open(os.path.join(here, "BENCH_SWEEP.json"), "w") as f:

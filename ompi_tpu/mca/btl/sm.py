@@ -124,9 +124,12 @@ def _attach(name: str) -> shared_memory.SharedMemory:
 class SmBtl(Btl):
     name = "sm"
     priority = 50
-    eager_limit = 16 * 1024
-    rndv_eager_limit = 16 * 1024
-    max_send_size = 64 * 1024
+    # shared memory pays per-handoff (scheduling) cost, not per-byte:
+    # large fragments measure ~1.5x faster on the 4MB OSU point than the
+    # old 64k ones (see BENCH_SWEEP.md host rows)
+    eager_limit = 64 * 1024
+    rndv_eager_limit = 64 * 1024
+    max_send_size = 512 * 1024
     latency = 10          # below tcp (100), above self (0)
     bandwidth = 10000
 
@@ -141,17 +144,25 @@ class SmBtl(Btl):
         # and shared memory must not be offered across that boundary so
         # inter-node traffic honestly exercises the DCN (tcp) path
         self._hostname = os.environ.get("OTPU_NODE_ID", socket.gethostname())
-        self._ring_size = 1 << 20
+        self._ring_size = 4 << 20
+
+    def _clamped(self, limit: int) -> int:
+        """A frame larger than the ring can NEVER be pushed (push would
+        retry forever) — bound protocol limits to half the capacity minus
+        framing/pickle slack, so two in-flight max frags always fit
+        (btl.h's limits are likewise bounded by transport buffer sizes)."""
+        return min(int(limit), max(1024, self._ring_size // 2 - 4096))
 
     def register_vars(self, fw) -> None:
         self.register_var(
-            "ring_size", vtype=VarType.SIZE, default="1m",
-            help="Per-peer shared-memory FIFO capacity",
-            on_set=lambda v: setattr(self, "_ring_size", v))
+            "ring_size", vtype=VarType.SIZE, default="4m",
+            help="Per-peer shared-memory FIFO capacity (takes effect at "
+                 "setup; rings are not resized after init)",
+            on_set=lambda v: setattr(self, "_ring_size", int(v)))
         self.register_var(
-            "eager_limit", vtype=VarType.SIZE, default="16k",
+            "eager_limit", vtype=VarType.SIZE, default="64k",
             help="Max eager message size over sm",
-            on_set=lambda v: setattr(self, "eager_limit", v))
+            on_set=lambda v: setattr(self, "eager_limit", self._clamped(v)))
 
     def setup(self, rte) -> bool:
         if rte.is_device_world or rte.world_size <= 1:
@@ -159,6 +170,9 @@ class SmBtl(Btl):
         if not hasattr(rte, "modex_put"):
             return False
         self._rte = rte
+        self.max_send_size = self._clamped(self.max_send_size)
+        self.eager_limit = self._clamped(self.eager_limit)
+        self.rndv_eager_limit = self._clamped(self.rndv_eager_limit)
         me = rte.my_world_rank
         job = os.environ.get("OTPU_COORD", "local").replace(":", "_") \
             .replace(".", "_")

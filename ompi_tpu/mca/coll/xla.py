@@ -122,7 +122,13 @@ class XlaCollModule:
         return jax.device_put(arr, self._sharded)
 
     def _get(self, comm, key, x, builder, inner_n: bool = False):
-        """One-probe fast path; build+validate under the lock on miss."""
+        """One-probe fast path; build+validate under the lock on miss.
+
+        Host (numpy) inputs always go through _check for explicit sharded
+        placement — a warm cache must not hand a raw host array to the
+        compiled program."""
+        if isinstance(x, np.ndarray):
+            x = self._check(comm, x, inner_n)
         entry = self._cache.get(key)
         if entry is None:
             x = self._check(comm, x, inner_n)
