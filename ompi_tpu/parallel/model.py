@@ -70,13 +70,51 @@ def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None):
     return num / den[..., None]
 
 
-def attention_block(p, x, *, sp: int, tp: int, n_heads_local: int):
-    """Ring attention with tp-sharded heads; psum-combined output proj.
+def ulysses_attention(q, k, v, axis: str, n_shards: int):
+    """DeepSpeed-Ulysses sequence parallelism: all-to-all head↔sequence
+    reshard instead of the ring's K/V rotation.
+
+    q/k/v local: (b, h_local, s_local, hd) with h_local % n_shards == 0.
+    One ``all_to_all`` turns the sequence axis local-complete (each shard
+    keeps h_local/n_shards heads over the FULL sequence), attention runs
+    locally with no inter-step dependency, and the inverse all_to_all
+    restores sequence sharding.  Two collectives total vs the ring's
+    n_shards ppermute steps — better for short-ish sequences on fast ICI;
+    the ring wins at very long context (O(s_local) memory).  The MoE-
+    dispatch-shaped exchange of SURVEY.md §2.6's alltoall row.
+    """
+    if n_shards == 1:
+        return _full_attention(q, k, v)
+
+    def scatter_heads(t):   # (b, h_l, s_l, hd) -> (b, h_l/n, s, hd)
+        return jax.lax.all_to_all(t, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    o = _full_attention(scatter_heads(q), scatter_heads(k),
+                        scatter_heads(v))          # (b, h_l/n, s, hd)
+    # inverse reshard: full-sequence heads -> my seq block, all heads
+    return jax.lax.all_to_all(o, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _full_attention(q, k, v):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def attention_block(p, x, *, sp: int, tp: int, n_heads_local: int,
+                    sp_impl: str = "ring"):
+    """Sequence-parallel attention with tp-sharded heads; psum output proj.
 
     x local: (b, s_local, d) replicated over tp.  Head projections are
     column-sharded over tp (h_local = H/tp); the output projection is
     row-sharded, so its partial products combine with a ``psum`` over tp —
     the tensor-parallel allreduce (DP/TP table row, SURVEY.md §2.6).
+
+    ``sp_impl`` picks the context-parallel scheme: "ring" (ppermute K/V
+    rotation, O(s_local) memory — long context) or "ulysses" (all-to-all
+    head↔seq reshard, 2 collectives — short/medium context on fast ICI).
     """
     b, s_l, d = x.shape
     h = rmsnorm(x)
@@ -86,7 +124,16 @@ def attention_block(p, x, *, sp: int, tp: int, n_heads_local: int):
         return y.reshape(b, s_l, n_heads_local, -1).transpose(0, 2, 1, 3)
 
     q, k, v = heads(p["wq"]), heads(p["wk"]), heads(p["wv"])
-    o = ring_attention(q, k, v, "sp", sp)           # (b, h_l, s_l, hd)
+    if sp_impl == "ulysses" and sp > 1:
+        if n_heads_local % sp:
+            # silent ring fallback would invalidate any collective-count
+            # comparison the user is running — fail loudly instead
+            raise ValueError(
+                f"ulysses needs local heads divisible by sp "
+                f"({n_heads_local} % {sp}); use sp_impl='ring'")
+        o = ulysses_attention(q, k, v, "sp", sp)    # (b, h_l, s_l, hd)
+    else:
+        o = ring_attention(q, k, v, "sp", sp)       # (b, h_l, s_l, hd)
     o = o.transpose(0, 2, 1, 3).reshape(b, s_l, -1)  # (b, s_l, h_l*hd)
     o = o @ p["wo"]
     if tp > 1:
@@ -154,8 +201,10 @@ def moe_block(p, x, *, tp: int, n_experts: int, capacity: int):
     return x + out.reshape(b, s_l, d)
 
 
-def transformer_block(p, x, *, sp, tp, n_heads_local, n_experts, capacity):
-    x = attention_block(p, x, sp=sp, tp=tp, n_heads_local=n_heads_local)
+def transformer_block(p, x, *, sp, tp, n_heads_local, n_experts, capacity,
+                      sp_impl: str = "ring"):
+    x = attention_block(p, x, sp=sp, tp=tp, n_heads_local=n_heads_local,
+                        sp_impl=sp_impl)
     x = mlp_block(p, x, tp=tp)
     x = moe_block(p, x, tp=tp, n_experts=n_experts, capacity=capacity)
     return x

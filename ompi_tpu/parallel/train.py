@@ -17,9 +17,17 @@ from __future__ import annotations
 
 import numpy as np
 
+from ompi_tpu.base.var import VarType, registry
 from ompi_tpu.parallel.mesh import MeshSpec
 from ompi_tpu.parallel.model import transformer_block
 from ompi_tpu.parallel.pipeline import pipeline_apply
+
+_sp_impl_var = registry.register(
+    "parallel", None, "sp_impl", vtype=VarType.STRING, default="ring",
+    enum_values=("ring", "ulysses"),
+    help="Sequence/context-parallel attention scheme: 'ring' (ppermute "
+         "K/V rotation, O(s_local) memory) or 'ulysses' (all-to-all "
+         "head<->seq reshard, 2 collectives; local heads must divide sp)")
 
 
 def model_dims(spec: MeshSpec) -> dict:
@@ -86,6 +94,7 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4):
     dims = model_dims(spec)
     tp, sp_n, pp = spec.tp, spec.sp, spec.pp
     M, mb, s_l, d = dims["M"], dims["mb"], dims["s_local"], dims["d"]
+    sp_impl = str(_sp_impl_var.value)
 
     def stage_fn(stage_params, x_mb):
         for i in range(dims["layers_local"]):
@@ -93,7 +102,8 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4):
             x_mb = transformer_block(
                 layer, x_mb, sp=sp_n, tp=tp,
                 n_heads_local=dims["h_local"],
-                n_experts=dims["n_experts"], capacity=dims["capacity"])
+                n_experts=dims["n_experts"], capacity=dims["capacity"],
+                sp_impl=sp_impl)
         return x_mb
 
     def body(params, x):

@@ -1,0 +1,83 @@
+"""MPI_T tool-interface tests (``ompi/mpi/tool`` analog)."""
+import numpy as np
+import pytest
+
+from ompi_tpu.api import tool
+from ompi_tpu.api.errors import MpiError
+
+
+@pytest.fixture(scope="module", autouse=True)
+def world():
+    """MPI init populates the registry (frameworks register their vars at
+    open, exactly like the reference's lazy var registration)."""
+    import ompi_tpu
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    yield w
+    rt.reset_for_testing()
+
+
+@pytest.fixture(autouse=True)
+def t_init():
+    tool.init_thread()
+    yield
+    tool.finalize()
+
+
+def test_requires_init():
+    tool.finalize()           # undo the fixture's init
+    with pytest.raises(MpiError):
+        tool.cvar_get_num()
+    tool.init_thread()        # restore for the fixture's finalize
+
+
+def test_cvar_enumerate_read_write():
+    n = tool.cvar_get_num()
+    assert n > 0
+    i = tool.cvar_get_index("otpu_coll_tuned_allreduce_algorithm")
+    var = tool.cvar_get_info(i)
+    assert var.name == "otpu_coll_tuned_allreduce_algorithm"
+    old = tool.cvar_read(i)
+    tool.cvar_write(i, "ring")
+    assert tool.cvar_read(i) == "ring"
+    assert var.source_detail == "MPI_T"
+    tool.cvar_write(i, old or "")
+
+
+def test_pvar_session_delta_semantics(world):
+    w = world
+    i = tool.pvar_get_index("otpu_runtime_spc_device_collectives")
+    s1 = tool.pvar_session_create()
+    s2 = tool.pvar_session_create()
+    h1 = s1.handle_alloc(i)
+    h1.start()
+    w.allreduce_array(np.ones((w.size, 8), np.float32))
+    # a second session's handle started later sees only ITS delta
+    h2 = s2.handle_alloc(i)
+    h2.start()
+    w.allreduce_array(np.ones((w.size, 8), np.float32))
+    assert h1.read() >= 2
+    assert h2.read() >= 1
+    assert h1.read() > h2.read()
+    s1.handle_free(h1)
+    tool.pvar_session_free(s2)
+
+
+def test_categories_are_frameworks():
+    n = tool.category_get_num()
+    assert n > 0
+    names = [tool.category_get_info(i)[0] for i in range(n)]
+    assert "coll" in names
+    cname, _desc, cvars = tool.category_get_info(names.index("coll"))
+    assert any("coll" in v for v in cvars)
+
+
+def test_bad_indices_raise():
+    with pytest.raises(MpiError):
+        tool.cvar_get_info(10 ** 9)
+    with pytest.raises(MpiError):
+        tool.pvar_get_info(-5)
+    with pytest.raises(MpiError):
+        tool.cvar_get_index("no_such_var_xyz")
