@@ -22,7 +22,7 @@ from ompi_tpu.api.errhandler import ERRORS_ARE_FATAL, Errhandler
 from ompi_tpu.api.errors import ErrorClass, MpiError, RevokedError
 from ompi_tpu.api.group import Group
 from ompi_tpu.api.info import Info
-from ompi_tpu.api.request import Request, waitall
+from ompi_tpu.api.request import CompletedRequest, Request, waitall
 from ompi_tpu.api.status import ANY_SOURCE, ANY_TAG, PROC_NULL, Status
 from ompi_tpu.datatype import Datatype, from_numpy_dtype
 
@@ -347,11 +347,87 @@ class Comm(AttributeHost):
 
     def isend(self, buf, dest: int, tag: int = 0) -> Request:
         self._check_state(dest)
+        if dest == PROC_NULL:
+            return CompletedRequest()
         return self.pml.isend(self, buf, dest, tag)
 
     def irecv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
         self._check_state(source)
+        if source == PROC_NULL:
+            return CompletedRequest(Status(source=PROC_NULL, tag=ANY_TAG))
         return self.pml.irecv(self, buf, source, tag)
+
+    def ssend(self, buf, dest: int, tag: int = 0) -> None:
+        """``MPI_Ssend``: returns only after the receiver matched."""
+        self.issend(buf, dest, tag).wait()
+
+    def issend(self, buf, dest: int, tag: int = 0) -> Request:
+        self._check_state(dest)
+        if dest == PROC_NULL:
+            return CompletedRequest()
+        return self.pml.isend(self, buf, dest, tag, sync=True)
+
+    def rsend(self, buf, dest: int, tag: int = 0) -> None:
+        """``MPI_Rsend``: the caller asserts the recv is posted; with a
+        posted recv it behaves exactly like send (MPI guarantees nothing
+        extra), so it shares the standard path like pml/ob1 does."""
+        self.send(buf, dest, tag)
+
+    def irsend(self, buf, dest: int, tag: int = 0) -> Request:
+        return self.isend(buf, dest, tag)
+
+    def bsend(self, buf, dest: int, tag: int = 0) -> None:
+        """``MPI_Bsend``: copies into the attached buffer space and
+        returns immediately; the user's buffer is reusable on return."""
+        self.ibsend(buf, dest, tag)   # ibsend is already locally complete
+
+    def ibsend(self, buf, dest: int, tag: int = 0) -> Request:
+        from ompi_tpu.api import buffer as _bsend
+
+        self._check_state(dest)
+        if dest == PROC_NULL:
+            return CompletedRequest()
+        arr = np.ascontiguousarray(buf)
+        _bsend.claim(arr.nbytes)
+        inner = self.pml.isend(self, arr.copy(), dest, tag)
+        _bsend.track(inner, arr.nbytes)
+        # buffered semantics: the returned request is LOCALLY complete —
+        # the message lives in the (conceptual) attach buffer; only
+        # Buffer_detach waits for the real delivery.  A rendezvous-size
+        # inner request must not leak to the caller or bsend-then-wait-
+        # then-recv pairs would deadlock (the pattern Bsend exists for).
+        return CompletedRequest()
+
+    # -- persistent point-to-point (``MPI_Send_init``/``Recv_init``) ----
+    def send_init(self, buf, dest: int, tag: int = 0) -> Request:
+        from ompi_tpu.api.request import CompletedRequest as _CR, \
+            PersistentP2P
+
+        self._check_state(dest)
+        if dest == PROC_NULL:
+            return PersistentP2P(lambda: _CR())
+        return PersistentP2P(lambda: self.pml.isend(self, buf, dest, tag))
+
+    def ssend_init(self, buf, dest: int, tag: int = 0) -> Request:
+        from ompi_tpu.api.request import CompletedRequest as _CR, \
+            PersistentP2P
+
+        self._check_state(dest)
+        if dest == PROC_NULL:
+            return PersistentP2P(lambda: _CR())
+        return PersistentP2P(
+            lambda: self.pml.isend(self, buf, dest, tag, sync=True))
+
+    def recv_init(self, buf, source: int = ANY_SOURCE,
+                  tag: int = ANY_TAG) -> Request:
+        from ompi_tpu.api.request import CompletedRequest as _CR, \
+            PersistentP2P
+
+        self._check_state(source)
+        if source == PROC_NULL:
+            return PersistentP2P(
+                lambda: _CR(Status(source=PROC_NULL, tag=ANY_TAG)))
+        return PersistentP2P(lambda: self.pml.irecv(self, buf, source, tag))
 
     def sendrecv(self, sendbuf, dest: int, recvbuf, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG) -> Status:

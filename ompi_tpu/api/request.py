@@ -70,6 +70,8 @@ class Request:
 
     # -- MPI operations --------------------------------------------------
     def test(self) -> tuple[bool, Optional[Status]]:
+        if self.persistent and self.state is RequestState.INACTIVE:
+            return True, Status()    # MPI-3.1 §3.7.3: inactive → empty status
         if not self.complete_flag:
             _progress()
         if self.complete_flag:
@@ -78,7 +80,12 @@ class Request:
         return False, None
 
     def wait(self, timeout: Optional[float] = None) -> Status:
-        """Spin in the progress engine until complete (``request.h:427``)."""
+        """Spin in the progress engine until complete (``request.h:427``).
+
+        An inactive persistent request returns immediately with the empty
+        status (MPI-3.1 §3.7.3) instead of spinning forever."""
+        if self.persistent and self.state is RequestState.INACTIVE:
+            return Status()
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         while not self.complete_flag:
@@ -133,6 +140,41 @@ class CompletedRequest(Request):
         if status is not None:
             self.status = status
         self.complete()
+
+
+class PersistentP2P(Request):
+    """``MPI_Send_init``/``MPI_Recv_init``: a reusable communication
+    specification.  Each ``start()`` issues a fresh underlying pml
+    request; completion (and the received status) is mirrored up.
+    Inactive until the first start, like the reference
+    (``ompi/request/request.h`` persistent lifecycle)."""
+
+    def __init__(self, issue) -> None:
+        super().__init__(persistent=True)
+        self._issue = issue
+        self._inner: Optional[Request] = None
+
+    def _start(self) -> None:
+        inner = self._issue()
+        self._inner = inner
+
+        def mirror(r: Request) -> None:
+            self.status = r.status
+            self.complete(r.error)
+
+        inner.on_complete(mirror)
+
+    def _try_cancel(self) -> bool:
+        if self._inner is None:
+            return False
+        self._inner.cancel()
+        return self._inner.state is RequestState.CANCELLED
+
+
+def startall(requests) -> None:
+    """``MPI_Startall``."""
+    for r in requests:
+        r.start()
 
 
 class GeneralizedRequest(Request):

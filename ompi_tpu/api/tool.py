@@ -151,8 +151,15 @@ class PvarHandle:
         self._base = 0.0
         self._frozen = 0.0
 
+    def _delta_class(self) -> bool:
+        """Counters/timers report deltas against the start value; level,
+        size, and watermark classes are absolute (MPI-3 §14.3.7)."""
+        from ompi_tpu.base.var import PvarClass
+
+        return self.pvar.pclass in (PvarClass.COUNTER, PvarClass.TIMER)
+
     def start(self) -> None:
-        self._base = self.pvar.read()
+        self._base = self.pvar.read() if self._delta_class() else 0.0
         self.started = True
 
     def stop(self) -> None:

@@ -183,7 +183,13 @@ class Ob1Pml:
             req.complete(err)
 
     # -- send path (pml_ob1_isend.c:233) --------------------------------
-    def isend(self, comm, buf, dest: int, tag: int) -> Request:
+    def isend(self, comm, buf, dest: int, tag: int,
+              sync: bool = False) -> Request:
+        """``sync=True`` gives MPI_Ssend semantics: completion only after
+        the receiver has matched — implemented by forcing the rendezvous
+        protocol, whose sender completion requires the receiver's ACK
+        (``pml_ob1_sendreq.h:380`` RNDV; an eager send completes locally
+        and cannot observe the match)."""
         spc.record("isend")
         req = SendRequest(self, comm, buf, dest, tag)
         dst_world = (comm.remote_group if comm.is_inter
@@ -201,7 +207,7 @@ class Ob1Pml:
         seq = next(self._seq.setdefault(
             (comm.cid, src_world, dst_world), itertools.count()))
         spc.record("bytes_sent", req.nbytes)
-        if req.nbytes <= ep.btl.eager_limit:
+        if req.nbytes <= ep.btl.eager_limit and not sync:
             # eager: single MATCH fragment, complete immediately
             frag = Frag(comm.cid, src_world, dst_world, tag, seq, MATCH,
                         req.convertor.pack(), total_len=req.nbytes)

@@ -81,13 +81,13 @@ class PessimistPml:
                 .tobytes().hex()
         self._event("send", **rec)
 
-    def send(self, comm, buf, dest, tag):
+    def send(self, comm, buf, dest, tag, **kw):
         self._log_send(comm, buf, dest, tag)
-        return self._inner.send(comm, buf, dest, tag)
+        return self._inner.send(comm, buf, dest, tag, **kw)
 
-    def isend(self, comm, buf, dest, tag):
+    def isend(self, comm, buf, dest, tag, **kw):
         self._log_send(comm, buf, dest, tag)
-        return self._inner.isend(comm, buf, dest, tag)
+        return self._inner.isend(comm, buf, dest, tag, **kw)
 
     # -- recv side: the nondeterministic event is the MATCH --------------
     def _log_match(self, comm, req) -> None:

@@ -24,7 +24,7 @@ from ompi_tpu.parallel.pipeline import pipeline_apply
 
 _sp_impl_var = registry.register(
     "parallel", None, "sp_impl", vtype=VarType.STRING, default="ring",
-    enum_values=("ring", "ulysses"),
+    enum_values={"ring": 0, "ulysses": 1},
     help="Sequence/context-parallel attention scheme: 'ring' (ppermute "
          "K/V rotation, O(s_local) memory) or 'ulysses' (all-to-all "
          "head<->seq reshard, 2 collectives; local heads must divide sp)")

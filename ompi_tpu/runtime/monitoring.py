@@ -127,13 +127,13 @@ class MonitoringPml:
         record_p2p(comm.world_rank(comm.rank), dst_world,
                    int(np.asarray(buf).nbytes))
 
-    def send(self, comm, buf, dest, tag):
+    def send(self, comm, buf, dest, tag, **kw):
         self._record(comm, buf, dest)
-        return self._inner.send(comm, buf, dest, tag)
+        return self._inner.send(comm, buf, dest, tag, **kw)
 
-    def isend(self, comm, buf, dest, tag):
+    def isend(self, comm, buf, dest, tag, **kw):
         self._record(comm, buf, dest)
-        return self._inner.isend(comm, buf, dest, tag)
+        return self._inner.isend(comm, buf, dest, tag, **kw)
 
 
 _COLL_BYTES_ARG = {"bcast", "allreduce", "reduce", "allgather", "alltoall",

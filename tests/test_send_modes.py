@@ -1,0 +1,115 @@
+"""Send modes (ssend/bsend/rsend) + persistent p2p requests.
+
+Reference: ``ompi/mpi/c/{ssend,bsend,rsend,send_init,recv_init}.c`` and
+the pml's per-mode protocol choice (MCA_PML_BASE_SEND_SYNCHRONOUS etc.,
+``pml_ob1_isend.c``).
+"""
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.api import buffer as bsend_buf
+from ompi_tpu.api.errors import MpiError
+from ompi_tpu.api.request import startall, waitall
+
+
+@pytest.fixture(scope="module")
+def world():
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    yield w
+    rt.reset_for_testing()
+    bsend_buf.reset_for_testing()
+
+
+class TestSsend:
+    def test_issend_completes_only_on_match(self, world):
+        s, r = world.as_rank(0), world.as_rank(1)
+        req = s.issend(np.array([3.14]), dest=1, tag=9)
+        # progress a while: must NOT complete before the recv is posted
+        from ompi_tpu.runtime.progress import progress
+
+        for _ in range(50):
+            progress()
+        assert not req.complete_flag
+        buf = np.zeros(1)
+        rr = r.irecv(buf, source=0, tag=9)
+        req.wait()
+        rr.wait()
+        assert buf[0] == 3.14
+
+    def test_blocking_ssend(self, world):
+        s, r = world.as_rank(2), world.as_rank(3)
+        buf = np.zeros(2)
+        rr = r.irecv(buf, source=2, tag=4)
+        s.ssend(np.array([1.0, 2.0]), dest=3, tag=4)
+        rr.wait()
+        assert buf.tolist() == [1.0, 2.0]
+
+
+class TestBsend:
+    def test_requires_attach(self, world):
+        bsend_buf.reset_for_testing()
+        with pytest.raises(MpiError):
+            world.as_rank(0).bsend(np.array([1.0]), dest=1, tag=1)
+
+    def test_bsend_roundtrip_and_capacity(self, world):
+        bsend_buf.attach(1 << 16)
+        try:
+            s, r = world.as_rank(4), world.as_rank(5)
+            msg = np.arange(16.0)
+            s.bsend(msg, dest=5, tag=7)
+            msg[:] = -1           # caller may clobber after return
+            buf = np.zeros(16)
+            r.recv(buf, source=4, tag=7)
+            assert buf.tolist() == list(range(16))
+            # exhausting the buffer raises ERR_BUFFER
+            with pytest.raises(MpiError):
+                s.bsend(np.zeros(1 << 16, np.uint8), dest=5, tag=8)
+        finally:
+            bsend_buf.detach()
+
+    def test_detach_returns_buffer(self, world):
+        arr = np.zeros(4096, np.uint8)
+        bsend_buf.attach(arr)
+        assert bsend_buf.detach() is arr
+
+
+class TestPersistent:
+    def test_send_recv_init_restartable(self, world):
+        s, r = world.as_rank(6), world.as_rank(7)
+        src = np.zeros(1)
+        dst = np.zeros(1)
+        sreq = s.send_init(src, dest=7, tag=11)
+        rreq = r.recv_init(dst, source=6, tag=11)
+        for i in range(3):
+            src[0] = 10.0 + i
+            startall([sreq, rreq])
+            waitall([sreq, rreq])
+            assert dst[0] == 10.0 + i
+        # inactive between starts: wait on inactive is an error-free no-op
+        # but start-while-active raises
+        startall([rreq])
+        with pytest.raises(MpiError):
+            rreq.start()
+        src[0] = 99.0
+        sreq.start()
+        waitall([sreq, rreq])
+        assert dst[0] == 99.0
+
+    def test_ssend_init(self, world):
+        s, r = world.as_rank(0), world.as_rank(2)
+        dst = np.zeros(1)
+        sreq = s.ssend_init(np.array([5.0]), dest=2, tag=21)
+        sreq.start()
+        from ompi_tpu.runtime.progress import progress
+
+        for _ in range(50):
+            progress()
+        assert not sreq.complete_flag     # sync: needs the match
+        rr = r.irecv(dst, source=0, tag=21)
+        sreq.wait()
+        rr.wait()
+        assert dst[0] == 5.0
