@@ -21,6 +21,13 @@ _API = {
     "finalize": "ompi_tpu.runtime.init",
     "initialized": "ompi_tpu.runtime.init",
     "finalized": "ompi_tpu.runtime.init",
+    "init_thread": "ompi_tpu.runtime.init",
+    "query_thread": "ompi_tpu.runtime.interlib",
+    "is_thread_main": "ompi_tpu.runtime.interlib",
+    "THREAD_SINGLE": "ompi_tpu.runtime.interlib",
+    "THREAD_FUNNELED": "ompi_tpu.runtime.interlib",
+    "THREAD_SERIALIZED": "ompi_tpu.runtime.interlib",
+    "THREAD_MULTIPLE": "ompi_tpu.runtime.interlib",
     "COMM_WORLD": "ompi_tpu.runtime.init",
     "COMM_SELF": "ompi_tpu.runtime.init",
     "Comm": "ompi_tpu.api.comm",

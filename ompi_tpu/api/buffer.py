@@ -48,7 +48,14 @@ def detach():
             raise MpiError(ErrorClass.ERR_BUFFER, "no bsend buffer attached")
         pending = list(_pending)
     for req in pending:
-        req.wait()
+        try:
+            req.wait()
+        except Exception:
+            # a buffered send to a dead peer completes in error; the
+            # detach must still succeed (the buffer IS drained — the
+            # message just won't arrive), or buffered sends would be
+            # bricked for the rest of the process
+            pass
     with _lock:
         obj = _attached_obj
         _attached_obj = None
@@ -71,6 +78,13 @@ def claim(nbytes: int) -> None:
                 ErrorClass.ERR_BUFFER,
                 f"bsend buffer exhausted ({_in_use}+{need} > {_capacity})")
         _in_use += need
+
+
+def release(nbytes: int) -> None:
+    """Undo a claim whose send was never issued (isend raised)."""
+    global _in_use
+    with _lock:
+        _in_use = max(0, _in_use - (nbytes + BSEND_OVERHEAD))
 
 
 def track(req, nbytes: int) -> None:

@@ -389,7 +389,11 @@ class Comm(AttributeHost):
             return CompletedRequest()
         arr = np.ascontiguousarray(buf)
         _bsend.claim(arr.nbytes)
-        inner = self.pml.isend(self, arr.copy(), dest, tag)
+        try:
+            inner = self.pml.isend(self, arr.copy(), dest, tag)
+        except Exception:
+            _bsend.release(arr.nbytes)   # claim must not leak
+            raise
         _bsend.track(inner, arr.nbytes)
         # buffered semantics: the returned request is LOCALLY complete —
         # the message lives in the (conceptual) attach buffer; only

@@ -147,7 +147,8 @@ class PersistentP2P(Request):
     specification.  Each ``start()`` issues a fresh underlying pml
     request; completion (and the received status) is mirrored up.
     Inactive until the first start, like the reference
-    (``ompi/request/request.h`` persistent lifecycle)."""
+    (``ompi/request/request.h`` persistent lifecycle).  Start with
+    :func:`start_all` (``MPI_Startall``)."""
 
     def __init__(self, issue) -> None:
         super().__init__(persistent=True)
@@ -169,12 +170,6 @@ class PersistentP2P(Request):
             return False
         self._inner.cancel()
         return self._inner.state is RequestState.CANCELLED
-
-
-def startall(requests) -> None:
-    """``MPI_Startall``."""
-    for r in requests:
-        r.start()
 
 
 class GeneralizedRequest(Request):
@@ -248,21 +243,38 @@ def waitsome(requests: Sequence[Request]) -> tuple[list[int], list[Status]]:
     return out, stats
 
 
+def _inactive(r: Request) -> bool:
+    """Inactive persistent requests don't participate in the wait/test
+    families and count as trivially complete (MPI-3.1 §3.7.3/§3.7.5)."""
+    return r.persistent and r.state is RequestState.INACTIVE
+
+
 def testall(requests: Sequence[Request]) -> tuple[bool, Optional[list[Status]]]:
     _progress()
-    if all(r.complete_flag for r in requests):
+    if all(r.complete_flag or _inactive(r) for r in requests):
+        out = []
         for r in requests:
+            if _inactive(r):
+                out.append(Status())
+                continue
             r._raise_if_error()
-        return True, [r.status for r in requests]
+            out.append(r.status)
+        return True, out
     return False, None
 
 
 def testany(requests: Sequence[Request]) -> tuple[bool, int, Optional[Status]]:
     _progress()
+    active = False
     for i, r in enumerate(requests):
+        if _inactive(r):
+            continue
+        active = True
         if r.complete_flag:
             r._raise_if_error()
             return True, i, r.status
+    if not active:
+        return True, UNDEFINED, Status()
     return False, UNDEFINED, None
 
 
@@ -278,5 +290,9 @@ def testsome(requests: Sequence[Request]) -> tuple[list[int], list[Status]]:
 
 
 def start_all(requests: Iterable[Request]) -> None:
+    """``MPI_Startall``."""
     for r in requests:
         r.start()
+
+
+startall = start_all   # MPI spelling

@@ -174,7 +174,7 @@ class PvarHandle:
         return self.pvar.read() - self._base
 
     def reset(self) -> None:
-        self._base = self.pvar.read()
+        self._base = self.pvar.read() if self._delta_class() else 0.0
         self._frozen = 0.0
 
 

@@ -139,6 +139,38 @@ def open_port(comm=None) -> str:
     return f"otpu-port-{seq}"
 
 
+def publish_name(service: str, port: str, comm=None) -> None:
+    """``MPI_Publish_name``: bind a service name to a port so an unrelated
+    job can find it (``ompi/mpi/c/publish_name.c`` — PMIx publish)."""
+    import ompi_tpu
+
+    client = _client(comm or ompi_tpu.COMM_WORLD)
+    existing = client.put_new(-1, f"__dpm_svc_{service}__", port)
+    if existing is not None and existing != port:
+        raise MpiError(ErrorClass.ERR_NAME,
+                       f"service {service!r} already published")
+
+
+def lookup_name(service: str, comm=None, wait: bool = False) -> str:
+    """``MPI_Lookup_name``: resolve a published service name to a port."""
+    import ompi_tpu
+
+    client = _client(comm or ompi_tpu.COMM_WORLD)
+    port = client.get(-1, f"__dpm_svc_{service}__", wait=wait)
+    if port is None:
+        raise MpiError(ErrorClass.ERR_NAME,
+                       f"service {service!r} not published")
+    return port
+
+
+def unpublish_name(service: str, comm=None) -> None:
+    """``MPI_Unpublish_name``."""
+    import ompi_tpu
+
+    client = _client(comm or ompi_tpu.COMM_WORLD)
+    client.delete(-1, f"__dpm_svc_{service}__")
+
+
 def accept(comm, port: str, root: int = 0) -> Comm:
     """Collective: publish our group under ``port`` and wait for a
     connector; returns the intercommunicator."""

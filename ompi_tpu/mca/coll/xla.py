@@ -442,21 +442,19 @@ class XlaCollModule:
 
     def _keyfor(self, coll: str, x, *args):
         """Single source of truth for program-cache keys (used by the
-        *_array methods and persistent_coll alike)."""
-        def op_of(i=0):
-            return (args[i] if len(args) > i else op_mod.SUM).name
-
-        def root_of(i=0):
-            return args[i] if len(args) > i else 0
-
+        *_array methods and persistent_coll alike).  Kept closure-free:
+        this runs on every collective call."""
         if coll == "allreduce":
             return _ar_key(x, args[0] if args else op_mod.SUM)
         if coll == "reduce":
-            return (coll, op_of(0), root_of(1), x.shape, x.dtype)
+            op = args[0] if args else op_mod.SUM
+            root = args[1] if len(args) > 1 else 0
+            return (coll, op.name, root, x.shape, x.dtype)
         if coll in ("bcast", "gather", "scatter"):
-            return (coll, root_of(), x.shape, x.dtype)
+            return (coll, args[0] if args else 0, x.shape, x.dtype)
         if coll in ("reduce_scatter", "scan", "exscan"):
-            return (coll, op_of(), x.shape, x.dtype)
+            return (coll, (args[0] if args else op_mod.SUM).name,
+                    x.shape, x.dtype)
         if coll in ("allgather", "alltoall"):
             return (coll, x.shape, x.dtype)
         if coll == "ppermute":

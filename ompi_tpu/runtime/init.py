@@ -118,6 +118,11 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
 
         spc.init()
 
+        # record the initializing thread (MPI_Is_thread_main anchor)
+        from ompi_tpu.runtime import interlib
+
+        interlib.note_main_thread()
+
         # CPU binding + topology modex (hwloc analog; the reference does
         # binding in PRRTE pre-exec, we do it first thing in init)
         import os as _os
@@ -224,8 +229,26 @@ def COMM_SELF():  # pragma: no cover - thin alias
     return comm_self()
 
 
+def init_thread(required: int = 0, devices=None, rte=None, argv=None):
+    """``MPI_Init_thread``: returns (world, provided).
+
+    The engine is thread-safe throughout, so provided is always
+    THREAD_MULTIPLE whatever level was required."""
+    from ompi_tpu.runtime import interlib
+
+    world = init(devices=devices, rte=rte, argv=argv)
+    return world, interlib.query_thread()
+
+
 def finalize() -> None:
     global _state, _world, _self, _rte
+    from ompi_tpu.runtime import interlib
+
+    if interlib.registrations() > 0:
+        # an interlib-registered library still needs the runtime
+        # (ompi_mpi_finalize's interlib guard); the last deregister's
+        # caller finalizes
+        return
     with _lock:
         if _state is not State.INIT_COMPLETED:
             return
@@ -268,6 +291,9 @@ def _atexit_finalize() -> None:
 def reset_for_testing() -> None:
     """Full teardown allowing re-init (tests only)."""
     global _state
+    from ompi_tpu.runtime import interlib
+
+    interlib.reset_for_testing()
     finalize()
     from ompi_tpu.ft import state as _ft_state
 

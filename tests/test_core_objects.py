@@ -193,3 +193,39 @@ def test_generalized_request():
     r.grequest_complete()
     st = r.wait()
     assert st.get_count(FLOAT32) == 3
+
+
+class TestThreadAndInterlib:
+    """MPI_Init_thread / Query_thread / Is_thread_main + the interlib
+    refcount guard (``ompi/interlib/interlib.c``)."""
+
+    def test_init_thread_provided(self):
+        import ompi_tpu
+        from ompi_tpu.runtime import init as rt
+
+        rt.reset_for_testing()
+        try:
+            w, provided = ompi_tpu.init_thread(ompi_tpu.THREAD_MULTIPLE)
+            assert provided == ompi_tpu.THREAD_MULTIPLE
+            assert w.size >= 1
+            assert ompi_tpu.query_thread() == ompi_tpu.THREAD_MULTIPLE
+            assert ompi_tpu.is_thread_main()
+        finally:
+            rt.reset_for_testing()
+
+    def test_interlib_blocks_finalize(self):
+        import ompi_tpu
+        from ompi_tpu.runtime import init as rt
+        from ompi_tpu.runtime import interlib
+
+        rt.reset_for_testing()
+        try:
+            ompi_tpu.init()
+            interlib.register(interlib.THREAD_SERIALIZED)
+            ompi_tpu.finalize()
+            assert ompi_tpu.initialized()      # library still registered
+            assert interlib.deregister() == 0
+            ompi_tpu.finalize()
+            assert ompi_tpu.finalized()
+        finally:
+            rt.reset_for_testing()

@@ -168,3 +168,38 @@ def test_recovery_shrink_spawn_merge(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("recovered OK") == 2
     assert "replacement joined OK" in r.stdout
+
+
+def test_publish_lookup_name(tmp_path):
+    """MPI_Publish_name / Lookup_name / Unpublish_name: connect via a
+    SERVICE name instead of a pre-shared port string
+    (``ompi/mpi/c/publish_name.c``)."""
+    script = tmp_path / "pub.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        from ompi_tpu import dpm
+        from ompi_tpu.api.errors import MpiError
+        w = ompi_tpu.init()
+        side = w.split(0 if w.rank < 2 else 1)
+        if w.rank < 2:
+            port = dpm.open_port(w)
+            if side.rank == 0:
+                dpm.publish_name("calc-svc", port, w)
+            inter = side.accept(port)
+            if side.rank == 0:
+                dpm.unpublish_name("calc-svc", w)
+                try:
+                    dpm.lookup_name("calc-svc", w)
+                    raise AssertionError("lookup after unpublish")
+                except MpiError:
+                    pass
+        else:
+            port = dpm.lookup_name("calc-svc", w, wait=True)
+            inter = side.connect(port)
+        assert inter.is_inter and inter.remote_size == 2
+        w.barrier()
+        print(f"pub OK rank {w.rank}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("pub OK") == 4
