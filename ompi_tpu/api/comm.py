@@ -231,6 +231,12 @@ class Comm(AttributeHost):
         self._check_state()
         return self._coll("alltoallv")(self, sendbufs)
 
+    def alltoallw(self, sendbufs, recvtypes=None):
+        """``MPI_Alltoallw``: per-peer buffers and per-peer datatypes
+        (recvtypes: numpy dtype per source rank)."""
+        self._check_state()
+        return self._coll("alltoallw")(self, sendbufs, recvtypes)
+
     def reduce_scatter(self, sendbuf, recvcounts=None,
                        op: op_mod.Op = op_mod.SUM):
         self._check_state()

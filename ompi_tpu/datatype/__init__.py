@@ -57,3 +57,29 @@ from ompi_tpu.datatype.core import (  # noqa: F401
     DISTRIBUTE_DFLT_DARG,
 )
 from ompi_tpu.datatype.convertor import Convertor, ConvertorFlags  # noqa: F401
+
+
+def pack(buf, count, datatype, external32: bool = False) -> bytes:
+    """``MPI_Pack`` (/ ``MPI_Pack_external``): described memory → a
+    contiguous byte stream, via the convertor (``ompi/mpi/c/pack.c``)."""
+    flags = ConvertorFlags.EXTERNAL32 if external32 else ConvertorFlags.NONE
+    return Convertor(datatype, count, buf, flags=flags).pack()
+
+
+def unpack(data, buf, count, datatype, external32: bool = False) -> int:
+    """``MPI_Unpack``: byte stream → described memory; returns the bytes
+    consumed."""
+    flags = ConvertorFlags.EXTERNAL32 if external32 else ConvertorFlags.NONE
+    return Convertor(datatype, count, buf, flags=flags).unpack(data)
+
+
+def pack_size(count, datatype, external32: bool = False) -> int:
+    """``MPI_Pack_size``: an upper bound on pack()'s output size."""
+    return count * datatype.size
+
+
+def reduce_local(inbuf, inoutbuf, op) -> None:
+    """``MPI_Reduce_local``: inoutbuf = inbuf (op) inoutbuf — the op
+    kernel applied locally (``ompi/mpi/c/reduce_local.c``; kernel table
+    ≅ ``ompi/mca/op``)."""
+    op(inbuf, inoutbuf)

@@ -173,6 +173,24 @@ class BasicCollModule:
         waitall(reqs)
         return out
 
+    def alltoallw(self, comm, sendbufs, recvtypes=None):
+        """``MPI_Alltoallw``: per-peer buffers AND per-peer datatypes.
+
+        ``sendbufs[i]`` (any dtype/shape each) goes to rank i;
+        ``recvtypes[i]`` (numpy dtypes) types the block received from
+        rank i (default uint8, the wire type).  The v-variant's
+        byte-stream exchange already carries arbitrary layouts — the w
+        semantics are the per-peer reinterpretation on both ends
+        (``ompi/mpi/c/alltoallw.c``)."""
+        raw = self.alltoallv(comm, sendbufs)
+        if recvtypes is None:
+            return raw
+        out = []
+        for i, b in enumerate(raw):
+            arr = np.ascontiguousarray(b).reshape(-1).view(np.uint8)
+            out.append(arr.view(np.dtype(recvtypes[i])))
+        return out
+
     def reduce(self, comm, sendbuf, op: op_mod.Op = op_mod.SUM, root=0):
         g = self.gather(comm, sendbuf, root)
         if comm.rank != root:

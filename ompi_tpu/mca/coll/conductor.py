@@ -119,6 +119,22 @@ class ConductorModule:
         return [[np.asarray(sendbufs[j][i]) for j in range(n)]
                 for i in range(n)]
 
+    def alltoallw(self, comm, sendbufs, recvtypes=None):
+        """Matrix form like alltoallv; ``recvtypes[i]`` retypes rank i's
+        received blocks (single dtype or one per source)."""
+        out = self.alltoallv(comm, sendbufs)
+        if recvtypes is None:
+            return out
+        typed = []
+        for i, row in enumerate(out):
+            rt = recvtypes[i]
+            per_src = list(rt) if isinstance(rt, (list, tuple)) \
+                else [rt] * comm.size
+            typed.append([
+                np.ascontiguousarray(b).reshape(-1).view(np.uint8)
+                .view(np.dtype(per_src[j])) for j, b in enumerate(row)])
+        return typed
+
     def reduce_scatter(self, comm, sendbuf, recvcounts, op):
         if self._is_device(sendbuf):
             return comm.c_coll["reduce_scatter_array"](comm, sendbuf, op)

@@ -118,10 +118,11 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
 
         spc.init()
 
-        # record the initializing thread (MPI_Is_thread_main anchor)
+        # record the initializing thread (MPI_Is_thread_main anchor —
+        # overrides any earlier library register() from a worker thread)
         from ompi_tpu.runtime import interlib
 
-        interlib.note_main_thread()
+        interlib.note_main_thread(force=True)
 
         # CPU binding + topology modex (hwloc analog; the reference does
         # binding in PRRTE pre-exec, we do it first thing in init)
@@ -244,13 +245,15 @@ def finalize() -> None:
     global _state, _world, _self, _rte
     from ompi_tpu.runtime import interlib
 
-    if interlib.registrations() > 0:
-        # an interlib-registered library still needs the runtime
-        # (ompi_mpi_finalize's interlib guard); the last deregister's
-        # caller finalizes
-        return
     with _lock:
         if _state is not State.INIT_COMPLETED:
+            return
+        # interlib guard INSIDE the init lock: a register() racing this
+        # finalize either lands before the check (runtime stays up; the
+        # last deregister's caller finalizes) or after teardown began —
+        # register while concurrently finalizing is the one ordering MPI
+        # itself leaves undefined (ompi_mpi_finalize's interlib guard)
+        if interlib.registrations() > 0:
             return
         _state = State.FINALIZE_STARTED
         try:

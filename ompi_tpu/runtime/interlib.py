@@ -26,12 +26,14 @@ _registrations = 0
 _main_thread = None
 
 
-def note_main_thread() -> None:
+def note_main_thread(force: bool = False) -> None:
     """Record the thread performing MPI init (``MPI_Is_thread_main``'s
-    reference point); first caller wins."""
+    reference point).  ``force`` is used by init itself: MPI defines the
+    main thread as the one that called init, so init's anchor overrides
+    any earlier register() from a library worker thread."""
     global _main_thread
     with _lock:
-        if _main_thread is None:
+        if force or _main_thread is None:
             _main_thread = threading.current_thread()
 
 

@@ -250,3 +250,19 @@ def test_spc_device_counters_bump(world, xla):
     host, dev = _world_data(xla, seed=16)
     world.allreduce_array(dev)
     assert spc.read("device_collectives") >= before + 1
+
+
+def test_alltoallw_per_peer_dtypes(world):
+    """MPI_Alltoallw: per-peer buffers and datatypes
+    (``ompi/mpi/c/alltoallw.c``) — conductor matrix form."""
+    n = world.size
+    # sendbufs[src][dst]: int32 to even receivers, float64 to odd
+    sendbufs = [[np.array([s], np.int32) if d % 2 == 0
+                 else np.array([s + 0.5], np.float64) for d in range(n)]
+                for s in range(n)]
+    recvtypes = [np.int32 if r % 2 == 0 else np.float64 for r in range(n)]
+    out = world.alltoallw(sendbufs, recvtypes)
+    for r in range(n):
+        for s in range(n):
+            got = out[r][s][0]
+            assert got == (s if r % 2 == 0 else s + 0.5), (r, s, got)

@@ -257,3 +257,28 @@ def test_device_flag_rejects_host_prepare():
     with pytest.raises(RuntimeError):
         Convertor(FLOAT32, 4, np.zeros(4, np.float32),
                   flags=ConvertorFlags.DEVICE)
+
+
+def test_pack_unpack_api():
+    """MPI_Pack / Unpack / Pack_size / Reduce_local (``ompi/mpi/c/pack.c``,
+    ``reduce_local.c``)."""
+    from ompi_tpu.api import op as op_mod
+    from ompi_tpu.datatype import (FLOAT32, FLOAT64, pack, pack_size,
+                                   reduce_local, unpack, vector)
+
+    dt = vector(3, 2, 4, FLOAT64)   # 3 blocks of 2, stride 4
+    src = np.arange(12.0)
+    data = pack(src, 1, dt)
+    assert len(data) == 6 * 8
+    assert pack_size(1, dt) >= len(data)
+    dst = np.zeros(12)
+    assert unpack(data, dst, 1, dt) == 48
+    assert dst[4] == 4.0 and dst[2] == 0.0   # gaps untouched
+
+    # external32: canonical big-endian stream
+    d32 = pack(np.arange(4, dtype=np.float32), 4, FLOAT32, external32=True)
+    assert np.frombuffer(d32, ">f4").tolist() == [0.0, 1.0, 2.0, 3.0]
+
+    a, b = np.arange(4.0), np.ones(4)
+    reduce_local(a, b, op_mod.MAX)
+    assert b.tolist() == [1.0, 1.0, 2.0, 3.0]
