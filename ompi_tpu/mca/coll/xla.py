@@ -234,7 +234,7 @@ class XlaCollModule:
         set of devices holding root's data.
         """
         if isinstance(x, self._jax_array):
-            fn = self._fast(("bcast", root, x.shape, x.dtype))
+            fn = self._fast(self._keyfor("bcast", x, root))
             if fn is not None:
                 return fn(x)
         import jax
@@ -264,7 +264,7 @@ class XlaCollModule:
 
     def allgather_array(self, comm, x):
         if isinstance(x, self._jax_array):
-            fn = self._fast(("allgather", x.shape, x.dtype))
+            fn = self._fast(self._keyfor("allgather", x))
             if fn is not None:
                 return fn(x)
         import jax
@@ -316,7 +316,7 @@ class XlaCollModule:
         Result: global (n, *S) sharded over the rank axis.
         """
         if isinstance(x, self._jax_array):
-            fn = self._fast(("reduce_scatter", op.name, x.shape, x.dtype))
+            fn = self._fast(self._keyfor("reduce_scatter", x, op))
             if fn is not None:
                 return fn(x)
         import jax
@@ -346,7 +346,7 @@ class XlaCollModule:
     def alltoall_array(self, comm, x):
         """x[i, j] moves to result[j, i] (rank j receives x[:, j])."""
         if isinstance(x, self._jax_array):
-            fn = self._fast(("alltoall", x.shape, x.dtype))
+            fn = self._fast(self._keyfor("alltoall", x))
             if fn is not None:
                 return fn(x)
         import jax
