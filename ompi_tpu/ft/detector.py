@@ -1,13 +1,18 @@
-"""Heartbeat failure detector — ring of observers.
+"""Heartbeat failure detector — ring of observers, peer-to-peer carrier.
 
 Re-design of ``/root/reference/ompi/communicator/ft/comm_ft_detector.c``:
 each process emits a periodic heartbeat to one observer arranged in a ring
 (``:29-33``), period η / timeout τ tunables (``:88-89``, defaults 3s/10s).
-TPU-native carrier: instead of RDMA-put heartbeats over the BTL, heartbeats
-are sequence-numbered puts into the coordination-service KV space (the
-job's reliable out-of-band channel); the observer polls its emitter's
-counter and, on a stall past the timeout, reports the failure to the
-propagator.  On emitter death the observer rotates to the next live
+
+Carrier: PRIMARY is peer-to-peer — sequence-numbered CTL fragments pushed
+directly over the btl to the observer (the reference's active-message
+heartbeats, ``comm_ft_detector.c:35,82-84``), so detection keeps working
+if the coordination service dies (it is NOT in the failure path).  The
+coord KV carries a secondary copy for bootstrap (before transports are
+up), for observers that rotate onto an emitter whose p2p frags they never
+received, and for the clean-departure tombstone.  The observer checks the
+p2p table first and falls back to the KV counter; on a stall past the
+timeout it reports to the propagator and rotates to the next live
 predecessor, exactly as the reference rotates observers.
 """
 from __future__ import annotations
@@ -51,17 +56,32 @@ class Detector:
         self._stop = threading.Event()
         self._seq = 0
         self._departed: set[int] = set()
+        # p2p heartbeat state: world rank -> (seq, local monotonic time),
+        # written by the CTL handler (btl receive path), read by _run
+        self._p2p_lock = threading.Lock()
+        self._p2p_seen: dict[int, tuple[int, float]] = {}
+        self._p2p_final: set[int] = set()
+        self._bml = None
         self._thread = threading.Thread(
             target=self._run, name="otpu-ft-detector", daemon=True)
 
     def start(self) -> None:
+        from ompi_tpu.mca.pml import ob1
+
+        ob1.register_ctl_handler("ft_hb", self._on_hb)
         self._thread.start()
 
     def stop(self) -> None:
         """Clean shutdown: leave a tombstone so observers see a finalized
         rank as a clean departure, not a failure (ULFM distinguishes
-        finalized from failed processes)."""
+        finalized from failed processes).  The tombstone goes both p2p
+        (fast path for the live observer) and to the KV (for observers
+        that rotate here later)."""
         self._stop.set()
+        try:
+            self._send_p2p({"proto": "ft_hb", "final": True})
+        except Exception:
+            pass
         try:
             self.client.put(self.rte.my_world_rank, "hb_final", True)
         except Exception:
@@ -70,6 +90,58 @@ class Detector:
             self.client.close()
         except Exception:
             pass
+
+    # -- p2p carrier -----------------------------------------------------
+    def _get_bml(self):
+        """The world pml's bml, resolved lazily (transports come up after
+        the detector can already be running)."""
+        if self._bml is None:
+            from ompi_tpu.runtime import init as rt
+
+            world = rt.get_world_if_initialized()
+            pml = getattr(world, "pml", None) if world is not None else None
+            while pml is not None and not hasattr(pml, "bml"):
+                pml = getattr(pml, "_inner", None)
+            self._bml = getattr(pml, "bml", None) if pml is not None else None
+        return self._bml
+
+    def _observer_of_me(self) -> int:
+        """The rank observing me: nearest live, non-departed successor."""
+        n = self.rte.world_size
+        me = self.rte.my_world_rank
+        for d in range(1, n):
+            r = (me + d) % n
+            if not ft_state.is_failed(r) and r not in self._departed:
+                return r
+        return me
+
+    def _send_p2p(self, meta: dict) -> bool:
+        from ompi_tpu.mca.btl.base import CTL, Frag
+
+        bml = self._get_bml()
+        if bml is None:
+            return False
+        target = self._observer_of_me()
+        me = self.rte.my_world_rank
+        if target == me:
+            return True
+        try:
+            ep = bml.endpoint(target)
+            if ep is None:
+                return False
+            ep.btl.send(ep, Frag(0, me, target, -1, 0, CTL, meta=meta))
+            return True
+        except Exception:
+            return False
+
+    def _on_hb(self, frag) -> None:
+        """CTL receive path (runs on whatever thread drives progress)."""
+        now = time.monotonic()
+        with self._p2p_lock:
+            if frag.meta.get("final"):
+                self._p2p_final.add(frag.src)
+            else:
+                self._p2p_seen[frag.src] = (frag.meta.get("seq", 0), now)
 
     # -- internals -------------------------------------------------------
     def _emitter_of(self) -> int:
@@ -84,45 +156,73 @@ class Detector:
 
     def _run(self) -> None:
         me = self.rte.my_world_rank
-        last_seq: dict[int, tuple[int, float]] = {}
+        # target -> (change marker, last-activity time, ever-seen flag)
+        last: dict[int, tuple] = {}
+        coord_up = True
         while not self._stop.is_set():
             now = time.monotonic()
-            # emit my heartbeat
+            # emit my heartbeat on both carriers
             self._seq += 1
-            try:
-                self.client.put(me, "hb", self._seq)
-            except Exception:
-                return  # coordination service gone: job is ending
+            self._send_p2p({"proto": "ft_hb", "seq": self._seq})
+            if coord_up:
+                try:
+                    self.client.put(me, "hb", self._seq)
+                except Exception:
+                    # coordination service gone: NOT fatal for detection —
+                    # the p2p carrier keeps the ring alive (the reference's
+                    # detector never depended on the runtime daemon)
+                    coord_up = False
+            # even with both carriers momentarily down (e.g. coord died
+            # before the first p2p send resolved endpoints), keep the
+            # ring alive: endpoints are warmed at init and may come back
+            # next tick; stop() is the only clean exit
             # observe my current emitter
             target = self._emitter_of()
             if target != me:
-                try:
-                    seen = self.client.get(target, "hb", wait=False)
-                except Exception:
-                    return
-                prev = last_seq.get(target)
-                # a never-seen emitter (hb key not yet written, or a newly
-                # rotated-to target) gets timeout + startup grace before
-                # being declared: its detector thread may just be late
-                limit = (self.timeout if prev is None or prev[0] is not None
-                         else self.timeout + self.startup_grace)
-                if prev is None or (seen is not None and seen != prev[0]):
-                    last_seq[target] = (seen, now)
-                elif now - prev[1] > limit:
+                with self._p2p_lock:
+                    p2p = self._p2p_seen.get(target)
+                    p2p_final = target in self._p2p_final
+                kv_seen = None
+                if coord_up:
                     try:
-                        finalized = self.client.get(target, "hb_final",
-                                                    wait=False)
+                        kv_seen = self.client.get(target, "hb", wait=False)
                     except Exception:
-                        return
-                    if finalized:
-                        # clean departure (finalize tombstone): rotate past
-                        # it without declaring a failure
-                        self._departed.add(target)
-                    else:
-                        from ompi_tpu.ft import propagator
+                        coord_up = False
+                if p2p_final:
+                    self._departed.add(target)
+                    last.pop(target, None)
+                    self._stop.wait(self.period)
+                    continue
+                marker = (kv_seen, p2p[0] if p2p else None)
+                ever = kv_seen is not None or p2p is not None
+                prev = last.get(target)
+                if prev is None or marker != prev[0]:
+                    last[target] = (marker, now, ever or
+                                    (prev[2] if prev else False))
+                else:
+                    # a never-seen emitter (no heartbeat on either carrier
+                    # yet, or a newly rotated-to target) gets timeout +
+                    # startup grace: its detector may just be late
+                    limit = (self.timeout if prev[2]
+                             else self.timeout + self.startup_grace)
+                    last_act = max(prev[1], p2p[1] if p2p else 0.0)
+                    if now - last_act > limit:
+                        finalized = False
+                        if coord_up:
+                            try:
+                                finalized = bool(self.client.get(
+                                    target, "hb_final", wait=False))
+                            except Exception:
+                                coord_up = False
+                        if finalized:
+                            # clean departure tombstone: rotate past it
+                            # without declaring a failure
+                            self._departed.add(target)
+                        else:
+                            from ompi_tpu.ft import propagator
 
-                        propagator.report_failure(self.rte, target,
-                                                  origin="heartbeat",
-                                                  client=self.client)
-                    last_seq.pop(target, None)
+                            propagator.report_failure(
+                                self.rte, target, origin="heartbeat",
+                                client=self.client if coord_up else None)
+                        last.pop(target, None)
             self._stop.wait(self.period)

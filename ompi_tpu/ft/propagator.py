@@ -2,13 +2,20 @@
 
 Re-design of ``/root/reference/ompi/communicator/ft/comm_ft_propagator.c``
 (+ ``comm_ft_reliable_bcast.c``): a detected failure is broadcast reliably
-to every survivor.  The reference builds a resilient binomial-graph overlay
-for the broadcast; TPU-native, the coordination service's event bus (the
-PMIx-event equivalent that ULFM also rides, ``ompi_mpi_init.c:400-402``)
-is the reliable carrier: the reporter publishes one ``proc_failed`` event,
-and every process's poller thread delivers it into the local failure state
-(``ompi_tpu.ft.state``).  Communicator revocation (``comm_ft_revoke.c``)
-rides the same bus as ``comm_revoked`` events.
+to every survivor over TWO carriers:
+
+- the coordination service's event bus (the PMIx-event equivalent that
+  ULFM also rides, ``ompi_mpi_init.c:400-402``) — every process's poller
+  thread delivers events into the local failure state; and
+- a peer-to-peer epidemic flood of CTL fragments over the btl (the
+  reference's resilient-overlay broadcast, degenerate full-flood form):
+  first receipt marks the failure locally and re-floods, so knowledge
+  spreads even with the coordination service dead — which also keeps the
+  heartbeat ring consistent (emitters reroute around ranks everyone has
+  learned are dead).
+
+Communicator revocation (``comm_ft_revoke.c``) rides the event bus as
+``comm_revoked`` events.
 """
 from __future__ import annotations
 
@@ -43,7 +50,49 @@ def report_failure(rte, world_rank: int, origin: str = "unknown",
             rte.event_notify("proc_failed",
                              {"rank": world_rank, "origin": origin})
     except Exception:
-        pass  # coordination service gone: job teardown in progress
+        pass  # coordination service gone: the p2p flood still carries it
+    _flood_failure(rte, world_rank, origin)
+
+
+def _flood_failure(rte, world_rank: int, origin: str) -> None:
+    """P2p reliable-broadcast leg: push the failure to every live peer as
+    a CTL fragment (``comm_ft_reliable_bcast.c``'s role, full-flood)."""
+    from ompi_tpu.mca.bml import resolve_bml
+    from ompi_tpu.mca.btl.base import CTL, Frag
+    from ompi_tpu.runtime import init as rt
+
+    world = rt.get_world_if_initialized()
+    if world is None:
+        return
+    bml = resolve_bml(world.pml)
+    if bml is None:
+        return
+    me = rte.my_world_rank
+    meta = {"proto": "ft_prop", "failed": world_rank, "origin": origin}
+    for wr in world.group.world_ranks:
+        if wr == me or ft_state.is_failed(wr):
+            continue
+        try:
+            ep = bml.endpoint(wr)
+            if ep is not None:
+                ep.btl.send(ep, Frag(0, me, wr, -1, 0, CTL, meta=meta))
+        except Exception:
+            pass
+
+
+def _on_prop_frag(frag) -> None:
+    """First receipt applies + re-floods (epidemic; is_failed dedups)."""
+    rank = int(frag.meta["failed"])
+    if ft_state.is_failed(rank):
+        return
+    _output.output(_stream, 1, "rank %d failed (p2p flood from %d)",
+                   rank, frag.src)
+    ft_state.mark_failed(rank)
+    from ompi_tpu.runtime import init as rt
+
+    rte = rt.get_rte()
+    if rte is not None:
+        _flood_failure(rte, rank, frag.meta.get("origin", "p2p"))
 
 
 def report_revoke(rte, cid: int, epoch: int, job: str = "0") -> None:
@@ -114,6 +163,9 @@ def start(rte, with_detector: bool = False) -> None:
     """Start the FT runtime (event poller + optional heartbeat ring)."""
     global _poller, _detector
     if _poller is None:
+        from ompi_tpu.mca.pml import ob1
+
+        ob1.register_ctl_handler("ft_prop", _on_prop_frag)
         _poller = EventPoller(rte)
         _poller.start()
     if with_detector and _detector is None:

@@ -1,3 +1,14 @@
 """bml — BTL multiplexer (``/root/reference/ompi/mca/bml/`` r2): builds
 per-peer endpoint lists of usable BTLs ordered by latency/bandwidth."""
 from ompi_tpu.mca.bml.r2 import Bml  # noqa: F401
+
+
+def resolve_bml(pml):
+    """The bml behind a (possibly wrapped) pml module, or None.
+
+    Interposition wrappers (monitoring, vprotocol) chain via ``_inner``;
+    this is the one place that knows how to walk them."""
+    inner = pml
+    while inner is not None and not hasattr(inner, "bml"):
+        inner = getattr(inner, "_inner", None)
+    return getattr(inner, "bml", None) if inner is not None else None

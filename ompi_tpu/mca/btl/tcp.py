@@ -47,6 +47,7 @@ class TcpBtl(Btl):
         self._listener: Optional[socket.socket] = None
         self._sel = selectors.DefaultSelector()
         self._by_rank: dict[int, _Conn] = {}
+        self._addr_cache: dict[int, tuple] = {}
 
     def register_vars(self, fw) -> None:
         self.register_var(
@@ -82,6 +83,17 @@ class TcpBtl(Btl):
     def reachable(self, world_rank: int, rte) -> Optional[Endpoint]:
         if self._rte is None or world_rank == rte.my_world_rank:
             return None
+        # cache the peer's address NOW, while the modex is reachable: a
+        # lazy lookup at first-send time would make the transport depend
+        # on the coordination service staying alive (the FT detector's
+        # p2p carrier must work after the coord dies)
+        if world_rank not in self._addr_cache:
+            try:
+                addr = rte.modex_get(world_rank, "btl_tcp_addr", wait=False)
+                if addr is not None:
+                    self._addr_cache[world_rank] = tuple(addr)
+            except Exception:
+                pass
         return Endpoint(self, world_rank)
 
     # -- send path -------------------------------------------------------
@@ -89,7 +101,11 @@ class TcpBtl(Btl):
         conn = self._by_rank.get(rank)
         if conn is not None:
             return conn
-        addr = self._rte.modex_get(rank, "btl_tcp_addr")
+        addr = self._addr_cache.get(rank)
+        if addr is None:
+            addr = self._rte.modex_get(rank, "btl_tcp_addr")
+            if addr is not None:
+                self._addr_cache[rank] = tuple(addr)
         if addr is None:
             raise ConnectionError(f"no tcp address for rank {rank}")
         sock = socket.create_connection(tuple(addr), timeout=30)

@@ -65,6 +65,7 @@ class CoordServer:
         self._srv = socket.create_server((host, port))
         self.addr = self._srv.getsockname()
         self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
         self._accepting = True
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
@@ -77,11 +78,29 @@ class CoordServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            if not self._accepting:
+                # raced shutdown: a connection accepted while close() was
+                # iterating must not be left alive past it
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._conns.append(conn)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
+        try:
+            self._serve_loop(conn)
+        finally:
+            try:
+                self._conns.remove(conn)   # prune on disconnect
+            except ValueError:
+                pass
+
+    def _serve_loop(self, conn: socket.socket) -> None:
         try:
             while True:
                 req = _recv_frame(conn)
@@ -232,11 +251,25 @@ class CoordServer:
         return self._aborted
 
     def close(self) -> None:
+        """Full stop: the listener AND every live client connection.
+        (A close that leaves established connections serving would make
+        the service look alive to already-wired clients — the FT tests
+        kill the coord to prove detection doesn't depend on it.)"""
         self._accepting = False
         try:
             self._srv.close()
         except OSError:
             pass
+        for conn in list(self._conns):   # _serve threads prune concurrently
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()
 
 
 class CoordClient:

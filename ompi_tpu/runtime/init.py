@@ -46,6 +46,12 @@ def get_rte():
     return _rte
 
 
+def get_world_if_initialized():
+    """COMM_WORLD if init completed, else None (no implicit init) —
+    for background services (detector) that must not trigger init."""
+    return _world if _state is State.INIT_COMPLETED else None
+
+
 # -- CID space ----------------------------------------------------------
 
 def next_local_cid() -> int:
@@ -177,6 +183,23 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
         _self.pml = pml_module
         pml_module.add_comm(_world)
         pml_module.add_comm(_self)
+
+        # eager add_procs: build every peer's endpoint list NOW, while the
+        # modex is guaranteed reachable (the reference does this at
+        # ompi_mpi_init.c:833 — BML endpoint lists are an init product,
+        # not a first-send side effect; the FT detector's p2p carrier
+        # depends on endpoints surviving a later coord death)
+        inner = pml_module
+        while inner is not None and not hasattr(inner, "bml"):
+            inner = getattr(inner, "_inner", None)
+        bml = getattr(inner, "bml", None) if inner is not None else None
+        if bml is not None and not _rte.is_device_world:
+            for wr in _world.group.world_ranks:
+                if wr != _rte.my_world_rank:
+                    try:
+                        bml.add_proc(wr)
+                    except Exception:
+                        pass   # peer reachable lazily or not at all
 
         # per-comm coll selection (ompi_mpi_init.c:956,962)
         from ompi_tpu.mca.coll.base import comm_select
