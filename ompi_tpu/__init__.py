@@ -28,6 +28,13 @@ _API = {
     "THREAD_FUNNELED": "ompi_tpu.runtime.interlib",
     "THREAD_SERIALIZED": "ompi_tpu.runtime.interlib",
     "THREAD_MULTIPLE": "ompi_tpu.runtime.interlib",
+    "wtime": "ompi_tpu.api.env",
+    "wtick": "ompi_tpu.api.env",
+    "get_processor_name": "ompi_tpu.api.env",
+    "get_version": "ompi_tpu.api.env",
+    "get_library_version": "ompi_tpu.api.env",
+    "alloc_mem": "ompi_tpu.api.env",
+    "free_mem": "ompi_tpu.api.env",
     "COMM_WORLD": "ompi_tpu.runtime.init",
     "COMM_SELF": "ompi_tpu.runtime.init",
     "Comm": "ompi_tpu.api.comm",

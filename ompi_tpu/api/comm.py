@@ -520,6 +520,12 @@ class Comm(AttributeHost):
                 return agreed
             floor = agreed + 1
 
+    # comm_compare results (``mpi.h`` MPI_IDENT family)
+    IDENT = 0
+    CONGRUENT = 1
+    SIMILAR = 2
+    UNEQUAL = 3
+
     def dup(self) -> "Comm":
         self._check_state()
         newcomm = Comm(self.group, self._next_cid(), self.rte,
@@ -528,6 +534,28 @@ class Comm(AttributeHost):
         newcomm.info = self.info.dup()
         self._finish_create(newcomm)
         return newcomm
+
+    def idup(self) -> tuple["Comm", Request]:
+        """``MPI_Comm_idup``: the dup itself is collective-synchronous
+        here (CID agreement), so the request is born complete."""
+        newcomm = self.dup()
+        req = CompletedRequest()
+        req.result = newcomm
+        return newcomm, req
+
+    def compare(self, other: "Comm") -> int:
+        """``MPI_Comm_compare``: IDENT (same object), CONGRUENT (same
+        group + order, different context), SIMILAR (same members, other
+        order), UNEQUAL."""
+        if self is other:
+            return Comm.IDENT
+        mine = list(self.group.world_ranks)
+        theirs = list(other.group.world_ranks)
+        if mine == theirs:
+            return Comm.CONGRUENT
+        if sorted(mine) == sorted(theirs):
+            return Comm.SIMILAR
+        return Comm.UNEQUAL
 
     def split(self, color, key=0) -> Optional["Comm"]:
         """``MPI_Comm_split``.

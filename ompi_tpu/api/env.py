@@ -1,0 +1,50 @@
+"""Environment inquiry functions (``ompi/mpi/c/wtime.c``, ``get_version.c``,
+``get_processor_name.c``, ``alloc_mem.c`` family)."""
+from __future__ import annotations
+
+import socket
+import time
+
+import numpy as np
+
+VERSION = (4, 0)              # MPI standard level the API tracks
+
+
+def wtime() -> float:
+    """``MPI_Wtime``: monotonic wall clock in seconds."""
+    return time.perf_counter()
+
+
+def wtick() -> float:
+    """``MPI_Wtick``: the clock's resolution."""
+    info = time.get_clock_info("perf_counter")
+    return info.resolution
+
+
+def get_processor_name() -> str:
+    """``MPI_Get_processor_name``."""
+    return socket.gethostname()
+
+
+def get_version() -> tuple:
+    """``MPI_Get_version``: (version, subversion) of the MPI level."""
+    return VERSION
+
+
+def get_library_version() -> str:
+    """``MPI_Get_library_version``."""
+    import ompi_tpu
+
+    return f"ompi_tpu {ompi_tpu.__version__} (TPU-native, MPI-{VERSION[0]}" \
+           f".{VERSION[1]} API surface)"
+
+
+def alloc_mem(nbytes: int, info=None) -> np.ndarray:
+    """``MPI_Alloc_mem``: a byte buffer suitable for RMA/sends.  The
+    reference returns registered memory; XLA owns device allocation here,
+    so host-side this is an aligned numpy buffer."""
+    return np.zeros(int(nbytes), np.uint8)
+
+
+def free_mem(buf) -> None:
+    """``MPI_Free_mem`` (the GC owns it; exists for API parity)."""

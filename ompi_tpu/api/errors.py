@@ -81,5 +81,51 @@ class RevokedError(MpiError):
         super().__init__(ErrorClass.ERR_REVOKED, message)
 
 
-def error_string(error_class: ErrorClass) -> str:
+_user_classes: dict[int, str] = {}
+_user_codes: dict[int, tuple[int, str]] = {}
+_next_user = 100
+
+
+def add_error_class(msg: str = "") -> int:
+    """``MPI_Add_error_class``: allocate a user error class."""
+    global _next_user
+    cls = _next_user
+    _next_user += 1
+    _user_classes[cls] = msg or f"user error class {cls}"
+    return cls
+
+
+def add_error_code(error_class: int, msg: str = "") -> int:
+    """``MPI_Add_error_code``: a code within a (user) class."""
+    global _next_user
+    code = _next_user
+    _next_user += 1
+    _user_codes[code] = (error_class, msg or f"user error code {code}")
+    return code
+
+
+def add_error_string(code: int, string: str) -> None:
+    """``MPI_Add_error_string``."""
+    if code in _user_classes:
+        _user_classes[code] = string
+    elif code in _user_codes:
+        _user_codes[code] = (_user_codes[code][0], string)
+    else:
+        raise MpiError(ErrorClass.ERR_ARG, f"unknown error code {code}")
+
+
+def error_string(error_class) -> str:
+    code = int(error_class)
+    if code in _user_classes:
+        return _user_classes[code]
+    if code in _user_codes:
+        return _user_codes[code][1]
     return ErrorClass(error_class).name
+
+
+def error_class_of(code) -> int:
+    """``MPI_Error_class``: map a code back to its class."""
+    c = int(code)
+    if c in _user_codes:
+        return _user_codes[c][0]
+    return c

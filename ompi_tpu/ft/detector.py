@@ -79,7 +79,11 @@ class Detector:
         that rotate here later)."""
         self._stop.set()
         try:
-            self._send_p2p({"proto": "ft_hb", "final": True})
+            # flood the tombstone to EVERY live peer, not just my current
+            # observer: with the coord dead, my emitter must also learn I
+            # departed (or it keeps heartbeating a corpse and its observer
+            # later declares IT failed when rotation misaligns the ring)
+            self._broadcast_p2p({"proto": "ft_hb", "final": True})
         except Exception:
             pass
         try:
@@ -96,14 +100,18 @@ class Detector:
         """The world pml's bml, resolved lazily (transports come up after
         the detector can already be running)."""
         if self._bml is None:
+            from ompi_tpu.mca.bml import resolve_bml
             from ompi_tpu.runtime import init as rt
 
             world = rt.get_world_if_initialized()
-            pml = getattr(world, "pml", None) if world is not None else None
-            while pml is not None and not hasattr(pml, "bml"):
-                pml = getattr(pml, "_inner", None)
-            self._bml = getattr(pml, "bml", None) if pml is not None else None
+            if world is not None:
+                self._bml = resolve_bml(getattr(world, "pml", None))
         return self._bml
+
+    def _known_gone(self, r: int) -> bool:
+        with self._p2p_lock:
+            final = r in self._p2p_final
+        return ft_state.is_failed(r) or r in self._departed or final
 
     def _observer_of_me(self) -> int:
         """The rank observing me: nearest live, non-departed successor."""
@@ -111,7 +119,7 @@ class Detector:
         me = self.rte.my_world_rank
         for d in range(1, n):
             r = (me + d) % n
-            if not ft_state.is_failed(r) and r not in self._departed:
+            if not self._known_gone(r):
                 return r
         return me
 
@@ -133,6 +141,24 @@ class Detector:
             return True
         except Exception:
             return False
+
+    def _broadcast_p2p(self, meta: dict) -> None:
+        """Best-effort send to every live peer (tombstone flood)."""
+        from ompi_tpu.mca.btl.base import CTL, Frag
+
+        bml = self._get_bml()
+        if bml is None:
+            return
+        me = self.rte.my_world_rank
+        for r in range(self.rte.world_size):
+            if r == me or self._known_gone(r):
+                continue
+            try:
+                ep = bml.endpoint(r)
+                if ep is not None:
+                    ep.btl.send(ep, Frag(0, me, r, -1, 0, CTL, meta=meta))
+            except Exception:
+                pass
 
     def _on_hb(self, frag) -> None:
         """CTL receive path (runs on whatever thread drives progress)."""

@@ -15,6 +15,7 @@ import pickle
 import selectors
 import socket
 import struct
+import threading
 import time
 from typing import Optional
 
@@ -30,6 +31,11 @@ class _Conn:
         self.rank = rank
         self.inbuf = bytearray()
         self.outbuf = bytearray()
+        # serialises outbuf append+flush: app threads, the progress
+        # engine, and the FT detector all send on the same conn, and two
+        # concurrent sock.send calls over one outbuf would duplicate the
+        # leading bytes and desynchronise the peer's framing
+        self.send_lock = threading.Lock()
 
 
 class TcpBtl(Btl):
@@ -48,6 +54,8 @@ class TcpBtl(Btl):
         self._sel = selectors.DefaultSelector()
         self._by_rank: dict[int, _Conn] = {}
         self._addr_cache: dict[int, tuple] = {}
+        self._connect_lock = threading.Lock()
+        self._connect_backoff: dict[int, float] = {}   # rank -> retry-after
 
     def register_vars(self, fw) -> None:
         self.register_var(
@@ -101,31 +109,52 @@ class TcpBtl(Btl):
         conn = self._by_rank.get(rank)
         if conn is not None:
             return conn
-        addr = self._addr_cache.get(rank)
-        if addr is None:
-            addr = self._rte.modex_get(rank, "btl_tcp_addr")
-            if addr is not None:
-                self._addr_cache[rank] = tuple(addr)
-        if addr is None:
-            raise ConnectionError(f"no tcp address for rank {rank}")
-        sock = socket.create_connection(tuple(addr), timeout=30)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn = _Conn(sock, rank)
-        # handshake: tell the peer who we are
-        hello = pickle.dumps({"rank": self._rte.my_world_rank})
-        sock.sendall(_LEN.pack(len(hello)) + hello)
-        sock.setblocking(False)
-        self._sel.register(sock, selectors.EVENT_READ, conn)
-        self._by_rank[rank] = conn
-        return conn
+        with self._connect_lock:   # one connection per peer, ever
+            conn = self._by_rank.get(rank)
+            if conn is not None:
+                return conn
+            # failed-connect backoff: a dead host blackholes SYNs, and a
+            # blocking retry per FT flood/heartbeat tick would stall the
+            # progress thread for the full connect timeout each time
+            until = self._connect_backoff.get(rank, 0.0)
+            if time.monotonic() < until:
+                raise ConnectionError(
+                    f"rank {rank} connect in backoff until {until:.1f}")
+            addr = self._addr_cache.get(rank)
+            if addr is None:
+                addr = self._rte.modex_get(rank, "btl_tcp_addr")
+                if addr is not None:
+                    self._addr_cache[rank] = tuple(addr)
+            if addr is None:
+                raise ConnectionError(f"no tcp address for rank {rank}")
+            try:
+                sock = socket.create_connection(tuple(addr), timeout=5)
+            except OSError:
+                self._connect_backoff[rank] = time.monotonic() + 10.0
+                raise
+            self._connect_backoff.pop(rank, None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(sock, rank)
+            # handshake: tell the peer who we are
+            hello = pickle.dumps({"rank": self._rte.my_world_rank})
+            sock.sendall(_LEN.pack(len(hello)) + hello)
+            sock.setblocking(False)
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+            self._by_rank[rank] = conn
+            return conn
 
     def send(self, ep: Endpoint, frag: Frag) -> None:
         conn = self._connect(ep.world_rank)
         payload = pickle.dumps(frag)
-        conn.outbuf += _LEN.pack(len(payload)) + payload
-        self._flush(conn)
+        with conn.send_lock:
+            conn.outbuf += _LEN.pack(len(payload)) + payload
+            self._flush_locked(conn)
 
     def _flush(self, conn: _Conn) -> None:
+        with conn.send_lock:
+            self._flush_locked(conn)
+
+    def _flush_locked(self, conn: _Conn) -> None:
         while conn.outbuf:
             try:
                 n = conn.sock.send(conn.outbuf)

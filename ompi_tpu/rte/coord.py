@@ -66,6 +66,7 @@ class CoordServer:
         self.addr = self._srv.getsockname()
         self._threads: list[threading.Thread] = []
         self._conns: list[socket.socket] = []
+        self._conns_lock = threading.Lock()
         self._accepting = True
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
@@ -78,15 +79,16 @@ class CoordServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
-            if not self._accepting:
-                # raced shutdown: a connection accepted while close() was
-                # iterating must not be left alive past it
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                return
-            self._conns.append(conn)
+            with self._conns_lock:
+                if not self._accepting:
+                    # raced shutdown: a connection accepted while close()
+                    # ran must not be left alive past it
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                self._conns.append(conn)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -95,10 +97,11 @@ class CoordServer:
         try:
             self._serve_loop(conn)
         finally:
-            try:
-                self._conns.remove(conn)   # prune on disconnect
-            except ValueError:
-                pass
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)   # prune on disconnect
+                except ValueError:
+                    pass
 
     def _serve_loop(self, conn: socket.socket) -> None:
         try:
@@ -255,12 +258,15 @@ class CoordServer:
         (A close that leaves established connections serving would make
         the service look alive to already-wired clients — the FT tests
         kill the coord to prove detection doesn't depend on it.)"""
-        self._accepting = False
+        with self._conns_lock:
+            self._accepting = False       # no new conns past this point
+            conns = list(self._conns)
+            self._conns.clear()
         try:
             self._srv.close()
         except OSError:
             pass
-        for conn in list(self._conns):   # _serve threads prune concurrently
+        for conn in conns:
             try:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -269,7 +275,6 @@ class CoordServer:
                 conn.close()
             except OSError:
                 pass
-        self._conns.clear()
 
 
 class CoordClient:

@@ -229,3 +229,56 @@ class TestThreadAndInterlib:
             assert ompi_tpu.finalized()
         finally:
             rt.reset_for_testing()
+
+
+class TestEnvironmentInquiry:
+    """MPI environment functions (wtime/version/processor-name/error
+    classes) + comm compare/idup (``ompi/mpi/c/*.c`` small families)."""
+
+    def test_wtime_and_friends(self):
+        from ompi_tpu.api import env
+
+        t0 = env.wtime()
+        assert env.wtime() >= t0
+        assert 0 < env.wtick() < 1
+        assert env.get_processor_name()
+        assert env.get_version() == (4, 0)
+        assert "ompi_tpu" in env.get_library_version()
+        buf = env.alloc_mem(128)
+        assert buf.nbytes == 128
+        env.free_mem(buf)
+
+    def test_user_error_classes(self):
+        from ompi_tpu.api import errors
+
+        cls = errors.add_error_class()
+        code = errors.add_error_code(cls, "my failure mode")
+        errors.add_error_string(cls, "my class")
+        assert errors.error_string(cls) == "my class"
+        assert errors.error_string(code) == "my failure mode"
+        assert errors.error_class_of(code) == cls
+        assert errors.error_string(errors.ErrorClass.ERR_TRUNCATE) \
+            == "ERR_TRUNCATE"
+
+    def test_comm_compare_and_idup(self):
+        import ompi_tpu
+        from ompi_tpu.runtime import init as rt
+
+        rt.reset_for_testing()
+        try:
+            w = ompi_tpu.init()
+            assert w.compare(w) == w.IDENT
+            d = w.dup()
+            assert w.compare(d) == w.CONGRUENT
+            if w.size > 1:
+                sub = w.create_group(
+                    ompi_tpu.Group(list(w.group.world_ranks[:1])))
+                if sub is not None:
+                    assert w.compare(sub) == w.UNEQUAL
+            c2, req = w.idup()
+            req.wait()
+            assert w.compare(c2) == w.CONGRUENT
+            c2.free()
+            d.free()
+        finally:
+            rt.reset_for_testing()
