@@ -134,6 +134,11 @@ class DeviceBench:
             fw_s.append(t1 - t0)
             raw_s.append(t2 - t1)
         fw_t, raw_t = statistics.median(fw_s), statistics.median(raw_s)
+        # ratio from per-iteration PAIRS: fw and raw run back-to-back, so
+        # tunnel latency drift hits both sides of a pair equally and the
+        # median pairwise ratio is far more stable run-to-run than the
+        # ratio of independent medians
+        pair_ratio = statistics.median(r / f_ for f_, r in zip(fw_s, raw_s))
         f = _bus_factor(coll, self.ndev)
         return {
             "coll": coll, "nbytes": nbytes,
@@ -141,7 +146,7 @@ class DeviceBench:
             "raw_lat_us": round(raw_t * 1e6, 2),
             "fw_bw_gbs": round(f * nbytes / fw_t / 1e9, 3),
             "raw_bw_gbs": round(f * nbytes / raw_t / 1e9, 3),
-            "ratio": round(raw_t / fw_t, 4),
+            "ratio": round(pair_ratio, 4),
         }
 
     def persistent_point(self, nbytes: int) -> dict:

@@ -545,12 +545,23 @@ class Comm(AttributeHost):
 
     def compare(self, other: "Comm") -> int:
         """``MPI_Comm_compare``: IDENT (same object), CONGRUENT (same
-        group + order, different context), SIMILAR (same members, other
-        order), UNEQUAL."""
+        group(s) + order, different context), SIMILAR (same members,
+        other order), UNEQUAL.  Intercomms compare local AND remote
+        groups; an intercomm never matches an intracomm."""
         if self is other:
             return Comm.IDENT
+        if self.is_inter != other.is_inter:
+            return Comm.UNEQUAL
         mine = list(self.group.world_ranks)
         theirs = list(other.group.world_ranks)
+        if self.is_inter:
+            rm = list(self.remote_group.world_ranks)
+            rt = list(other.remote_group.world_ranks)
+            if mine == theirs and rm == rt:
+                return Comm.CONGRUENT
+            if sorted(mine) == sorted(theirs) and sorted(rm) == sorted(rt):
+                return Comm.SIMILAR
+            return Comm.UNEQUAL
         if mine == theirs:
             return Comm.CONGRUENT
         if sorted(mine) == sorted(theirs):

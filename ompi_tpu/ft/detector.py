@@ -123,16 +123,15 @@ class Detector:
                 return r
         return me
 
-    def _send_p2p(self, meta: dict) -> bool:
+    def _send_frag(self, target: int, meta: dict) -> bool:
+        """One CTL heartbeat-frag to ``target`` (shared by the heartbeat
+        and tombstone paths so the frag shape can't desynchronise)."""
         from ompi_tpu.mca.btl.base import CTL, Frag
 
         bml = self._get_bml()
         if bml is None:
             return False
-        target = self._observer_of_me()
         me = self.rte.my_world_rank
-        if target == me:
-            return True
         try:
             ep = bml.endpoint(target)
             if ep is None:
@@ -142,23 +141,20 @@ class Detector:
         except Exception:
             return False
 
-    def _broadcast_p2p(self, meta: dict) -> None:
-        """Best-effort send to every live peer (tombstone flood)."""
-        from ompi_tpu.mca.btl.base import CTL, Frag
+    def _send_p2p(self, meta: dict) -> bool:
+        target = self._observer_of_me()
+        if target == self.rte.my_world_rank:
+            return True
+        return self._send_frag(target, meta)
 
-        bml = self._get_bml()
-        if bml is None:
-            return
+    def _broadcast_p2p(self, meta: dict) -> None:
+        """Tombstone flood: established connections only — shutdown must
+        not block connecting to possibly-dead peers."""
         me = self.rte.my_world_rank
+        meta = dict(meta, est_only=True)
         for r in range(self.rte.world_size):
-            if r == me or self._known_gone(r):
-                continue
-            try:
-                ep = bml.endpoint(r)
-                if ep is not None:
-                    ep.btl.send(ep, Frag(0, me, r, -1, 0, CTL, meta=meta))
-            except Exception:
-                pass
+            if r != me and not self._known_gone(r):
+                self._send_frag(r, meta)
 
     def _on_hb(self, frag) -> None:
         """CTL receive path (runs on whatever thread drives progress)."""

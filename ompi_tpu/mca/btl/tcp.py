@@ -54,7 +54,8 @@ class TcpBtl(Btl):
         self._sel = selectors.DefaultSelector()
         self._by_rank: dict[int, _Conn] = {}
         self._addr_cache: dict[int, tuple] = {}
-        self._connect_lock = threading.Lock()
+        self._locks_guard = threading.Lock()
+        self._connect_locks: dict[int, threading.Lock] = {}  # per peer
         self._connect_backoff: dict[int, float] = {}   # rank -> retry-after
 
     def register_vars(self, fw) -> None:
@@ -105,19 +106,24 @@ class TcpBtl(Btl):
         return Endpoint(self, world_rank)
 
     # -- send path -------------------------------------------------------
-    def _connect(self, rank: int) -> _Conn:
+    def _connect(self, rank: int, best_effort: bool = False) -> _Conn:
         conn = self._by_rank.get(rank)
         if conn is not None:
             return conn
-        with self._connect_lock:   # one connection per peer, ever
+        with self._locks_guard:
+            lock = self._connect_locks.setdefault(rank, threading.Lock())
+        with lock:   # one connection per PEER — peers connect in parallel
             conn = self._by_rank.get(rank)
             if conn is not None:
                 return conn
-            # failed-connect backoff: a dead host blackholes SYNs, and a
-            # blocking retry per FT flood/heartbeat tick would stall the
-            # progress thread for the full connect timeout each time
+            # failed-connect backoff gates only BEST-EFFORT traffic (FT
+            # heartbeats/floods): a dead host blackholes SYNs and a
+            # blocking retry per tick would stall the sender for the full
+            # connect timeout.  Application sends always attempt the
+            # connect — a transient failure must not hard-fail the data
+            # path for the backoff window.
             until = self._connect_backoff.get(rank, 0.0)
-            if time.monotonic() < until:
+            if best_effort and time.monotonic() < until:
                 raise ConnectionError(
                     f"rank {rank} connect in backoff until {until:.1f}")
             addr = self._addr_cache.get(rank)
@@ -127,24 +133,42 @@ class TcpBtl(Btl):
                     self._addr_cache[rank] = tuple(addr)
             if addr is None:
                 raise ConnectionError(f"no tcp address for rank {rank}")
+            sock = None
             try:
                 sock = socket.create_connection(tuple(addr), timeout=5)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # handshake: tell the peer who we are
+                hello = pickle.dumps({"rank": self._rte.my_world_rank})
+                sock.sendall(_LEN.pack(len(hello)) + hello)
             except OSError:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
                 self._connect_backoff[rank] = time.monotonic() + 10.0
                 raise
             self._connect_backoff.pop(rank, None)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = _Conn(sock, rank)
-            # handshake: tell the peer who we are
-            hello = pickle.dumps({"rank": self._rte.my_world_rank})
-            sock.sendall(_LEN.pack(len(hello)) + hello)
             sock.setblocking(False)
             self._sel.register(sock, selectors.EVENT_READ, conn)
             self._by_rank[rank] = conn
             return conn
 
     def send(self, ep: Endpoint, frag: Frag) -> None:
-        conn = self._connect(ep.world_rank)
+        # FT control traffic is best-effort: it honours connect backoff
+        # and, when flagged, only rides ALREADY-established connections
+        # (a shutdown tombstone flood must not block connecting to a
+        # possibly-dead peer)
+        meta = frag.meta or {}
+        ft = str(meta.get("proto", "")).startswith("ft_")
+        if meta.get("est_only"):
+            conn = self._by_rank.get(ep.world_rank)
+            if conn is None:
+                raise ConnectionError(
+                    f"no established connection to rank {ep.world_rank}")
+        else:
+            conn = self._connect(ep.world_rank, best_effort=ft)
         payload = pickle.dumps(frag)
         with conn.send_lock:
             conn.outbuf += _LEN.pack(len(payload)) + payload
