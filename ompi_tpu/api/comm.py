@@ -439,6 +439,21 @@ class Comm(AttributeHost):
                 lambda: _CR(Status(source=PROC_NULL, tag=ANY_TAG)))
         return PersistentP2P(lambda: self.pml.irecv(self, buf, source, tag))
 
+    def sendrecv_replace(self, buf, dest: int, source: int = ANY_SOURCE,
+                         sendtag: int = 0, recvtag: int = ANY_TAG) -> Status:
+        """``MPI_Sendrecv_replace``: the received message overwrites the
+        sent buffer (staged through a copy, like the reference).  ``buf``
+        must be a writable ndarray — replacement into a list/tuple would
+        be silently lost."""
+        if not isinstance(buf, np.ndarray):
+            raise MpiError(ErrorClass.ERR_BUFFER,
+                           "sendrecv_replace needs a writable ndarray")
+        arr = np.ascontiguousarray(buf)
+        st = self.sendrecv(arr.copy(), dest, arr, source, sendtag, recvtag)
+        if buf is not arr:
+            np.copyto(buf, arr)
+        return st
+
     def sendrecv(self, sendbuf, dest: int, recvbuf, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG) -> Status:
         self._check_state(dest)

@@ -101,6 +101,14 @@ class Request:
         self._raise_if_error()
         return self.status
 
+    def get_status(self) -> tuple[bool, Optional[Status]]:
+        """``MPI_Request_get_status``: like test() but errors surface in
+        ``status.error`` rather than raising."""
+        try:
+            return self.test()
+        except MpiError:
+            return True, self.status
+
     def cancel(self) -> None:
         with self._lock:
             if self.state is RequestState.ACTIVE and self._try_cancel():

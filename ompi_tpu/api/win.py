@@ -67,6 +67,34 @@ class Win(AttributeHost):
         win.comm.barrier()  # all exposure agents live before first access
         return win
 
+    @classmethod
+    def allocate(cls, comm, size: int, dtype=np.float64,
+                 name: str = "") -> tuple["Win", np.ndarray]:
+        """``MPI_Win_allocate``: framework-allocated exposure region;
+        returns (win, local buffer)."""
+        win = cls.create(comm, size=size, dtype=dtype, name=name)
+        return win, win.local
+
+    @classmethod
+    def allocate_shared(cls, comm, size: int, dtype=np.float64,
+                        name: str = "") -> tuple["Win", np.ndarray]:
+        """``MPI_Win_allocate_shared``: same-node windows are genuinely
+        shared-memory mapped here (osc/rdma's segments), so allocate IS
+        allocate_shared; ``shared_query`` gives the direct view."""
+        return cls.allocate(comm, size, dtype, name)
+
+    def shared_query(self, target: int) -> np.ndarray:
+        """``MPI_Win_shared_query``: a direct load/store view of
+        ``target``'s window (same-node, shm-mapped osc modules only)."""
+        self._check()
+        seg = getattr(self.module, "_seg", None)
+        if seg is None:
+            raise MpiError(
+                ErrorClass.ERR_RMA_CONFLICT,
+                f"window {self.name}'s osc module has no shared segments "
+                f"(active-message path); use put/get")
+        return seg(self, target).typed()
+
     # -- accessors -------------------------------------------------------
     @property
     def size(self) -> int:
@@ -127,6 +155,39 @@ class Win(AttributeHost):
         self._mon("compare_and_swap", np.asarray(value).nbytes)
         return self.module.compare_and_swap(self, value, compare, target,
                                             offset)
+
+    # -- request-based RMA (MPI_Rput/Rget/Raccumulate/Rget_accumulate) ---
+    # The osc modules complete operations on return (mapped windows:
+    # direct load/store; active message: request/reply inside the call),
+    # so the returned request is born complete — flush is still what
+    # orders remote visibility, exactly as MPI allows.
+    def rput(self, arr, target: int, offset: int = 0):
+        from ompi_tpu.api.request import CompletedRequest
+
+        self.put(arr, target, offset)
+        return CompletedRequest()
+
+    def rget(self, count: int, target: int, offset: int = 0):
+        from ompi_tpu.api.request import CompletedRequest
+
+        req = CompletedRequest()
+        req.result = self.get(count, target, offset)
+        return req
+
+    def raccumulate(self, arr, target: int, offset: int = 0,
+                    op: op_mod.Op = op_mod.SUM):
+        from ompi_tpu.api.request import CompletedRequest
+
+        self.accumulate(arr, target, offset, op)
+        return CompletedRequest()
+
+    def rget_accumulate(self, arr, target: int, offset: int = 0,
+                        op: op_mod.Op = op_mod.SUM):
+        from ompi_tpu.api.request import CompletedRequest
+
+        req = CompletedRequest()
+        req.result = self.get_accumulate(arr, target, offset, op)
+        return req
 
     # -- synchronization -------------------------------------------------
     def fence(self) -> None:

@@ -166,3 +166,41 @@ def test_rdma_excluded_falls_back_to_pt2pt(tmp_path):
                 extra=("--mca", "osc", "^rdma"))
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("fallback OK") == 2
+
+
+def test_shared_query_and_request_rma(tmp_path):
+    """MPI_Win_allocate_shared + shared_query direct load/store view, and
+    the request-based Rput/Rget family (``win_shared_query.c``,
+    ``rput.c``)."""
+
+    script = tmp_path / "wsq.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import ompi_tpu
+        from ompi_tpu.api.win import Win
+
+        w = ompi_tpu.init()
+        win, buf = Win.allocate_shared(w, 8, np.float64)
+        buf[:] = w.rank * 100.0
+        win.fence()
+        # direct view of the right neighbour's memory (same node: shm)
+        peer = (w.rank + 1) % w.size
+        view = win.shared_query(peer)
+        assert view[0] == peer * 100.0, view
+        win.fence()
+        # request-based RMA
+        r1 = win.rput(np.array([7.0]), peer, offset=1)
+        r1.wait()
+        win.flush(peer)
+        r2 = win.rget(2, peer, offset=0)
+        r2.wait()
+        got = r2.result
+        assert got[1] == 7.0, got
+        win.fence()
+        win.free()
+        print(f"WSQ OK {w.rank}")
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(2, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("WSQ OK") == 2

@@ -184,3 +184,35 @@ def test_spc_counters_advance(world):
     world.as_rank(0).send(np.zeros(10, np.float64), dest=1, tag=70)
     world.as_rank(1).recv(np.zeros(10, np.float64), source=0, tag=70)
     assert spc.read("bytes_sent") >= before + 80
+
+
+def test_sendrecv_replace(world):
+    """MPI_Sendrecv_replace: received data overwrites the send buffer."""
+    a, b = world.as_rank(0), world.as_rank(1)
+    from ompi_tpu.api.request import waitall
+
+    bufa = np.array([10.0, 11.0])
+    bufb = np.array([20.0, 21.0])
+    # eager-size exchange: the isend pairs with the replace sequentially
+    ra = a.isend(bufa.copy(), dest=1, tag=5)
+    st = b.sendrecv_replace(bufb, dest=0, source=0, sendtag=6, recvtag=5)
+    assert bufb.tolist() == [10.0, 11.0]
+    got = np.zeros(2)
+    a.recv(got, source=1, tag=6)
+    assert got.tolist() == [20.0, 21.0]
+    waitall([ra])
+
+
+def test_request_get_status_no_side_effects(world):
+    """MPI_Request_get_status: completion visible without freeing."""
+    s, r = world.as_rank(2), world.as_rank(3)
+    buf = np.zeros(1)
+    req = r.irecv(buf, source=2, tag=9)
+    flag, _ = req.get_status()
+    assert not flag
+    s.send(np.array([4.0]), dest=3, tag=9)
+    flag, st = req.get_status()
+    assert flag and st.source == 2
+    # request still waitable afterwards (get_status freed nothing)
+    req.wait()
+    assert buf[0] == 4.0
