@@ -445,7 +445,7 @@ class Comm(AttributeHost):
         sent buffer (staged through a copy, like the reference).  ``buf``
         must be a writable ndarray — replacement into a list/tuple would
         be silently lost."""
-        if not isinstance(buf, np.ndarray):
+        if not isinstance(buf, np.ndarray) or not buf.flags.writeable:
             raise MpiError(ErrorClass.ERR_BUFFER,
                            "sendrecv_replace needs a writable ndarray")
         arr = np.ascontiguousarray(buf)
@@ -698,6 +698,13 @@ class Comm(AttributeHost):
             if add is not None:
                 add(newcomm)
         comm_select(newcomm)
+
+    def topo_test(self) -> str:
+        """``MPI_Topo_test``: "cart" | "graph" | "dist_graph" |
+        "undefined"."""
+        if self.topo is None:
+            return "undefined"
+        return self.topo.kind   # every topo class defines it; fail loudly
 
     # -- process topologies (``ompi/mca/topo``) -------------------------
     def cart_create(self, dims: Sequence[int], periods=None,

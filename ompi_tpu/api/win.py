@@ -93,7 +93,13 @@ class Win(AttributeHost):
                 ErrorClass.ERR_RMA_CONFLICT,
                 f"window {self.name}'s osc module has no shared segments "
                 f"(active-message path); use put/get")
-        return seg(self, target).typed()
+        view = seg(self, target).typed()
+        # trim the >=1-byte allocation pad (zero-size windows) off the
+        # mapped segment.  shared_query assumes the symmetric allocation
+        # allocate_shared performs (same size every rank), so my element
+        # count is the peer's too
+        nelem = self.local.size if self.local is not None else len(view)
+        return view[:nelem]
 
     # -- accessors -------------------------------------------------------
     @property

@@ -120,6 +120,18 @@ class Datatype:
         """(combiner, contents) — the decode API (``MPI_Type_get_envelope``)."""
         return self.combiner, self.contents
 
+    def get_contents(self) -> tuple:
+        """``MPI_Type_get_contents``: the constructor arguments."""
+        return self.contents
+
+    def set_name(self, name: str) -> None:
+        """``MPI_Type_set_name``."""
+        self.name = name
+
+    def get_name(self) -> str:
+        """``MPI_Type_get_name``."""
+        return self.name
+
     # -- helpers used by the convertor and coll/op layers ---------------
     @property
     def elementary(self) -> Optional[np.dtype]:

@@ -163,3 +163,23 @@ class TestMultiprocessTopo:
         r = _tpurun(4, script)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "TOPO HALO OK" in r.stdout
+
+
+def test_topo_test_and_type_introspection(world):
+    """MPI_Topo_test + MPI_Type_get_contents/set_name
+    (``ompi/mpi/c/topo_test.c``, ``type_get_contents.c``)."""
+    assert world.topo_test() == "undefined"
+    cart = world.cart_create([world.size], periods=[True])
+    assert cart.topo_test() == "cart"
+    cart.free()
+
+    from ompi_tpu.datatype import FLOAT32, vector
+
+    dt = vector(3, 2, 5, FLOAT32)
+    comb, contents = dt.get_envelope()
+    assert comb == "vector"
+    assert dt.get_contents() == contents
+    dt.set_name("my_vec")
+    assert dt.get_name() == "my_vec"
+    d2 = dt.dup()
+    assert d2.get_envelope()[0] == "dup"
