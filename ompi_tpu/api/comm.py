@@ -149,6 +149,26 @@ class Comm(AttributeHost):
     def set_errhandler(self, eh: Errhandler) -> None:
         self.errhandler = eh
 
+    def get_errhandler(self) -> Errhandler:
+        return self.errhandler
+
+    def call_errhandler(self, errorcode) -> None:
+        """``MPI_Comm_call_errhandler`` (fatal default handler aborts,
+        ERRORS_RETURN raises the MpiError to the caller)."""
+        try:
+            cls = ErrorClass(int(errorcode))
+        except ValueError:
+            cls = ErrorClass.ERR_OTHER
+        self._err(MpiError(cls, f"user-raised code {int(errorcode)}"))
+
+    def set_info(self, info: Info) -> None:
+        """``MPI_Comm_set_info``: replace the comm's info hints."""
+        self.info = info.dup()
+
+    def get_info(self) -> Info:
+        """``MPI_Comm_get_info``."""
+        return self.info.dup()
+
     def _check_state(self, peer: Optional[int] = None) -> None:
         if self.freed:
             raise MpiError(ErrorClass.ERR_COMM, "communicator was freed")
