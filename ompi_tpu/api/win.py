@@ -68,6 +68,60 @@ class Win(AttributeHost):
         return win
 
     @classmethod
+    def create_dynamic(cls, comm, name: str = "") -> "Win":
+        """``MPI_Win_create_dynamic``: a window with NO exposure region
+        at creation; memory is attached later with :meth:`attach`.  The
+        reference addresses attached regions by absolute address; here
+        :meth:`attach` returns a region handle the application shares
+        with peers (the same out-of-band step real MPI apps do with
+        ``MPI_Get_address``)."""
+        import itertools
+
+        if comm.rte is not None and comm.rte.is_device_world:
+            raise MpiError(
+                ErrorClass.ERR_WIN,
+                "dynamic windows need the multi-process model (attach "
+                "semantics are per-process memory; run under tpurun)")
+        win = cls(comm.dup(), np.zeros(0, np.uint8), name=name)
+        win.dtype = np.dtype(np.uint8)
+        win.device = False
+        win.dynamic = True
+        win.regions = {}
+        win._region_ids = itertools.count(1)
+        from ompi_tpu.mca.osc import win_select
+
+        win_select(win)
+        win.comm.barrier()
+        return win
+
+    def attach_region(self, arr) -> int:
+        """``MPI_Win_attach`` (local): expose ``arr`` through this
+        dynamic window; returns the region handle peers target."""
+        self._check()
+        if not getattr(self, "dynamic", False):
+            raise MpiError(ErrorClass.ERR_WIN,
+                           "attach needs a dynamic window")
+        if not isinstance(arr, np.ndarray) or \
+                not arr.flags["C_CONTIGUOUS"]:
+            # a silent ascontiguousarray COPY would expose hidden memory:
+            # peers' puts must land in the caller's own array
+            raise MpiError(ErrorClass.ERR_WIN,
+                           "attach needs a C-contiguous ndarray (remote "
+                           "writes target the caller's memory)")
+        handle = next(self._region_ids)
+        self.regions[handle] = arr
+        return handle
+
+    def detach_region(self, handle: int) -> None:
+        """``MPI_Win_detach``."""
+        self._check()
+        if getattr(self, "regions", None) is None \
+                or handle not in self.regions:
+            raise MpiError(ErrorClass.ERR_WIN,
+                           f"no attached region {handle}")
+        del self.regions[handle]
+
+    @classmethod
     def allocate(cls, comm, size: int, dtype=np.float64,
                  name: str = "") -> tuple["Win", np.ndarray]:
         """``MPI_Win_allocate``: framework-allocated exposure region;
@@ -122,16 +176,36 @@ class Win(AttributeHost):
             monitoring.record_osc(op, nbytes)
 
     # -- RMA ops ---------------------------------------------------------
-    def put(self, arr, target: int, offset: int = 0) -> None:
+    def put(self, arr, target: int, offset: int = 0,
+            region: Optional[int] = None) -> None:
         self._check()
         arr = np.ascontiguousarray(arr)
         self._mon("put", arr.nbytes)
+        if region is not None:
+            self._region_op("put_region", arr, target, offset, region)
+            return
         self.module.put(self, arr, target, offset)
 
-    def get(self, count: int, target: int, offset: int = 0) -> np.ndarray:
+    def get(self, count: int, target: int, offset: int = 0,
+            region: Optional[int] = None) -> np.ndarray:
         self._check()
+        if region is not None:
+            # region dtype lives at the target: count real bytes after
+            out = self._region_op("get_region", count, target, offset,
+                                  region)
+            self._mon("get", out.nbytes)
+            return out
         self._mon("get", count * self.dtype.itemsize)
         return self.module.get(self, count, target, offset)
+
+    def _region_op(self, name: str, payload, target: int, offset: int,
+                   region: int):
+        fn = getattr(self.module, name, None)
+        if fn is None:
+            raise MpiError(
+                ErrorClass.ERR_WIN,
+                f"{self.name}'s osc module has no dynamic-region RMA")
+        return fn(self, payload, target, offset, region)
 
     def accumulate(self, arr, target: int, offset: int = 0,
                    op: op_mod.Op = op_mod.SUM) -> None:

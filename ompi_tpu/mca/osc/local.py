@@ -106,6 +106,8 @@ class LocalComponent(Component):
             help="Selection priority of osc/local")
 
     def win_query(self, win):
+        if getattr(win, "dynamic", False):
+            return None   # region RMA needs the active-message path
         if (win.comm.rte is not None and win.comm.rte.is_device_world) \
                 or win.comm.size == 1:
             return self._prio.value, LocalModule()

@@ -139,6 +139,27 @@ class Pt2ptModule:
         _send_req(win.comm, target,
                   {"kind": "put", "off": offset, "data": arr})
 
+    # -- dynamic-window region RMA (MPI_Win_create_dynamic + attach) -----
+    def put_region(self, win, arr, target: int, offset: int,
+                   region: int) -> None:
+        _send_req(win.comm, target,
+                  {"kind": "put", "off": offset, "data": arr,
+                   "region": region})
+
+    def get_region(self, win, count: int, target: int, offset: int,
+                   region: int) -> np.ndarray:
+        rt = self._next_reply_tag()
+        _send_req(win.comm, target,
+                  {"kind": "get", "off": offset, "count": count, "rt": rt,
+                   "region": region})
+        out = _recv_reply(win.comm, target, rt)
+        if isinstance(out, dict) and out.get("err"):
+            from ompi_tpu.api.errors import ErrorClass, MpiError
+
+            raise MpiError(ErrorClass.ERR_RMA_CONFLICT,
+                           f"region {region} on rank {target}: {out['err']}")
+        return out
+
     def get(self, win, count: int, target: int, offset: int) -> np.ndarray:
         rt = self._next_reply_tag()
         _send_req(win.comm, target,
@@ -265,6 +286,17 @@ class Pt2ptModule:
     def _handle(self, win, source: int, req: dict) -> None:
         kind = req["kind"]
         base = win.local
+        if req.get("region") is not None:
+            # dynamic window: resolve the attached region by handle.  A
+            # detached/unknown handle is erroneous per MPI — gets reply
+            # an error marker (origin raises ERR_RMA_RANGE); puts are
+            # dropped rather than corrupting win.local
+            base = win.regions.get(req["region"])
+            if base is None:
+                if kind == "get":
+                    _send_reply(win.comm, source, req["rt"],
+                                {"err": "region detached"})
+                return
         if kind == "put":
             data = req["data"]
             base[req["off"]:req["off"] + data.size] = data

@@ -268,6 +268,8 @@ class RdmaOscComponent(Component):
         rte = win.comm.rte
         if rte is None or rte.is_device_world:
             return None
+        if getattr(win, "dynamic", False):
+            return None   # dynamic regions need the active-message path
         if getattr(rte, "client", None) is None:
             return None
         try:

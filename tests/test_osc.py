@@ -201,3 +201,49 @@ class TestMultiprocessRma:
         r = _tpurun(4, script)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "RMA PSCW OK" in r.stdout
+
+
+def test_dynamic_window_attach_detach(tmp_path):
+    """MPI_Win_create_dynamic + attach/detach: RMA into regions exposed
+    after window creation (``ompi/mpi/c/win_create_dynamic.c``)."""
+    import textwrap
+
+    script = tmp_path / "dyn.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import ompi_tpu
+        from ompi_tpu.api.win import Win
+
+        w = ompi_tpu.init()
+        win = Win.create_dynamic(w)
+        mem = np.full(4, w.rank * 10.0)
+        h = win.attach_region(mem)
+        # share my handle with everyone (the app-level address exchange
+        # real MPI dynamic windows also need)
+        handles = w.allgather(np.array([h], np.int64))
+        handles = [int(np.ravel(x)[0]) for x in np.asarray(handles)]
+        w.barrier()
+        peer = (w.rank + 1) % w.size
+        got = win.get(4, peer, offset=0, region=handles[peer])
+        assert got.tolist() == [peer * 10.0] * 4, got
+        win.put(np.array([99.0]), peer, offset=1, region=handles[peer])
+        win.fence()
+        w.barrier()
+        assert mem[1] == 99.0, mem
+        win.detach_region(h)
+        w.barrier()   # both sides detached before probing
+        # detached region: gets raise, puts are dropped (erroneous per MPI)
+        from ompi_tpu.api.errors import MpiError
+        try:
+            win.get(4, peer, offset=0, region=handles[peer])
+            raise AssertionError("get from detached region succeeded")
+        except MpiError:
+            pass
+        w.barrier()
+        win.free()
+        print(f"DYN OK {w.rank}")
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(2, script)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("DYN OK") == 2
