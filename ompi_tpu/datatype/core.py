@@ -17,6 +17,8 @@ from typing import Iterable, Optional, Sequence
 
 import numpy as np
 
+from ompi_tpu.api.attributes import AttributeHost
+
 try:  # ml_dtypes ships with jax; gives numpy bfloat16
     import ml_dtypes
 
@@ -62,8 +64,11 @@ def _coalesce(segments: Iterable[Segment]) -> tuple[Segment, ...]:
     return tuple(out)
 
 
-class Datatype:
-    """An MPI-style datatype: committed type map + extent bookkeeping."""
+class Datatype(AttributeHost):
+    """An MPI-style datatype: committed type map + extent bookkeeping.
+
+    Hosts attributes (``MPI_Type_set_attr`` family) via AttributeHost,
+    like communicators and windows."""
 
     def __init__(
         self,
@@ -114,6 +119,7 @@ class Datatype:
         d = Datatype(self.segments, self.lb, self.ub, self.name, "dup",
                      (self,))
         d.committed = self.committed
+        self._attrs_copy_to(d)   # MPI_Type_dup runs the keyval copy fns
         return d
 
     def get_envelope(self) -> tuple[str, tuple]:

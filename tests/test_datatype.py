@@ -282,3 +282,24 @@ def test_pack_unpack_api():
     a, b = np.arange(4.0), np.ones(4)
     reduce_local(a, b, op_mod.MAX)
     assert b.tolist() == [1.0, 1.0, 2.0, 3.0]
+
+
+def test_type_attributes():
+    """MPI_Type_create_keyval / set_attr / get_attr / delete_attr."""
+    from ompi_tpu.api.attributes import keyval_create, keyval_free
+    from ompi_tpu.datatype import FLOAT32, vector
+
+    dt = vector(2, 1, 3, FLOAT32)
+    kv = keyval_create()
+    found, _ = dt.attr_get(kv)
+    assert not found
+    dt.attr_put(kv, {"unit": "rows"})
+    found, val = dt.attr_get(kv)
+    assert found and val["unit"] == "rows"
+    # dup copies attributes through the keyval copy_fn (default: share)
+    d2 = dt.dup()
+    assert d2.attr_get(kv)[0]
+    dt.attr_delete(kv)
+    assert not dt.attr_get(kv)[0]
+    assert d2.attr_get(kv)[0]      # the dup's copy survives
+    keyval_free(kv)
