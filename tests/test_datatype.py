@@ -286,20 +286,23 @@ def test_pack_unpack_api():
 
 def test_type_attributes():
     """MPI_Type_create_keyval / set_attr / get_attr / delete_attr."""
-    from ompi_tpu.api.attributes import keyval_create, keyval_free
+    from ompi_tpu.api.attributes import DUP_FN, keyval_create, keyval_free
     from ompi_tpu.datatype import FLOAT32, vector
 
     dt = vector(2, 1, 3, FLOAT32)
-    kv = keyval_create()
-    found, _ = dt.attr_get(kv)
-    assert not found
-    dt.attr_put(kv, {"unit": "rows"})
-    found, val = dt.attr_get(kv)
+    kv_null = keyval_create()        # default = MPI_NULL_COPY_FN
+    kv_dup = keyval_create(copy_fn=DUP_FN)
+    assert not dt.attr_get(kv_null)[0]
+    dt.attr_put(kv_null, {"unit": "rows"})
+    dt.attr_put(kv_dup, "shared")
+    found, val = dt.attr_get(kv_null)
     assert found and val["unit"] == "rows"
-    # dup copies attributes through the keyval copy_fn (default: share)
     d2 = dt.dup()
-    assert d2.attr_get(kv)[0]
-    dt.attr_delete(kv)
-    assert not dt.attr_get(kv)[0]
-    assert d2.attr_get(kv)[0]      # the dup's copy survives
-    keyval_free(kv)
+    # MPI semantics: NULL_COPY keyvals do NOT propagate, DUP_FN ones do
+    assert not d2.attr_get(kv_null)[0]
+    assert d2.attr_get(kv_dup) == (True, "shared")
+    dt.attr_delete(kv_null)
+    assert not dt.attr_get(kv_null)[0]
+    assert d2.attr_get(kv_dup)[0]    # the dup's copy survives
+    keyval_free(kv_null)
+    keyval_free(kv_dup)
