@@ -100,7 +100,9 @@ def main(argv=None) -> int:
                 f"{var.value!r} (type {var.vtype.name.lower()}, "
                 f"source {origin}{detail})", p))
 
-    if args.all or args.topo:
+    if args.topo:
+        # explicit-only (not part of --all): device discovery initializes
+        # the accelerator runtime, which an info dump must not pay for
         from ompi_tpu.base import hwloc
 
         for line in hwloc.summary().splitlines():

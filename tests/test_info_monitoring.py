@@ -111,3 +111,12 @@ def test_monitoring_disabled_by_default(tmp_path):
     r = _tpurun(2, [sys.executable, str(script)])
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("nomon OK") == 2
+
+
+def test_topo_explicit_only():
+    """--all must NOT boot the accelerator runtime for topology; --topo
+    opts in (regression guard for the lazy-init guarantee)."""
+    r_all = _run_info("--all")
+    assert "topo:" not in r_all.stdout
+    r_topo = _run_info("--topo")
+    assert "topo:" in r_topo.stdout and "host:" in r_topo.stdout
