@@ -59,6 +59,7 @@ class CoordServer:
         self._aborted: Optional[int] = None
         self._failed: set[int] = set()
         self._fence_expect: dict[str, tuple] = {}
+        self._fence_done: set[str] = set()
         self._next_rank = nprocs          # global rank allocator (dpm spawn)
         self._spawn_handler = None        # set by the launcher (tpurun)
         self._spawn_seq = 0
@@ -156,10 +157,15 @@ class CoordServer:
                         # when every rank has either arrived or died — a
                         # dead rank's earlier arrival must not release the
                         # fence while a live survivor is still outside it
+                        oneshot = bool(req.get("oneshot"))
+                        if oneshot and fid in self._fence_done:
+                            # late arrival to a completed one-shot round
+                            _send_frame(conn, {"ok": True})
+                            continue
                         arrived = self._fence_ranks.setdefault(fid, set())
                         arrived.add(req.get("rank", -1))
                         if self._fence_satisfied(fid):
-                            self._complete_fence(fid)
+                            self._complete_fence(fid, oneshot)
                         else:
                             gen = self._fence_gen.get(fid, 0)
                             while self._fence_gen.get(fid, 0) == gen:
@@ -168,7 +174,7 @@ class CoordServer:
                                     break
                                 # a failure may have lowered the bar
                                 if self._fence_satisfied(fid):
-                                    self._complete_fence(fid)
+                                    self._complete_fence(fid, oneshot)
                                     break
                     _send_frame(conn, {"ok": True})
                 elif op == "event_pub":
@@ -224,8 +230,15 @@ class CoordServer:
         expected = self._fence_expect.get(fid, range(self.nprocs))
         return all(r in arrived or r in self._failed for r in expected)
 
-    def _complete_fence(self, fid: str) -> None:
-        # caller holds _fence_cond
+    def _complete_fence(self, fid: str, oneshot: bool = False) -> None:
+        # caller holds _fence_cond.  One-shot fences (finalize) record
+        # completion permanently: a rank arriving LATE — released peers
+        # treated it as failed (e.g. its heartbeats stopped but the
+        # process lives) — must pass instead of waiting forever on peers
+        # that already left.  Normal fences keep per-round generations so
+        # re-used ids (runtime re-init) still synchronise.
+        if oneshot:
+            self._fence_done.add(fid)
         self._fence_ranks[fid] = set()
         self._fence_gen[fid] = self._fence_gen.get(fid, 0) + 1
         self._fence_cond.notify_all()
@@ -280,12 +293,13 @@ class CoordServer:
 class CoordClient:
     """Per-process client (the PMIx client analog)."""
 
-    def __init__(self, addr: Optional[tuple] = None):
+    def __init__(self, addr: Optional[tuple] = None,
+                 timeout: float = 120.0):
         if addr is None:
             spec = os.environ["OTPU_COORD"]
             host, port = spec.rsplit(":", 1)
             addr = (host, int(port))
-        self._sock = socket.create_connection(addr, timeout=120)
+        self._sock = socket.create_connection(addr, timeout=timeout)
         self._lock = threading.Lock()
         self._event_since = 0
 
@@ -333,6 +347,18 @@ class CoordClient:
         if rank < 0:
             raise ValueError("fence requires the caller's world rank")
         self._rpc(op="fence", id=fence_id, rank=rank, expect=expect)
+
+    def fence_oneshot(self, fence_id: str, *, rank: int,
+                      expect=None) -> None:
+        """A fence whose completion is remembered: a rank arriving after
+        the round completed (peers were released by its presumed failure)
+        passes instead of waiting for ranks that already left.  Used for
+        the finalize fence — normal fences keep strict per-round
+        semantics."""
+        if rank < 0:
+            raise ValueError("fence requires the caller's world rank")
+        self._rpc(op="fence", id=fence_id, rank=rank, expect=expect,
+                  oneshot=True)
 
     def event_publish(self, name: str, payload: Any) -> None:
         self._rpc(op="event_pub", name=name, payload=payload)

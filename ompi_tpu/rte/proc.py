@@ -56,6 +56,27 @@ class ProcRte(Rte):
         self.client.fence(f"{self.job}:f{self._fence_counter}",
                           rank=self.my_world_rank, expect=self.job_ranks)
 
+    def fence_final(self, timeout: float = 10.0) -> None:
+        """Pre-teardown synchronisation (ompi_mpi_finalize's barrier).
+
+        One-shot semantics (a rank arriving after peers were released by
+        its presumed failure passes immediately) on a DEDICATED short-
+        timeout connection: a peer that exited without fencing must cost
+        at most ``timeout`` seconds and must not desynchronise the shared
+        client's request/reply stream — the throwaway connection is
+        closed either way."""
+        from ompi_tpu.rte.coord import CoordClient
+
+        c = CoordClient(timeout=timeout)
+        try:
+            c.fence_oneshot(f"{self.job}:final", rank=self.my_world_rank,
+                            expect=self.job_ranks)
+        finally:
+            try:
+                c.close()
+            except Exception:
+                pass
+
     def locality_color(self, split_type: str) -> int:
         # 'shared' → same node (the sm/ICI domain).  Stable cross-process
         # hash: builtin hash() is PYTHONHASHSEED-randomised per process,

@@ -280,6 +280,18 @@ def finalize() -> None:
             return
         _state = State.FINALIZE_STARTED
         try:
+            # pre-teardown synchronisation (ompi_mpi_finalize's barrier):
+            # a fast-exiting rank must not unlink shared segments a slower
+            # peer is still attaching during ITS init.  fence_final is
+            # one-shot + failure-aware and rides a dedicated short-timeout
+            # connection, so a peer that exited without fencing costs a
+            # bounded wait and cannot desync the shared client.
+            fence_final = getattr(_rte, "fence_final", None)
+            if fence_final is not None:
+                try:
+                    fence_final()
+                except Exception:
+                    pass   # coord gone / timeout: peers are exiting too
             from ompi_tpu.ft import propagator as _ft_prop
 
             _ft_prop.stop()
