@@ -242,3 +242,35 @@ class TestMultiprocessFt:
                          ("ft_detector_timeout", "1.5")])
         assert "FT DETECTOR OK" in r.stdout, r.stdout + r.stderr
         assert r.returncode == 0, r.stdout + r.stderr
+
+
+class TestMultiFailure:
+    def test_detector_survives_double_failure(self, tmp_path):
+        """TWO adjacent ranks die; the ring rotates past both and every
+        survivor learns both failures (observer rotation,
+        ``comm_ft_detector.c`` + the propagator flood)."""
+        script = tmp_path / "double.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            import ompi_tpu
+            from ompi_tpu.ft import state as ft_state
+
+            w = ompi_tpu.init()
+            w.barrier()
+            if w.rank in (1, 2):
+                time.sleep(0.5)
+                os._exit(1)          # both die abruptly, no tombstone
+            deadline = time.time() + 60
+            while not (ft_state.is_failed(1) and ft_state.is_failed(2)):
+                if time.time() > deadline:
+                    sys.exit("double failure never fully detected")
+                time.sleep(0.05)
+            print(f"DOUBLE OK {w.rank}", flush=True)
+            ompi_tpu.finalize()
+        """))
+        r = _tpurun(4, script, recovery=True, timeout=150,
+                    mca=[("ft_detector", "true"),
+                         ("ft_detector_period", "0.2"),
+                         ("ft_detector_timeout", "1.5"),
+                         ("ft_detector_startup_grace", "2.0")])
+        assert r.stdout.count("DOUBLE OK") == 2, r.stdout + r.stderr
