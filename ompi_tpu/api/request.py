@@ -238,10 +238,12 @@ def waitany(requests: Sequence[Request]) -> tuple[int, Status]:
             time.sleep(50e-6)
 
 
-def waitsome(requests: Sequence[Request]) -> tuple[list[int], list[Status]]:
+def waitsome(requests: Sequence[Request]):
+    """Returns ``(indices, statuses)``; ``(UNDEFINED, [])`` when the list
+    holds no active request (outcount=MPI_UNDEFINED, MPI-3.1 §3.7.5)."""
     idx, _ = waitany(requests)
     if idx == UNDEFINED:
-        return [], []
+        return UNDEFINED, []
     out, stats = [], []
     for i, r in enumerate(requests):
         if r.complete_flag:
@@ -286,8 +288,13 @@ def testany(requests: Sequence[Request]) -> tuple[bool, int, Optional[Status]]:
     return False, UNDEFINED, None
 
 
-def testsome(requests: Sequence[Request]) -> tuple[list[int], list[Status]]:
+def testsome(requests: Sequence[Request]):
+    """Returns ``(indices, statuses)``; ``(UNDEFINED, [])`` when the list
+    holds no active request (outcount=MPI_UNDEFINED, MPI-3.1 §3.7.5)."""
     _progress()
+    if not requests or all(r.state is RequestState.INACTIVE
+                           for r in requests):
+        return UNDEFINED, []
     out, stats = [], []
     for i, r in enumerate(requests):
         if r.complete_flag:

@@ -148,6 +148,7 @@ class PvarHandle:
         self.hid = hid
         self.bound_obj = obj
         self.started = False
+        self._frozen_valid = False   # has start() or stop() set _frozen state?
         self._base = 0.0
         self._frozen = 0.0
 
@@ -161,15 +162,23 @@ class PvarHandle:
     def start(self) -> None:
         self._base = self.pvar.read() if self._delta_class() else 0.0
         self.started = True
+        self._frozen_valid = True
 
     def stop(self) -> None:
         """Freeze the handle: reads after stop report the value observed
         at stop time (MPI-3 §14.3 stopped-handle semantics)."""
         self._frozen = self.pvar.read() - self._base
         self.started = False
+        self._frozen_valid = True
 
     def read(self) -> float:
         if not self.started:
+            # a never-started, never-stopped handle on an absolute class
+            # (LEVEL/SIZE/WATERMARK) reports the live value — MPI-3
+            # continuous-variable semantics; only delta classes freeze at 0
+            # before a start, and an explicit stop() freezes every class
+            if not self._frozen_valid and not self._delta_class():
+                return self.pvar.read()
             return self._frozen
         return self.pvar.read() - self._base
 

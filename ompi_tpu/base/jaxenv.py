@@ -1,0 +1,27 @@
+"""JAX backend-selection guard.
+
+Stock JAX honors the ``JAX_PLATFORMS`` environment variable, but a site
+boot hook (e.g. a ``sitecustomize`` that force-targets an accelerator
+tunnel) may override the platform via ``jax.config`` before any user code
+runs.  :func:`apply_platform_env` restores env-var precedence: an explicit
+``JAX_PLATFORMS`` always wins.  Call it before the first backend
+initialization (``jax.devices()``) — without it, a child process asked to
+run on ``cpu`` can hang trying to reach an accelerator that is absent or
+unreachable.
+"""
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    plats = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not plats:
+        return
+    try:
+        import jax
+
+        if getattr(jax.config, "jax_platforms", None) != plats:
+            jax.config.update("jax_platforms", plats)
+    except Exception:
+        pass  # pre-init only; never block the caller's own error handling

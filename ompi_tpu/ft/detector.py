@@ -245,6 +245,7 @@ class Detector:
 
                             propagator.report_failure(
                                 self.rte, target, origin="heartbeat",
-                                client=self.client if coord_up else None)
+                                client=(self.client if coord_up
+                                        else propagator.NO_EVENT))
                         last.pop(target, None)
             self._stop.wait(self.period)

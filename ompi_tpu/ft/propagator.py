@@ -28,6 +28,12 @@ from ompi_tpu.ft import state as ft_state
 
 _stream = _output.open_stream("ft")
 
+#: Sentinel for ``report_failure(client=...)``: the caller knows the
+#: coordination service is dead — skip the event-bus leg entirely rather
+#: than block on the shared client's socket timeout (which would stall
+#: the detector thread and silence this rank's own heartbeats).
+NO_EVENT = object()
+
 
 def report_failure(rte, world_rank: int, origin: str = "unknown",
                    client=None) -> None:
@@ -36,21 +42,24 @@ def report_failure(rte, world_rank: int, origin: str = "unknown",
     ``client``: publish over this dedicated coordination connection instead
     of the shared one (the detector passes its own so a blocked shared
     client can't stall the report — or the detector's heartbeat loop).
+    Pass :data:`NO_EVENT` when the coordination service is known dead to
+    go straight to the p2p flood.
     """
     if ft_state.is_failed(world_rank):
         return
     _output.output(_stream, 1, "rank %d detected failed (via %s)",
                    world_rank, origin)
     ft_state.mark_failed(world_rank)
-    try:
-        if client is not None:
-            client.event_publish("proc_failed",
+    if client is not NO_EVENT:
+        try:
+            if client is not None:
+                client.event_publish("proc_failed",
+                                     {"rank": world_rank, "origin": origin})
+            else:
+                rte.event_notify("proc_failed",
                                  {"rank": world_rank, "origin": origin})
-        else:
-            rte.event_notify("proc_failed",
-                             {"rank": world_rank, "origin": origin})
-    except Exception:
-        pass  # coordination service gone: the p2p flood still carries it
+        except Exception:
+            pass  # coordination service gone: the p2p flood still carries it
     _flood_failure(rte, world_rank, origin)
 
 

@@ -139,11 +139,13 @@ class XlaCollModule:
         Host (numpy) inputs always go through _check for explicit sharded
         placement — a warm cache must not hand a raw host array to the
         compiled program."""
-        if isinstance(x, np.ndarray):
+        checked = isinstance(x, np.ndarray)
+        if checked:
             x = self._check(comm, x, inner_n)
         entry = self._cache.get(key)
         if entry is None:
-            x = self._check(comm, x, inner_n)
+            if not checked:
+                x = self._check(comm, x, inner_n)
             with self._lock:
                 entry = self._cache.get(key)
                 if entry is None:

@@ -78,7 +78,8 @@ def ulysses_attention(q, k, v, axis: str, n_shards: int):
     One ``all_to_all`` turns the sequence axis local-complete (each shard
     keeps h_local/n_shards heads over the FULL sequence), attention runs
     locally with no inter-step dependency, and the inverse all_to_all
-    restores sequence sharding.  Two collectives total vs the ring's
+    restores sequence sharding.  Two reshard phases (four ``all_to_all``
+    calls: q/k/v scatter + the output inverse) vs the ring's
     n_shards ppermute steps — better for short-ish sequences on fast ICI;
     the ring wins at very long context (O(s_local) memory).  The MoE-
     dispatch-shaped exchange of SURVEY.md §2.6's alltoall row.

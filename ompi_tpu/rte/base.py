@@ -71,6 +71,9 @@ class DeviceWorldRte(Rte):
     is_device_world = True
 
     def __init__(self, devices=None, axis_name: str = "world") -> None:
+        from ompi_tpu.base.jaxenv import apply_platform_env
+
+        apply_platform_env()
         import jax
 
         if devices is None:
