@@ -51,8 +51,14 @@ class Op:
 
 
 def _elementwise(np_fn):
-    def fn(invec, inoutvec, datatype=None):
-        inoutvec[...] = np_fn(invec, inoutvec)
+    if isinstance(np_fn, np.ufunc):
+        # write straight into inoutvec: the temp-then-copy form doubles
+        # memory traffic, which is THE cost of a host reduction
+        def fn(invec, inoutvec, datatype=None):
+            np_fn(invec, inoutvec, out=inoutvec)
+    else:
+        def fn(invec, inoutvec, datatype=None):
+            inoutvec[...] = np_fn(invec, inoutvec)
     return fn
 
 

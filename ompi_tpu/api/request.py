@@ -30,6 +30,28 @@ def _progress() -> int:
     return progress()
 
 
+#: Empty progress polls before yielding the core.  On an oversubscribed
+#: host (more ranks than cores — the reference's ``mpi_yield_when_idle``
+#: situation) a waiter that keeps spinning hogs its whole scheduler
+#: quantum while the peer it waits on is runnable but descheduled: every
+#: rendezvous round-trip then costs O(quantum) instead of O(µs).  Yielding
+#: after a handful of empty polls costs ~1µs on an idle machine and turns
+#: the oversubscribed pingpong from milliseconds into microseconds.
+_YIELD_AFTER = 4
+_SLEEP_AFTER = 64
+
+
+def _idle_backoff(spins: int) -> None:
+    """Escalating wait: spin -> sched_yield -> block on transport fds
+    (the btl doorbell/socket set; wakes in ~10µs on message arrival)."""
+    if spins >= _SLEEP_AFTER:
+        from ompi_tpu.runtime.progress import idle_wait
+
+        idle_wait(0.001)
+    elif spins >= _YIELD_AFTER:
+        time.sleep(0)          # bare yield: give the peer the core
+
+
 class Request:
     """Base request; subclasses drive completion from the progress engine."""
 
@@ -94,8 +116,7 @@ class Request:
                 raise TimeoutError("request wait timed out")
             if made == 0:
                 spins += 1
-                if spins > 1000:
-                    time.sleep(50e-6)  # adaptive yield, opal_progress-style
+                _idle_backoff(spins)
             else:
                 spins = 0
         self._raise_if_error()
@@ -234,8 +255,7 @@ def waitany(requests: Sequence[Request]) -> tuple[int, Status]:
                 return i, r.status
         made = _progress()
         spins = spins + 1 if made == 0 else 0
-        if spins > 1000:
-            time.sleep(50e-6)
+        _idle_backoff(spins)
 
 
 def waitsome(requests: Sequence[Request]):

@@ -63,7 +63,9 @@ def pack(buf, count, datatype, external32: bool = False) -> bytes:
     """``MPI_Pack`` (/ ``MPI_Pack_external``): described memory → a
     contiguous byte stream, via the convertor (``ompi/mpi/c/pack.c``)."""
     flags = ConvertorFlags.EXTERNAL32 if external32 else ConvertorFlags.NONE
-    return Convertor(datatype, count, buf, flags=flags).pack()
+    # user-facing MPI_Pack keeps the documented bytes contract; the hot
+    # path (pml/btl) consumes the convertor's zero-extra-copy array form
+    return Convertor(datatype, count, buf, flags=flags).pack().tobytes()
 
 
 def unpack(data, buf, count, datatype, external32: bool = False) -> int:

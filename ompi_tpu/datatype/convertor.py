@@ -63,17 +63,31 @@ class Convertor:
         self.checksum = 0
         segs = datatype.segments
         self._native = None
-        self._seg_offs = np.array([s.offset for s in segs], dtype=np.int64)
-        self._seg_lens = np.array([s.nbytes for s in segs], dtype=np.int64)
-        self._seg_prefix = np.concatenate(
-            ([0], np.cumsum(self._seg_lens)))  # len nseg+1
-        # byte-offset template of one element's packed stream (pack order)
-        tmpl = np.empty(datatype.size, dtype=np.int64)
-        pos = 0
-        for s in segs:
-            tmpl[pos:pos + s.nbytes] = s.offset + np.arange(s.nbytes)
-            pos += s.nbytes
-        self._template = tmpl
+        # the segment tables depend only on the datatype: build once and
+        # cache ON the datatype — convertor construction is per-message
+        # (every send/recv request makes one) and must stay O(1)
+        cache = getattr(datatype, "_convertor_cache", None)
+        if cache is None:
+            seg_offs = np.array([s.offset for s in segs], dtype=np.int64)
+            seg_lens = np.array([s.nbytes for s in segs], dtype=np.int64)
+            seg_prefix = np.concatenate(([0], np.cumsum(seg_lens)))
+            # byte-offset template of one element's packed stream
+            tmpl = np.empty(datatype.size, dtype=np.int64)
+            pos = 0
+            for s in segs:
+                tmpl[pos:pos + s.nbytes] = s.offset + np.arange(s.nbytes)
+                pos += s.nbytes
+            # gap-free single segment ⇒ the packed stream IS the memory
+            # layout: pack/unpack collapse to one slice copy
+            contig = (len(segs) == 1 and datatype.extent == datatype.size
+                      and segs[0].nbytes == datatype.size)
+            cache = (seg_offs, seg_lens, seg_prefix, tmpl, contig)
+            try:
+                datatype._convertor_cache = cache
+            except AttributeError:
+                pass   # slots/frozen types: just rebuild next time
+        (self._seg_offs, self._seg_lens, self._seg_prefix,
+         self._template, self._contig) = cache
         # per-position itemsize (for external32 byteswap alignment)
         if flags & ConvertorFlags.EXTERNAL32:
             self._swap_plan = [
@@ -210,19 +224,31 @@ class Convertor:
                 sub[:] = sub[:, ::-1]
             pos += take
 
-    def pack(self, max_bytes: Optional[int] = None) -> bytes:
-        """Return the next <= max_bytes of the packed stream; advances."""
+    def pack(self, max_bytes: Optional[int] = None) -> np.ndarray:
+        """Return the next <= max_bytes of the packed stream; advances.
+
+        Returns an OWNED uint8 array (bytes-like; btls write it straight
+        to the wire — returning ``bytes`` would add a full-size copy per
+        fragment on the host hot path)."""
         if self._mem is None:
             raise RuntimeError("convertor has no buffer bound")
         if self.packed_size == 0:
-            return b""
+            return np.empty(0, np.uint8)
         dt = self.datatype
         n = self.packed_size - self.position
         if max_bytes is not None:
             n = min(n, max_bytes)
         n = self._align_external32(n)
-        out = np.empty(n, dtype=np.uint8)
         start = self.position
+        if self._contig and not (self.flags & ConvertorFlags.EXTERNAL32):
+            # contiguous fast path: stream position == memory offset
+            lo = self.base_offset + dt.segments[0].offset + start
+            out = np.array(self._mem[lo:lo + n])   # owned copy
+            if self.flags & ConvertorFlags.CHECKSUM:
+                self.checksum = zlib.crc32(out, self.checksum)
+            self.position = start + n
+            return out
+        out = np.empty(n, dtype=np.uint8)
         # head partial element
         written = 0
         size = dt.size
@@ -247,9 +273,29 @@ class Convertor:
         if self.flags & ConvertorFlags.EXTERNAL32:
             self._swap_external32(out, start)
         if self.flags & ConvertorFlags.CHECKSUM:
-            self.checksum = zlib.crc32(out.tobytes(), self.checksum)
+            self.checksum = zlib.crc32(out, self.checksum)
         self.position = start + n
-        return out.tobytes()
+        return out
+
+    def pack_borrow(self, max_bytes: Optional[int] = None):
+        """Like :meth:`pack` but may return a zero-copy VIEW of the bound
+        user buffer: ``(chunk, borrowed)``.  When ``borrowed`` is True the
+        chunk aliases user memory — a transport must either consume it
+        synchronously (copy to wire/ring before returning) or take an
+        owned copy before queueing it anywhere (the reference's btl
+        descriptors make the same send-in-place vs buffered distinction).
+        """
+        if (self._contig and self._mem is not None and self.packed_size
+                and not self.flags & (ConvertorFlags.EXTERNAL32
+                                      | ConvertorFlags.CHECKSUM)):
+            n = self.packed_size - self.position
+            if max_bytes is not None:
+                n = min(n, max_bytes)
+            lo = (self.base_offset + self.datatype.segments[0].offset
+                  + self.position)
+            self.position += n
+            return self._mem[lo:lo + n], True
+        return self.pack(max_bytes), False
 
     def unpack(self, data: Union[bytes, memoryview, np.ndarray]) -> int:
         """Consume an incoming packed chunk at the current position."""
@@ -267,10 +313,16 @@ class Convertor:
         chunk = chunk[:n]
         start = self.position
         if self.flags & ConvertorFlags.CHECKSUM:
-            self.checksum = zlib.crc32(chunk.tobytes(), self.checksum)
+            self.checksum = zlib.crc32(np.ascontiguousarray(chunk),
+                                       self.checksum)
         if self.flags & ConvertorFlags.EXTERNAL32:
             self._swap_external32(chunk, start)
         dt = self.datatype
+        if self._contig and not (self.flags & ConvertorFlags.EXTERNAL32):
+            lo = self.base_offset + dt.segments[0].offset + start
+            self._mem[lo:lo + n] = chunk
+            self.position = start + n
+            return n
         size = dt.size
         written = 0
         e0, r0 = divmod(start, size)

@@ -13,6 +13,15 @@ from typing import Any, Callable, Optional
 
 from ompi_tpu.base.mca import Component
 
+
+def owned_bytes(payload) -> bytes:
+    """Owned bytes of any bytes-like payload (ndarray views included) —
+    the buffered-descriptor side of the send-in-place vs copy split."""
+    import numpy as np
+
+    return payload.tobytes() if isinstance(payload, np.ndarray) \
+        else bytes(payload)
+
 # fragment kinds (pml protocol headers ride in ``kind`` + ``meta``)
 MATCH = "match"          # eager: full payload, match on arrival
 RNDV = "rndv"            # rendezvous first fragment: header + head of data
@@ -24,8 +33,13 @@ CTL = "ctl"              # control (FT heartbeats, monitoring, osc)
 
 @dataclass
 class Frag:
-    """One wire fragment. ``data`` is bytes; ``meta`` is a small dict that
-    must stay picklable (it crosses process boundaries on tcp/sm)."""
+    """One wire fragment. ``data`` is bytes-like; ``meta`` is a small dict
+    that must stay picklable (it crosses process boundaries on tcp/sm).
+
+    ``borrowed`` marks ``data`` as a zero-copy view of the SENDER's user
+    buffer: valid only within the btl.send call (the wire/ring write is
+    the copy).  Anything that outlives the call — queueing, in-process
+    loopback delivery — must take ownership first (``own_data``)."""
 
     cid: int
     src: int              # world rank of sender
@@ -37,6 +51,15 @@ class Frag:
     total_len: int = 0    # full message length (rndv)
     offset: int = 0       # stream offset of this fragment (FRAG)
     meta: dict = field(default_factory=dict)
+    borrowed: bool = False
+
+    def own_data(self) -> None:
+        """Replace a borrowed view with an owned copy (idempotent)."""
+        if self.borrowed:
+            import numpy as np
+
+            self.data = np.array(self.data, copy=True)
+            self.borrowed = False
 
 
 @dataclass

@@ -23,11 +23,40 @@ from typing import Optional
 
 from ompi_tpu.base.containers import Fifo
 from ompi_tpu.base.var import VarType
-from ompi_tpu.mca.btl.base import Btl, Endpoint, Frag
+from ompi_tpu.mca.btl.base import Btl, Endpoint, Frag, owned_bytes
 
 _HDR = struct.Struct("<QQ")  # head, tail
 _LEN = struct.Struct("<I")
 _DATA_OFF = _HDR.size
+
+
+def _as_u8(payload) -> np.ndarray:
+    """Zero-copy uint8 view of any contiguous bytes-like payload."""
+    if isinstance(payload, np.ndarray):
+        return payload.reshape(-1).view(np.uint8)
+    return np.frombuffer(payload, np.uint8)
+
+
+def _frame_hdr(frag: Frag) -> bytes:
+    """Pickle the fragment's metadata WITHOUT the payload: the payload
+    rides raw after the header so large messages never pay the pickle
+    round trip (2 extra full-size copies at 512KB+)."""
+    return pickle.dumps(
+        (frag.cid, frag.src, frag.dst, frag.tag, frag.seq, frag.kind,
+         frag.total_len, frag.offset, frag.meta),
+        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _unframe(buf: np.ndarray) -> Frag:
+    """Rebuild a Frag from one popped frame; ``data`` is a zero-copy view
+    of the ring's REUSED scratch buffer, so the frag is ``borrowed``:
+    valid until the next pop — queue points must call ``own_data()``."""
+    (hlen,) = _LEN.unpack_from(buf, 0)
+    cid, src, dst, tag, seq, kind, total_len, offset, meta = \
+        pickle.loads(memoryview(buf)[_LEN.size:_LEN.size + hlen])
+    return Frag(cid, src, dst, tag, seq, kind,
+                buf[_LEN.size + hlen:], total_len, offset, meta,
+                borrowed=True)
 
 
 class _Ring:
@@ -46,6 +75,7 @@ class _Ring:
             _HDR.pack_into(shm.buf, 0, 0, 0)
         self._addr = None
         self._popbuf = None
+        self._framebuf = None
         try:
             from ompi_tpu import native
 
@@ -60,6 +90,41 @@ class _Ring:
 
     def _load(self) -> tuple[int, int]:
         return _HDR.unpack_from(self.shm.buf, 0)
+
+    def push_frame(self, hdr: bytes, payload) -> bool:
+        """Push one [u32 hlen][hdr][payload] frame; payload is any
+        bytes-like (ndarray views welcome — the gather-push copies them
+        straight into the ring, no Python-side concatenation)."""
+        a = _LEN.pack(len(hdr)) + hdr
+        if self._addr is not None:
+            return self._native.ring_push2(
+                self._addr, self.cap, np.frombuffer(a, np.uint8),
+                _as_u8(payload))
+        return self.push(a + owned_bytes(payload))
+
+    def pop_frame(self) -> Optional[np.ndarray]:
+        """Pop one frame into a REUSED scratch buffer; returns a view.
+
+        The view is valid until the next pop on this ring — receivers
+        must consume it synchronously or take an owned copy (the popped
+        Frag is marked ``borrowed`` accordingly).  Reuse matters: a fresh
+        1MB numpy allocation per frame costs more in page faults than the
+        copy itself."""
+        if self._addr is not None:
+            n = self._native.ring_peek_len(self._addr, self.cap)
+            if n < 0:
+                return None
+            buf = self._framebuf
+            if buf is None or len(buf) < n:
+                buf = self._framebuf = np.empty(
+                    max(n, 64 * 1024), np.uint8)
+            if self._native.ring_pop(self._addr, self.cap, buf) < 0:
+                return None
+            return buf[:n]
+        payload = self.pop()
+        if payload is None:
+            return None
+        return np.frombuffer(payload, np.uint8)
 
     def push(self, payload: bytes) -> bool:
         if self._addr is not None:
@@ -124,12 +189,14 @@ def _attach(name: str) -> shared_memory.SharedMemory:
 class SmBtl(Btl):
     name = "sm"
     priority = 50
-    # shared memory pays per-handoff (scheduling) cost, not per-byte:
-    # large fragments measure ~1.5x faster on the 4MB OSU point than the
-    # old 64k ones (see BENCH_SWEEP.md host rows)
-    eager_limit = 64 * 1024
-    rndv_eager_limit = 64 * 1024
-    max_send_size = 512 * 1024
+    # shared memory pays per-handoff (scheduling + matching) cost, not
+    # per-byte: with the zero-copy send path a single big eager frame is
+    # one ring write, while RNDV costs 3 handoffs — measured ~2x on the
+    # 512KB pingpong (see BENCH_SWEEP.md host rows).  The 4MB ring
+    # comfortably holds two in-flight 512KB frames per peer.
+    eager_limit = 512 * 1024
+    rndv_eager_limit = 512 * 1024
+    max_send_size = 1024 * 1024
     latency = 10          # below tcp (100), above self (0)
     bandwidth = 10000
 
@@ -139,6 +206,9 @@ class SmBtl(Btl):
         self._rings_in: dict[int, _Ring] = {}    # per-sender, I own these
         self._rings_out: dict[int, _Ring] = {}   # per-receiver, attached
         self._pending: dict[int, Fifo] = {}
+        self._db_rx: Optional[socket.socket] = None   # my doorbell
+        self._db_tx: Optional[socket.socket] = None   # ring peers' bells
+        self._db_addr: dict[int, str] = {}            # rank -> bell address
         # node identity, not raw hostname: OTPU_NODE_ID partitions ranks
         # into emulated nodes (tpurun --fake-nodes / multi-host launchers),
         # and shared memory must not be offered across that boundary so
@@ -160,7 +230,7 @@ class SmBtl(Btl):
                  "setup; rings are not resized after init)",
             on_set=lambda v: setattr(self, "_ring_size", int(v)))
         self.register_var(
-            "eager_limit", vtype=VarType.SIZE, default="64k",
+            "eager_limit", vtype=VarType.SIZE, default="512k",
             help="Max eager message size over sm",
             on_set=lambda v: setattr(self, "eager_limit", self._clamped(v)))
 
@@ -188,9 +258,39 @@ class SmBtl(Btl):
                 name=name, create=True, size=self._ring_size + _DATA_OFF)
             self._rings_in[src] = _Ring(shm, owner=True)
             names[src] = name
+        # doorbell: an abstract unix dgram socket peers ping after pushing
+        # a frame, so an idle receiver blocked in progress.idle_wait wakes
+        # immediately instead of sleeping out its backoff (the wakeup role
+        # the reference gets from libevent + btl_sm's fifo signalling)
+        db_name = None
+        try:
+            from ompi_tpu.runtime import progress as progress_mod
+
+            db = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+            db.setblocking(False)
+            db_name = f"\0otpu_db_{job}_{me}_{os.getpid() & 0xffff}"
+            db.bind(db_name)
+            self._db_rx = db
+            self._db_tx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+            self._db_tx.setblocking(False)
+            progress_mod.register_waiter(db)
+        except OSError:
+            self._db_rx = self._db_tx = None
+            db_name = None
         rte.modex_put("btl_sm_rings", {"host": self._hostname,
-                                       "names": names})
+                                       "names": names, "db": db_name})
         return True
+
+    def _ring_doorbell(self, rank: int, info: Optional[dict] = None) -> None:
+        if self._db_tx is None:
+            return
+        db = info.get("db") if info is not None else self._db_addr.get(rank)
+        if db is None:
+            return
+        try:
+            self._db_tx.sendto(b"x", db)
+        except OSError:
+            pass  # full/absent: receiver still polls on its own cadence
 
     def reachable(self, world_rank: int, rte) -> Optional[Endpoint]:
         if self._rte is None or world_rank == rte.my_world_rank:
@@ -212,24 +312,40 @@ class SmBtl(Btl):
             name = info["names"][self._rte.my_world_rank]
             ring = _Ring(_attach(name), owner=False)
             self._rings_out[rank] = ring
+            if info.get("db") is not None:
+                self._db_addr[rank] = info["db"]
         return ring
 
     def send(self, ep: Endpoint, frag: Frag) -> None:
         ring = self._ring_to(ep.world_rank, ep.addr)
-        payload = pickle.dumps(frag)
-        if not ring.push(payload):
-            self._pending.setdefault(ep.world_rank, Fifo()).push(payload)
+        hdr = _frame_hdr(frag)
+        if not ring.push_frame(hdr, frag.data):
+            # defer with an OWNED payload copy: the caller's request may
+            # complete (eager) and the user reuse the buffer before the
+            # retry fires from the progress loop
+            self._pending.setdefault(ep.world_rank, Fifo()).push(
+                (hdr, owned_bytes(frag.data)))
+        self._ring_doorbell(ep.world_rank, ep.addr)
 
     def progress(self) -> int:
         events = 0
+        # drain doorbell pings (edge signal only; frames carry the data)
+        if self._db_rx is not None:
+            while True:
+                try:
+                    self._db_rx.recv(512)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError:
+                    break
         # drain incoming rings
         for ring in self._rings_in.values():
             while True:
-                payload = ring.pop()
-                if payload is None:
+                buf = ring.pop_frame()
+                if buf is None:
                     break
                 if self._recv_cb is not None:
-                    self._recv_cb(pickle.loads(payload))
+                    self._recv_cb(_unframe(buf))
                     events += 1
         # retry pending writes
         for rank, fifo in self._pending.items():
@@ -237,15 +353,16 @@ class SmBtl(Btl):
             if ring is None:
                 continue
             while len(fifo):
-                payload = fifo.pop()
-                if not ring.push(payload):
+                hdr, payload = fifo.pop()
+                if not ring.push_frame(hdr, payload):
                     # put it back at the front by re-queueing a marker fifo
                     newf = Fifo()
-                    newf.push(payload)
+                    newf.push((hdr, payload))
                     while len(fifo):
                         newf.push(fifo.pop())
                     self._pending[rank] = newf
                     break
+                self._ring_doorbell(rank)
                 events += 1
         return events
 
@@ -269,6 +386,21 @@ class SmBtl(Btl):
                 break
             if self.progress() == 0:
                 _time.sleep(0.0005)
+        if self._db_rx is not None:
+            from ompi_tpu.runtime import progress as progress_mod
+
+            progress_mod.unregister_waiter(self._db_rx)
+            try:
+                self._db_rx.close()
+            except OSError:
+                pass
+            self._db_rx = None
+        if self._db_tx is not None:
+            try:
+                self._db_tx.close()
+            except OSError:
+                pass
+            self._db_tx = None
         for ring in self._rings_out.values():
             try:
                 ring.shm.close()

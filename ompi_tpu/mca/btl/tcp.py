@@ -86,6 +86,11 @@ class TcpBtl(Btl):
         self._listener = socket.create_server(("127.0.0.1", 0), backlog=64)
         self._listener.setblocking(False)
         self._sel.register(self._listener, selectors.EVENT_READ, "listener")
+        # idle waiters block on the listener too: an inbound connect (the
+        # peer's first message) must wake a sleeping receiver
+        from ompi_tpu.runtime import progress as progress_mod
+
+        progress_mod.register_waiter(self._listener)
         rte.modex_put("btl_tcp_addr", self._listener.getsockname())
         return True
 
@@ -137,9 +142,11 @@ class TcpBtl(Btl):
             try:
                 sock = socket.create_connection(tuple(addr), timeout=5)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                # handshake: tell the peer who we are
+                # handshake: tell the peer who we are (framed like any
+                # fragment: header pickle + empty payload)
                 hello = pickle.dumps({"rank": self._rte.my_world_rank})
-                sock.sendall(_LEN.pack(len(hello)) + hello)
+                sock.sendall(_LEN.pack(_LEN.size + len(hello))
+                             + _LEN.pack(len(hello)) + hello)
             except OSError:
                 if sock is not None:
                     try:
@@ -152,6 +159,9 @@ class TcpBtl(Btl):
             conn = _Conn(sock, rank)
             sock.setblocking(False)
             self._sel.register(sock, selectors.EVENT_READ, conn)
+            from ompi_tpu.runtime import progress as progress_mod
+
+            progress_mod.register_waiter(sock)
             self._by_rank[rank] = conn
             return conn
 
@@ -169,9 +179,24 @@ class TcpBtl(Btl):
                     f"no established connection to rank {ep.world_rank}")
         else:
             conn = self._connect(ep.world_rank, best_effort=ft)
-        payload = pickle.dumps(frag)
+        # wire format: [u32 frame][u32 hlen][hdr pickle][payload raw] —
+        # splitting the payload out of the pickle saves a full-size copy
+        # per fragment on both ends (same framing as btl/sm)
+        hdr = pickle.dumps(
+            (frag.cid, frag.src, frag.dst, frag.tag, frag.seq, frag.kind,
+             frag.total_len, frag.offset, frag.meta),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        # the outbuf append IS the owning copy (and happens synchronously,
+        # inside a borrowed view's validity window); memoryview routes an
+        # ndarray through the buffer protocol instead of ndarray.__radd__
+        payload = frag.data
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            payload = memoryview(payload)
         with conn.send_lock:
-            conn.outbuf += _LEN.pack(len(payload)) + payload
+            conn.outbuf += _LEN.pack(_LEN.size + len(hdr) + len(payload))
+            conn.outbuf += _LEN.pack(len(hdr))
+            conn.outbuf += hdr
+            conn.outbuf += payload
             self._flush_locked(conn)
 
     def _flush(self, conn: _Conn) -> None:
@@ -212,6 +237,9 @@ class TcpBtl(Btl):
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn = _Conn(sock)
                 self._sel.register(sock, selectors.EVENT_READ, conn)
+                from ompi_tpu.runtime import progress as progress_mod
+
+                progress_mod.register_waiter(sock)
                 continue
             conn: _Conn = key.data
             try:
@@ -221,6 +249,9 @@ class TcpBtl(Btl):
             except OSError:
                 data = b""
             if not data:
+                from ompi_tpu.runtime import progress as progress_mod
+
+                progress_mod.unregister_waiter(conn.sock)
                 try:
                     self._sel.unregister(conn.sock)
                     conn.sock.close()
@@ -237,6 +268,8 @@ class TcpBtl(Btl):
         return events
 
     def _drain(self, conn: _Conn) -> int:
+        import numpy as np
+
         events = 0
         while True:
             if len(conn.inbuf) < _LEN.size:
@@ -244,16 +277,22 @@ class TcpBtl(Btl):
             (n,) = _LEN.unpack(conn.inbuf[:_LEN.size])
             if len(conn.inbuf) < _LEN.size + n:
                 return events
-            payload = bytes(conn.inbuf[_LEN.size:_LEN.size + n])
+            frame = bytes(conn.inbuf[_LEN.size:_LEN.size + n])
             del conn.inbuf[:_LEN.size + n]
-            obj = pickle.loads(payload)
+            (hlen,) = _LEN.unpack_from(frame, 0)
+            obj = pickle.loads(memoryview(frame)[_LEN.size:_LEN.size + hlen])
             if isinstance(obj, dict) and "rank" in obj and conn.rank is None:
                 conn.rank = obj["rank"]
                 # keep at most one conn per rank (cross-connect resolution)
                 self._by_rank.setdefault(conn.rank, conn)
                 continue
+            cid, src, dst, tag, seq, kind, total_len, offset, meta = obj
+            frag = Frag(cid, src, dst, tag, seq, kind,
+                        np.frombuffer(frame, np.uint8,
+                                      offset=_LEN.size + hlen),
+                        total_len, offset, meta)
             if self._recv_cb is not None:
-                self._recv_cb(obj)
+                self._recv_cb(frag)
                 events += 1
 
     def close(self) -> None:
@@ -267,14 +306,24 @@ class TcpBtl(Btl):
                     self._flush(conn)
             if any(c.outbuf for c in self._by_rank.values()):
                 time.sleep(0.0005)
-        for conn in list(self._by_rank.values()):
+        from ompi_tpu.runtime import progress as progress_mod
+
+        # every registered socket — including accepted-but-unhandshaked
+        # conns that never made it into _by_rank — must leave the global
+        # waiter selector, or their EOF-readable fds make idle_wait()
+        # busy-spin forever after this btl is gone
+        for key in list(self._sel.get_map().values()):
+            if key.data == "listener":
+                continue
+            progress_mod.unregister_waiter(key.fileobj)
             try:
-                self._sel.unregister(conn.sock)
-                conn.sock.close()
+                self._sel.unregister(key.fileobj)
+                key.fileobj.close()
             except (OSError, KeyError):
                 pass
         self._by_rank.clear()
         if self._listener is not None:
+            progress_mod.unregister_waiter(self._listener)
             try:
                 self._sel.unregister(self._listener)
                 self._listener.close()

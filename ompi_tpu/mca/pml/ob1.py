@@ -208,9 +208,12 @@ class Ob1Pml:
             (comm.cid, src_world, dst_world), itertools.count()))
         spc.record("bytes_sent", req.nbytes)
         if req.nbytes <= ep.btl.eager_limit and not sync:
-            # eager: single MATCH fragment, complete immediately
+            # eager: single MATCH fragment, complete immediately.  The
+            # payload is a borrowed view when the layout allows it — the
+            # btl's wire/ring write is the only copy (send-in-place)
+            data, borrowed = req.convertor.pack_borrow()
             frag = Frag(comm.cid, src_world, dst_world, tag, seq, MATCH,
-                        req.convertor.pack(), total_len=req.nbytes)
+                        data, total_len=req.nbytes, borrowed=borrowed)
             ep.btl.send(ep, frag)
             req.complete()
             if peruse.active():
@@ -224,11 +227,12 @@ class Ob1Pml:
 
             memchecker.protect_send(req, buf)
             try:
-                head = req.convertor.pack(ep.btl.rndv_eager_limit)
+                head, borrowed = req.convertor.pack_borrow(
+                    ep.btl.rndv_eager_limit)
                 self._send_reqs[req.req_id] = req
                 frag = Frag(comm.cid, src_world, dst_world, tag, seq, RNDV,
                             head, total_len=req.nbytes,
-                            meta={"req_id": req.req_id})
+                            meta={"req_id": req.req_id}, borrowed=borrowed)
                 ep.btl.send(ep, frag)
             except Exception:
                 # failed setup: the request will never complete, so the
@@ -253,10 +257,11 @@ class Ob1Pml:
         ep = self.bml.endpoint(dst_world)
         while not req.convertor.finished:
             off = req.convertor.position
-            data = req.convertor.pack(ep.btl.max_send_size)
+            data, borrowed = req.convertor.pack_borrow(ep.btl.max_send_size)
             ep.btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
                                  -1, 0, FRAG, data, total_len=req.nbytes,
-                                 offset=off, meta={"req_id": peer_req}))
+                                 offset=off, meta={"req_id": peer_req},
+                                 borrowed=borrowed))
         self._send_reqs.pop(req.req_id, None)
         req.complete()
         if peruse.active():
@@ -378,6 +383,7 @@ class Ob1Pml:
         if frag.kind == CTL:
             handler = _ctl_handlers.get(frag.meta.get("proto"))
             if handler is not None:
+                frag.own_data()   # handlers may stash the payload
                 handler(frag)
             return
         key = (frag.cid, frag.dst)
@@ -393,7 +399,9 @@ class Ob1Pml:
             st = self._match.setdefault(key, _MatchState())
             expected = st.expected_seq.get(frag.src, 0)
             if frag.seq != expected:
-                # out-of-order arrival: hold by seq (recvfrag.c:106-147)
+                # out-of-order arrival: hold by seq (recvfrag.c:106-147);
+                # held data must outlive the sender's btl.send call
+                frag.own_data()
                 spc.record("out_of_sequence_msgs")
                 st.ooo.setdefault(frag.src, {})[frag.seq] = frag
                 return
@@ -431,6 +439,7 @@ class Ob1Pml:
                 self._deliver_to_request(req, frag, events)
                 return
         spc.record("unexpected_msgs")
+        frag.own_data()   # queued past the sender's btl.send call
         st.unexpected.append(frag)
         if peruse.active():
             events.append((peruse.MSG_INSERT_IN_UNEX_Q, frag.cid,

@@ -70,6 +70,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.otpu_ring_push.restype = ctypes.c_int
         lib.otpu_ring_push.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, _U8P, ctypes.c_uint64]
+        lib.otpu_ring_push2.restype = ctypes.c_int
+        lib.otpu_ring_push2.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, _U8P, ctypes.c_uint64,
+            _U8P, ctypes.c_uint64]
+        lib.otpu_ring_peek_len.restype = ctypes.c_int64
+        lib.otpu_ring_peek_len.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.otpu_ring_pop.restype = ctypes.c_int64
         lib.otpu_ring_pop.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, _U8P, ctypes.c_uint64]
@@ -166,6 +172,19 @@ def atomic_store_u64(addr: int, v: int) -> None:
 def ring_push(buf_addr: int, cap: int, payload: np.ndarray) -> bool:
     lib = _load()
     return bool(lib.otpu_ring_push(buf_addr, cap, payload, len(payload)))
+
+
+def ring_push2(buf_addr: int, cap: int, a: np.ndarray,
+               b: np.ndarray) -> bool:
+    """Gather-push one frame from two buffers (header + payload)."""
+    lib = _load()
+    return bool(lib.otpu_ring_push2(buf_addr, cap, a, len(a), b, len(b)))
+
+
+def ring_peek_len(buf_addr: int, cap: int) -> int:
+    """Next complete frame's length, or -1 when none is ready."""
+    lib = _load()
+    return int(lib.otpu_ring_peek_len(buf_addr, cap))
 
 
 def ring_pop(buf_addr: int, cap: int, out: np.ndarray) -> int:

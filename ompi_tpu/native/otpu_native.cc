@@ -91,6 +91,49 @@ int otpu_ring_push(uint8_t *buf, uint64_t cap, const uint8_t *payload,
     return 1;
 }
 
+// Gather-push: one frame from two source buffers (header + payload),
+// written back-to-back so the caller never has to concatenate them in
+// Python (the concatenation would copy the payload an extra time).
+int otpu_ring_push2(uint8_t *buf, uint64_t cap,
+                    const uint8_t *a, uint64_t alen,
+                    const uint8_t *b, uint64_t blen) {
+    uint64_t head = load_acq(buf);
+    uint64_t tail = load_acq(buf + 8);
+    uint64_t n = alen + blen;
+    if (4 + n > cap - (tail - head))
+        return 0;
+    uint8_t *data = buf + 16;
+    uint32_t len32 = (uint32_t)n;
+    ring_write(data, cap, tail, (const uint8_t *)&len32, 4);
+    ring_write(data, cap, tail + 4, a, alen);
+    ring_write(data, cap, tail + 4 + alen, b, blen);
+    store_rel(buf + 8, tail + 4 + n);
+    return 1;
+}
+
+// Length of the next complete frame, or -1 when none is ready — lets the
+// consumer allocate an exact-size owned buffer before popping (so frame
+// payloads can be delivered as zero-copy views of that buffer).
+int64_t otpu_ring_peek_len(const uint8_t *buf, uint64_t cap) {
+    uint64_t head = load_acq(buf);
+    uint64_t tail = load_acq(buf + 8);
+    if (tail - head < 4)
+        return -1;
+    const uint8_t *data = buf + 16;
+    uint32_t len32;
+    uint64_t p = head % cap;
+    uint8_t tmp[4];
+    uint64_t first = 4 < cap - p ? 4 : cap - p;
+    std::memcpy(tmp, data + p, (size_t)first);
+    if (first < 4)
+        std::memcpy(tmp + first, data, (size_t)(4 - first));
+    std::memcpy(&len32, tmp, 4);
+    uint64_t n = len32;
+    if (tail - head < 4 + n)
+        return -1;          // producer mid-frame
+    return (int64_t)n;
+}
+
 int64_t otpu_ring_pop(uint8_t *buf, uint64_t cap, uint8_t *out,
                       uint64_t out_cap) {
     uint64_t head = load_acq(buf);

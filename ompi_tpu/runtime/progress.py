@@ -10,7 +10,9 @@ collective schedules (libnbc equivalent), FT heartbeats, RMA passive targets.
 """
 from __future__ import annotations
 
+import selectors
 import threading
+import time
 from typing import Callable
 
 _LOW_PRIORITY_CADENCE = 8  # opal_progress.c:227
@@ -20,6 +22,46 @@ _callbacks: list[Callable[[], int]] = []
 _lp_callbacks: list[Callable[[], int]] = []
 _counter = 0
 _in_progress = threading.local()
+
+# -- event-based idle wait (the libevent role in opal_progress) ----------
+#
+# Transports register a readable fd that goes hot when work arrives (the
+# btl/sm doorbell socket, tcp data sockets).  An idle waiter blocks in
+# select() on these instead of sleeping blind: message arrival wakes it
+# in ~10µs instead of a scheduler-quantum-sized nap — the difference
+# between µs and ms per rendezvous round-trip on an oversubscribed host.
+_waiter_sel = selectors.DefaultSelector()
+_waiter_count = 0
+
+
+def register_waiter(fileobj) -> None:
+    global _waiter_count
+    with _lock:
+        _waiter_sel.register(fileobj, selectors.EVENT_READ)
+        _waiter_count += 1
+
+
+def unregister_waiter(fileobj) -> None:
+    global _waiter_count
+    with _lock:
+        try:
+            _waiter_sel.unregister(fileobj)
+            _waiter_count -= 1
+        except KeyError:
+            pass
+
+
+def idle_wait(timeout: float) -> bool:
+    """Block until a transport fd is readable or ``timeout`` elapses.
+    Returns True when woken by an fd (caller should poll progress)."""
+    if _waiter_count == 0:
+        time.sleep(timeout)
+        return False
+    try:
+        return bool(_waiter_sel.select(timeout))
+    except OSError:
+        time.sleep(timeout)
+        return False
 
 
 def register(cb: Callable[[], int], low_priority: bool = False) -> None:

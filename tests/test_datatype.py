@@ -43,10 +43,10 @@ def _roundtrip(dt, count, buf_elems=None, chunk=None):
     cp = Convertor(dt, count, src)
     packed = b""
     if chunk is None:
-        packed = cp.pack()
+        packed = cp.pack().tobytes()
     else:
         while not cp.finished:
-            packed += cp.pack(chunk)
+            packed += cp.pack(chunk).tobytes()
     assert len(packed) == count * dt.size
     cu = Convertor(dt, count, dst)
     if chunk is None:
@@ -202,7 +202,7 @@ def test_external32_chunks_stay_item_aligned():
     c = Convertor(FLOAT64, 10, data.copy(), flags=ConvertorFlags.EXTERNAL32)
     chunks = []
     while not c.finished:
-        chunks.append(c.pack(13))  # 13 rounds down to 8
+        chunks.append(c.pack(13).tobytes())  # 13 rounds down to 8
     assert all(len(ch) % 8 == 0 for ch in chunks[:-1])
     joined = b"".join(chunks)
     assert np.frombuffer(joined, ">f8").tolist() == data.tolist()
@@ -226,7 +226,7 @@ def test_large_datatype():
     c = Convertor(dt, 1, src)
     out = bytearray()
     while not c.finished:
-        out += c.pack(1 << 20)
+        out += memoryview(c.pack(1 << 20))
     np.testing.assert_array_equal(np.frombuffer(out, np.float32), src)
 
 

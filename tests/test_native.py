@@ -31,7 +31,7 @@ def test_pack_unpack_matches_numpy():
             cp.prepare(mem.copy())
             cp._native = False
             a, b = cn.pack(), cp.pack()
-            assert a == b, (dt.name, count)
+            assert np.array_equal(a, b), (dt.name, count)
             dn, dp = np.zeros(8192, np.uint8), np.zeros(8192, np.uint8)
             un = cv.Convertor(dt, count)
             un.prepare(dn)
@@ -57,7 +57,7 @@ def test_partial_pack_resume_with_native():
         c._native = flag
         chunks = []
         while not c.finished:
-            chunks.append(c.pack(37))
+            chunks.append(c.pack(37).tobytes())
         stream = b"".join(chunks)
         if flag:
             native_stream = stream
@@ -112,6 +112,15 @@ def test_python_and_native_rings_interoperate():
         assert pyr.pop() == b"from-native"
         pyr.push(b"from-python")
         assert nat.pop() == b"from-python"
+        # framed (header+payload) push/pop must interoperate the same way
+        nat.push_frame(b"hdr-n", b"payload-from-native")
+        frame = pyr.pop_frame()
+        assert frame is not None and frame.tobytes().endswith(
+            b"payload-from-native")
+        pyr.push_frame(b"hdr-p", b"payload-from-python")
+        frame = nat.pop_frame()
+        assert frame is not None and frame.tobytes().endswith(
+            b"payload-from-python")
     finally:
         shm.close()
         shm.unlink()

@@ -1,0 +1,65 @@
+"""Host-path performance regression guards.
+
+Round-2 review found `allreduce_host_tuned` collapsing superlinearly at
+4MB (265ms on the 1-core VM — ~12x worse per byte than the 256KB point).
+The fixes (escalating idle backoff + doorbell wakeups, header/payload
+split frames, contiguous-datatype fast paths, zero-copy eager sends,
+scratch-buffer reuse) brought it to ~40ms.  These guards pin the shape of
+the curve, not absolute speed: per-byte cost may not regress superlinearly
+again.  Mirrors the linear degradation of the reference's ring
+(``coll_base_allreduce.c:341``) under fixed bandwidth.
+"""
+import json
+import os
+import statistics
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = textwrap.dedent("""
+    import json, statistics, time
+    import numpy as np, ompi_tpu
+
+    w = ompi_tpu.init()
+    out = []
+    for nbytes in (262144, 4194304):
+        x = np.ones(nbytes // 4, np.float32)
+        for _ in range(2):
+            w.allreduce(x)
+        lat = []
+        for _ in range(5):
+            w.barrier()
+            t0 = time.perf_counter()
+            w.allreduce(x)
+            lat.append(time.perf_counter() - t0)
+        out.append((nbytes, statistics.median(lat)))
+    if w.rank == 0:
+        print("GUARD " + json.dumps(out))
+    ompi_tpu.finalize()
+""")
+
+
+def test_allreduce_per_byte_cost_stays_linear(tmp_path):
+    script = tmp_path / "guard.py"
+    script.write_text(_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "4",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = next(ln for ln in r.stdout.splitlines() if "GUARD" in ln)
+    (small_b, small_t), (big_b, big_t) = json.loads(
+        line.split("GUARD ", 1)[1])
+    per_byte_small = small_t / small_b
+    per_byte_big = big_t / big_b
+    # superlinear collapse guard: 16x the bytes may cost at most ~3x more
+    # per byte (scheduling noise margin included; the round-2 pathology
+    # measured ~12x)
+    assert per_byte_big <= 3.5 * per_byte_small, (
+        f"per-byte cost grew {per_byte_big / per_byte_small:.1f}x "
+        f"from 256KB ({small_t * 1e3:.1f}ms) to 4MB ({big_t * 1e3:.1f}ms)")
+    # absolute backstop well above today's ~40ms, far below the 265ms bug
+    assert big_t < 0.12, f"4MB allreduce took {big_t * 1e3:.0f}ms"
