@@ -1,0 +1,157 @@
+"""coll/pallas — explicit remote-DMA ring collectives (ICI p2p path).
+
+Slots in below coll/xla (priority 85 < 90): XLA's compiler-scheduled
+collectives stay the default, and this component is the explicit-schedule
+alternative — ring allreduce / all-gather / neighbor permute written
+directly against the interconnect with ``pltpu.make_async_remote_copy``
+(``ompi_tpu/ops/pallas_collectives.py``).  Raise
+``--mca coll_pallas_priority 95`` to make it own those three slots; any
+call shape it does not cover (non-sum ops, general permutations, host
+buffers) delegates to the next module in the comm's stack, the way
+coll/tuned falls through to coll/basic.
+
+Capability probe: real multi-chip TPU runs the compiled kernels;
+elsewhere (tests, virtual CPU meshes) they run in Pallas interpreter
+mode — override with ``--mca coll_pallas_interpret 0/1``.
+
+Reference slot: the explicit BTL RDMA transport
+(``opal/mca/btl/btl.h:949,987``) + its ring schedules
+(``coll_base_allreduce.c:341``), per SURVEY.md §2.6's "Pallas remote
+DMA" row.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.api import op as op_mod
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType
+
+
+class PallasCollModule:
+    def __init__(self, comm, devices, axis_name: str, interpret: bool,
+                 max_bytes: int) -> None:
+        import jax
+        from jax.sharding import Mesh
+
+        self.devices = list(devices)
+        self.axis = axis_name
+        self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        self.n = len(self.devices)
+        self.interpret = interpret
+        self.max_bytes = max_bytes
+        self._jax_array = jax.Array
+        self._fallback = None   # resolved at comm_enable
+
+    def comm_enable(self, comm) -> None:
+        # next-lower provider of the device-array slots (normally
+        # coll/xla): unsupported calls fall through to it
+        from ompi_tpu.mca.coll.xla import XlaCollModule
+
+        self._fallback = next(
+            (m for m in comm.coll_modules if isinstance(m, XlaCollModule)),
+            None)
+
+    # -- helpers ---------------------------------------------------------
+    def _delegate(self, name, comm, x, *args):
+        if self._fallback is None:
+            from ompi_tpu.api.errors import ErrorClass, MpiError
+
+            raise MpiError(ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                           f"coll/pallas cannot run {name} and no "
+                           "fallback module is present")
+        return getattr(self._fallback, name)(comm, x, *args)
+
+    def _place(self, comm, x):
+        if isinstance(x, self._jax_array):
+            return x
+        if self._fallback is not None:
+            return self._fallback._check(comm, x)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            np.asarray(x), NamedSharding(self.mesh, P(self.axis)))
+
+    def _supported(self, x) -> bool:
+        return (x.dtype.kind == "f"
+                and x.nbytes // max(1, self.n) <= self.max_bytes)
+
+    # -- collective slots ------------------------------------------------
+    def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        x = self._place(comm, x)
+        if op is not op_mod.SUM or not self._supported(x):
+            return self._delegate("allreduce_array", comm, x, op)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        return pc.all_reduce_sum(x, self.mesh, self.axis,
+                                 interpret=self.interpret)
+
+    def allgather_array(self, comm, x):
+        x = self._place(comm, x)
+        if not self._supported(x):
+            return self._delegate("allgather_array", comm, x)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        return pc.all_gather(x, self.mesh, self.axis,
+                             interpret=self.interpret)
+
+    def ppermute_array(self, comm, x, perm):
+        perm = tuple((int(s), int(d)) for s, d in perm)
+        rot = tuple((i, (i + 1) % self.n) for i in range(self.n))
+        x = self._place(comm, x)
+        if perm != rot or not self._supported(x):
+            return self._delegate("ppermute_array", comm, x, perm)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        return pc.right_permute(x, self.mesh, self.axis,
+                                interpret=self.interpret)
+
+
+class PallasCollComponent(Component):
+    name = "pallas"
+    priority = 85
+
+    def register_vars(self, fw) -> None:
+        self._prio = self.register_var(
+            "priority", vtype=VarType.INT, default=85,
+            help="Selection priority of coll/pallas (explicit remote-DMA "
+                 "ring collectives); raise above coll/xla's 90 to select")
+        self._interpret = self.register_var(
+            "interpret", vtype=VarType.STRING, default="auto",
+            help="Run kernels in Pallas interpreter mode: auto = only off "
+                 "real TPU devices, 0/1 to force")
+        self._max = self.register_var(
+            "max_bytes", vtype=VarType.SIZE, default="8m",
+            help="Largest per-rank payload routed to the DMA ring (the "
+                 "accumulator lives in VMEM); bigger calls fall through "
+                 "to coll/xla")
+        self._axis = self.register_var(
+            "axis_name", default="mpi",
+            help="Mesh axis name for coll/pallas kernels")
+
+    def _interpret_mode(self, devices) -> bool:
+        v = str(self._interpret.value or "auto").strip().lower()
+        if v in ("0", "false", "no"):
+            return False
+        if v in ("1", "true", "yes"):
+            return True
+        return not all(
+            getattr(d, "platform", "") == "tpu" for d in devices)
+
+    def comm_query(self, comm):
+        rte = comm.rte
+        if rte is None or not rte.is_device_world:
+            return None
+        try:
+            devices = [rte.device_of(r) for r in comm.group.world_ranks]
+        except Exception:
+            return None
+        if not devices or any(d is None for d in devices):
+            return None
+        return self._prio.value, PallasCollModule(
+            comm, devices, self._axis.value,
+            self._interpret_mode(devices), int(self._max.value))
+
+
+COMPONENT = PallasCollComponent()

@@ -1,0 +1,287 @@
+"""Pallas remote-DMA ring collectives — the explicit ICI transport path.
+
+The reference's lowest layer is an explicit transport with RDMA verbs
+(``/root/reference/opal/mca/btl/btl.h:949`` put / ``:987`` get); its
+collectives are schedules of those verbs over a topology.  coll/xla rides
+XLA's compiler-scheduled collectives instead — this module is the
+explicit-schedule twin: ring algorithms written directly against the ICI
+with ``pltpu.make_async_remote_copy`` (one-sided remote DMA + send/recv
+semaphore discipline), the TPU-native form of the reference's
+``btl_put``-based ring (``coll_base_allreduce.c:341``).
+
+Why have both: XLA's collectives are near-optimal for the standard cases,
+but an explicit schedule composes with compute inside ONE kernel (overlap
+of reduce + forward per ring step, custom quantized wire formats, PP
+activation handoff fused into the stage loop) — the knob the reference
+keeps by owning its transport.  SURVEY.md §2.6 maps this slot to "Pallas
+remote DMA".
+
+All kernels are SPMD under ``shard_map`` over a 1-D mesh axis; payloads
+are split into per-device ring blocks outside the kernel.  They run in
+interpreter mode on a virtual CPU mesh (tests) and compile for real
+multi-chip ICI unchanged.  VMEM bounds the block size (the accumulator
+lives on-chip): huge payloads belong to coll/xla — the component's
+``max_bytes`` var gates selection accordingly.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def _mods():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return jax, jnp, lax, pl, pltpu
+
+
+def _ring_kernels(n: int, axis: str, interpret: bool):
+    """Build the kernel-constructor namespace once per (n, axis, mode)."""
+    jax, jnp, lax, pl, pltpu = _mods()
+
+    def compiler_params():
+        if interpret:
+            return None
+        return pltpu.CompilerParams(has_side_effects=True, collective_id=0)
+
+    return jax, jnp, lax, pl, pltpu, compiler_params
+
+
+@functools.lru_cache(maxsize=64)
+def _build_right_permute(n: int, axis: str, shape, dtype_str: str,
+                         interpret: bool):
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=out_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+
+    def call(x):
+        kw = {}
+        cp = cparams()
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(shape, dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
+def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
+                      interpret: bool):
+    """Ring all-gather: n-1 steps, each forwarding the freshest block to
+    the right neighbor (``jax docs distributed`` canonical schedule; the
+    reference's ``coll_base_allgather.c`` ring)."""
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+
+    def kernel(x_ref, out_ref, local_sem, send_sem, recv_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        cp = pltpu.make_async_copy(x_ref, out_ref.at[my], local_sem)
+        cp.start()
+        cp.wait()
+
+        def step(k, carry):
+            slot = lax.rem(my - k + n, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[slot], dst_ref=out_ref.at[slot],
+                send_sem=send_sem, recv_sem=recv_sems.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait()   # send done + block (my-k-1) landed from the left
+            return carry
+
+        lax.fori_loop(0, n - 1, step, 0)
+
+    def call(x):
+        kw = {}
+        cp = cparams()
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n,) + blk_shape, dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
+def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
+                      interpret: bool):
+    """Ring all-reduce (sum): n-1 reduce-scatter steps with the add fused
+    into the ring loop, then n-1 all-gather steps — one kernel, the
+    explicit-DMA form of ``coll_base_allreduce.c:341``.
+
+    Per-device payload is pre-shaped to (n, blk).  Distinct recv slots
+    per step (scratch (n-1, blk)) make the schedule self-synchronizing:
+    no slot is ever reused, so the send/recv semaphore pair is the only
+    ordering needed (the capacity/backpressure dance of a 2-slot scheme
+    is deliberately traded for VMEM).
+    """
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+
+    def kernel(x_ref, out_ref, acc_ref, recv_ref,
+               local_sem, send_sem, rs_sems, ag_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
+        cp.start()
+        cp.wait()
+
+        # -- reduce-scatter phase -------------------------------------
+        def rs_step(k, carry):
+            send_idx = lax.rem(my - k + n, n)
+            recv_idx = lax.rem(my - k - 1 + n, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=acc_ref.at[send_idx], dst_ref=recv_ref.at[k],
+                send_sem=send_sem, recv_sem=rs_sems.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait()   # my partial for block recv_idx arrived
+            part = recv_ref[pl.ds(k, 1), :]
+            cur = acc_ref[pl.ds(recv_idx, 1), :]
+            acc_ref[pl.ds(recv_idx, 1), :] = cur + part
+            return carry
+
+        lax.fori_loop(0, n - 1, rs_step, 0)
+
+        # after n-1 steps block (my+1)%n is fully reduced here
+        done = lax.rem(my + 1, n)
+        cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref.at[done],
+                                    local_sem)
+        cp2.start()
+        cp2.wait()
+
+        # -- all-gather phase -----------------------------------------
+        def ag_step(k, carry):
+            fwd = lax.rem(my + 1 - k + n, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[fwd], dst_ref=out_ref.at[fwd],
+                send_sem=send_sem, recv_sem=ag_sems.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait()   # completed block (my-k)%n landed from the left
+            return carry
+
+        lax.fori_loop(0, n - 1, ag_step, 0)
+
+    def call(x):  # x: (n, blk) per device
+        kw = {}
+        cp = cparams()
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.VMEM((n, blk), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((n - 1, blk), jnp.dtype(dtype_str)),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
+# -- public entry points (shard_map wrappers) ----------------------------
+
+def right_permute(x, mesh, axis: str, interpret: bool = True):
+    """Rotate the leading (rank) axis by +1 via neighbor remote DMA —
+    the PP activation-handoff primitive (``lax.ppermute`` twin)."""
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+    shard_shape = (1,) + tuple(x.shape[1:])
+    fn = _build_right_permute(n, axis, shard_shape, str(x.dtype), interpret)
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False))(x)
+
+
+def all_gather(x, mesh, axis: str, interpret: bool = True):
+    """(n, *S) sharded -> (n, *S) replicated via the DMA ring."""
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+    blk_shape = tuple(x.shape[1:])
+    inner = _build_all_gather(n, axis, blk_shape, str(x.dtype), interpret)
+
+    def body(t):                       # t: (1, *S)
+        return inner(t[0])             # (n, *S)
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(), check_vma=False))(x)
+
+
+def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):
+    """(n, *S) sharded -> (*S) replicated sum via the fused ring kernel.
+
+    The per-rank payload is flattened and zero-padded to n equal ring
+    blocks outside the kernel (XLA fuses the pad/reshape into the
+    surrounding program)."""
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    payload_shape = tuple(x.shape[1:])
+    if n == 1:
+        return x.reshape(payload_shape)
+    size = int(np.prod(payload_shape)) if payload_shape else 1
+    blk = -(-size // n)                # ceil
+    padded = blk * n
+    inner = _build_all_reduce(n, axis, blk, str(x.dtype), interpret)
+
+    def body(t):                       # t: (1, *S)
+        flat = t.reshape(-1)
+        if padded != size:
+            flat = jnp.pad(flat, (0, padded - size))
+        out = inner(flat.reshape(n, blk))      # (n, blk) reduced
+        return out.reshape(-1)[:size].reshape(payload_shape)
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(), check_vma=False))(x)
