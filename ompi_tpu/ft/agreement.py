@@ -160,13 +160,12 @@ def agree_tree(
 
     # STATIC binomial tree over participants: parent clears the lowest
     # set bit; vrank v owns children v + 2^k for k below v's lowest set
-    # bit (all bits for the root) — the coll_base_topo binomial shape
-    n = len(participants)
-    idx = participants.index(me) if me in participants else 0
-    max_k = _lowbit(idx) if idx else max(1, n - 1).bit_length()
-    children = [participants[idx + (1 << k)] for k in range(max_k)
-                if idx + (1 << k) < n]
-    parent = None if idx == 0 else participants[idx & (idx - 1)]
+    # bit (all bits for the root) — the coll_base_topo binomial shape,
+    # shared with agree_p2p via _p2p_tree
+    if me in participants:
+        parent, children, _ = _p2p_tree(participants, me)
+    else:
+        parent, children = None, []
 
     coverage = {me}
     acc = contribution
@@ -282,6 +281,8 @@ def _lowbit(x: int) -> int:
 
 def _decide(rte, instance, participants, combine, deadline, poll):
     """Coordinator side: gather live contributions, reduce, decide."""
+    import time as _time
+
     ckey = _key(instance, "c")
     values: dict[int, Any] = {}
     known_failed: set[int] = set()
@@ -298,13 +299,351 @@ def _decide(rte, instance, participants, combine, deadline, poll):
                 still.append(r)
         pending = still
         if pending:
-            if time.monotonic() > deadline:
+            if _time.monotonic() > deadline:
                 raise AgreementError(
                     f"agreement {instance} timed out waiting for {pending}")
-            time.sleep(poll)
+            _time.sleep(poll)
     out = None
     for r in sorted(values):
         out = values[r] if out is None else combine(out, values[r])
     known_failed.update(r for r in participants
                         if ft_state.is_failed(r))
     return out, frozenset(known_failed)
+
+
+
+
+# ======================================================================
+# agree_p2p — ERA-grade agreement with NO coordination-service dependency
+# ======================================================================
+#
+# The decision path of ``coll_ftagree_earlyreturning.c`` never touches an
+# out-of-band server: contributions reduce up a tree of survivors, the
+# root runs a prepare/ack/commit round, and stragglers pull the outcome
+# with queries ("early return" — ``:34-36`` keeps per-agreement hash
+# tables of passed/ongoing instances exactly for those late queries).
+# This is that protocol over the pml's CTL carrier:
+#
+# - values are IDEMPOTENT {rank: contribution} dicts (merge-safe), so
+#   tree rebalancing can never double-count a partial;
+# - fast path: static binomial-tree reduce (subtree-complete dicts sent
+#   up), root acts when its dict covers every live participant;
+# - TWO-PHASE uniformity: the root first broadcasts PREPARE(D) and waits
+#   for an ack from every live participant; only then does it commit
+#   (DECISION) and return.  No rank returns before the commit exists, and
+#   the commit exists only once every survivor holds the prepared value —
+#   so a takeover root is guaranteed to find the value (prepared or
+#   committed) at some survivor whenever ANY rank (alive or since dead)
+#   can have returned it.  This is ERA's ack/commit round; without it a
+#   root that decides, returns, and dies forks the outcome;
+# - failure recovery: on any failure-knowledge change every undecided
+#   rank pushes its dict DIRECTLY to the current root (lowest live);
+#   a takeover root must collect a reply (decision / prepared /
+#   explicit "undecided") from EVERY live participant before preparing
+#   fresh — adopt-before-recompute;
+# - late-frame guards: prepare/decision from a known-failed IMMEDIATE
+#   sender is discarded, and after answering a takeover root R's query a
+#   rank rejects prepare/decision stamped by any earlier root ("pledge");
+#   both lean on the perfect-detector assumption ULFM itself makes;
+# - GC: the ``prev_instance`` seq-2 contract of agree_kv, plus an LRU cap
+#   on completed instances kept for early-return queries.
+
+_P2P_PROTO = "ftagree_p2p"
+_p2p_lock = None          # created lazily (threading import cost)
+_p2p_instances: dict = {}
+_p2p_done_order: list = []
+_P2P_DONE_CAP = 512
+_p2p_registered = False
+
+
+def _p2p_state(instance: tuple, create: bool = True):
+    st = _p2p_instances.get(instance)
+    if st is None and create:
+        st = _p2p_instances[instance] = {
+            "vals": {},          # rank -> contribution (idempotent merge)
+            "prepared": None,    # (value, stamp) once a PREPARE was seen
+            "acks": set(),       # ranks that acked MY prepare round
+            "decision": None,    # committed outcome
+            "by": -1,            # stamp of the committed outcome
+            "replies": set(),    # ranks that answered MY query round
+            # highest root rank whose query this rank answered while
+            # undecided: after pledging to R, prepare/decision frames
+            # stamped by an earlier root are rejected
+            "answered_root": -1}
+    return st
+
+
+def _p2p_gc(instance: tuple) -> None:
+    """LRU-bound completed instances (runs under _p2p_lock)."""
+    _p2p_done_order.append(instance)
+    while len(_p2p_done_order) > _P2P_DONE_CAP:
+        _p2p_instances.pop(_p2p_done_order.pop(0), None)
+
+
+def _p2p_setup():
+    global _p2p_lock, _p2p_registered
+    import threading
+
+    if _p2p_lock is None:
+        _p2p_lock = threading.Lock()
+    if not _p2p_registered:
+        from ompi_tpu.mca.pml import ob1
+
+        ob1.register_ctl_handler(_P2P_PROTO, _p2p_on_frag)
+        _p2p_registered = True
+
+
+def _p2p_send(rte, dst_world: int, op: str, instance: tuple,
+              payload=None, extra: Optional[dict] = None) -> None:
+    import pickle
+
+    import numpy as np
+
+    from ompi_tpu.mca.bml import resolve_bml
+    from ompi_tpu.mca.btl.base import CTL, Frag
+    from ompi_tpu.runtime import init as rt
+
+    world = rt.get_world_if_initialized()
+    if world is None:
+        return
+    bml = resolve_bml(world.pml)
+    if bml is None:
+        return
+    try:
+        ep = bml.endpoint(dst_world)
+        if ep is None:
+            return
+        meta = {"proto": _P2P_PROTO, "op": op, "inst": instance}
+        if extra:
+            meta.update(extra)
+        data = b"" if payload is None else \
+            np.frombuffer(pickle.dumps(payload), np.uint8)
+        ep.btl.send(ep, Frag(0, rte.my_world_rank, dst_world, -1, 0, CTL,
+                             data, meta=meta))
+    except Exception:
+        pass   # peer died mid-send: recovery paths cover it
+
+
+def _p2p_on_frag(frag) -> None:
+    import pickle
+
+    inst = tuple(frag.meta["inst"])
+    op = frag.meta["op"]
+    payload = pickle.loads(bytes(frag.data)) if len(frag.data) else None
+    # a query from a self-declared root proves everything below it died —
+    # adopt that knowledge before answering (faster than the flood)
+    for r in frag.meta.get("failed", ()):
+        ft_state.mark_failed(int(r))
+    reply = None
+    with _p2p_lock:
+        st = _p2p_state(inst)
+        if op == "vals":
+            st["vals"].update(payload)
+            if frag.meta.get("answer"):
+                st["replies"].add(frag.src)
+        elif op == "prepare":
+            by = int(frag.meta.get("by", frag.src))
+            if ft_state.is_failed(frag.src) or by < st["answered_root"]:
+                return   # late frame from a superseded/dead root
+            cur = st["prepared"]
+            if cur is None or by >= cur[1]:
+                st["prepared"] = (payload, by)
+            reply = ("pack", None, None)
+        elif op == "pack":
+            st["acks"].add(frag.src)
+        elif op == "prepared":
+            # a query reply reporting a prepared-but-uncommitted value
+            by = int(frag.meta.get("by", -1))
+            cur = st["prepared"]
+            if cur is None or by >= cur[1]:
+                st["prepared"] = (payload, by)
+            if frag.meta.get("answer"):
+                st["replies"].add(frag.src)
+        elif op == "decision":
+            by = int(frag.meta.get("by", frag.src))
+            if ft_state.is_failed(frag.src) or by < st["answered_root"]:
+                return
+            if st["decision"] is None:
+                st["decision"] = payload
+                st["by"] = by
+                _p2p_gc(inst)
+        elif op == "query":
+            if st["decision"] is not None:
+                reply = ("decision", st["decision"],
+                         {"by": st["by"]})
+            elif st["prepared"] is not None:
+                reply = ("prepared", st["prepared"][0],
+                         {"by": st["prepared"][1], "answer": True})
+            else:
+                if frag.meta.get("root"):
+                    st["answered_root"] = max(st["answered_root"],
+                                              frag.src)
+                reply = ("vals", dict(st["vals"]), {"answer": True})
+    if reply is not None:
+        from ompi_tpu.runtime import init as rt
+
+        world = rt.get_world_if_initialized()
+        rte = world.rte if world is not None else None
+        if rte is not None:
+            rop, rpayload, rextra = reply
+            _p2p_send(rte, frag.src, rop, inst, rpayload, extra=rextra)
+
+
+def _p2p_tree(participants: list, me: int):
+    """Static binomial tree: (parent, children, subtree member set).
+    Shared by agree_tree and agree_p2p — one tree shape, one formula."""
+    n = len(participants)
+    idx = participants.index(me)
+    max_k = _lowbit(idx) if idx else max(1, n - 1).bit_length()
+    children = [participants[idx + (1 << k)] for k in range(max_k)
+                if idx + (1 << k) < n]
+    parent = None if idx == 0 else participants[idx & (idx - 1)]
+    subtree = {me}
+    frontier = [participants.index(c) for c in children]
+    while frontier:
+        j = frontier.pop()
+        subtree.add(participants[j])
+        kk = _lowbit(j) if j else 0
+        frontier.extend(j + (1 << k) for k in range(kk)
+                        if j + (1 << k) < n)
+    return parent, children, subtree
+
+
+def agree_p2p(
+    comm,
+    instance: tuple,
+    contribution: Any,
+    participants: Iterable[int],
+    combine: Callable[[Any, Any], Any],
+    timeout: float = 60.0,
+    prev_instance: Optional[tuple] = None,
+) -> tuple[Any, frozenset]:
+    """Coordination-free uniform agreement; returns (combined, failed set).
+
+    Safe on revoked communicators (rides the CTL carrier, below
+    matching) and with the coordination service completely dead —
+    liveness rests only on the failure detector's p2p carriers.
+    ``combine`` folds contributions in ascending-rank order.
+    """
+    import time as _time
+
+    from ompi_tpu.runtime.progress import progress
+
+    rte = comm.rte
+    me = rte.my_world_rank
+    participants = sorted(participants)
+    _p2p_setup()
+    instance = tuple(instance)
+    with _p2p_lock:
+        if prev_instance is not None:
+            _p2p_instances.pop(tuple(prev_instance), None)
+        st = _p2p_state(instance)
+        st["vals"][me] = contribution
+    original_root = participants[0]
+    parent, children, subtree = _p2p_tree(participants, me)
+    deadline = _time.monotonic() + timeout
+
+    sent_up = False
+    last_push_root = original_root
+    last_known_failed: frozenset = frozenset()
+    last_query = 0.0
+    last_prep = 0.0
+
+    def _commit(decision):
+        with _p2p_lock:
+            if st["decision"] is None:
+                st["decision"] = decision
+                st["by"] = me
+                _p2p_gc(instance)
+            decision, by = st["decision"], st["by"]
+        for r in participants:
+            if r != me and not ft_state.is_failed(r):
+                _p2p_send(rte, r, "decision", instance, decision,
+                          extra={"by": by})
+        return decision
+
+    while True:
+        progress()
+        with _p2p_lock:
+            decision = st["decision"]
+            decided_by = st["by"]
+            prepared = st["prepared"]
+            vals = dict(st["vals"])
+            replies = set(st["replies"])
+            acks = set(st["acks"])
+        if decision is not None:
+            # relay down the live tree so my subtree sees it too
+            live = [r for r in participants if not ft_state.is_failed(r)]
+            if me in live:
+                _, kids, _ = _p2p_tree(live, me)
+                for c in kids:
+                    _p2p_send(rte, c, "decision", instance, decision,
+                              extra={"by": decided_by})
+            return decision
+
+        known_failed = frozenset(
+            r for r in participants if ft_state.is_failed(r))
+        live = [r for r in participants if r not in known_failed]
+        if not live:
+            raise AgreementError(f"agreement {instance}: no live participants")
+        root = live[0]
+        now = _time.monotonic()
+
+        if me == root:
+            if prepared is not None:
+                # prepare round: re-push to unacked members; commit once
+                # every live participant holds the prepared value
+                if all(r in acks or r == me for r in live):
+                    return _commit(prepared[0])
+                if now - last_prep > 0.05:
+                    last_prep = now
+                    for r in live:
+                        if r != me and r not in acks:
+                            _p2p_send(rte, r, "prepare", instance,
+                                      prepared[0], extra={"by": me})
+            else:
+                covered = all(r in vals for r in live)
+                ready = covered and (
+                    me == original_root
+                    or all(r in replies or r == me for r in live))
+                if ready:
+                    out = None
+                    for r in sorted(vals):
+                        out = vals[r] if out is None \
+                            else combine(out, vals[r])
+                    value = (out, frozenset(known_failed))
+                    with _p2p_lock:
+                        if st["prepared"] is None:
+                            st["prepared"] = (value, me)
+                        prepared = st["prepared"]
+                elif now - last_query > 0.05:
+                    # gather: query members I have neither values nor a
+                    # query-round answer from (piggybacking my failure
+                    # knowledge, which also justifies my root claim)
+                    last_query = now
+                    for r in live:
+                        if r != me and (r not in vals or r not in replies):
+                            _p2p_send(rte, r, "query", instance,
+                                      extra={"failed": sorted(known_failed),
+                                             "root": True})
+        else:
+            # fast path: send my subtree-complete dict up the static tree
+            if not sent_up and not known_failed:
+                if all(r in vals for r in subtree):
+                    _p2p_send(rte, parent, "vals", instance, vals)
+                    sent_up = True
+            # recovery: failure-knowledge changes -> push direct to root
+            elif known_failed and (known_failed != last_known_failed
+                                   or last_push_root != root):
+                _p2p_send(rte, root, "vals", instance, vals,
+                          extra={"failed": sorted(known_failed)})
+                last_push_root = root
+                last_known_failed = known_failed
+            # straggler pull: periodically ask the root for the outcome
+            if now - last_query > 0.25:
+                last_query = now
+                _p2p_send(rte, root, "query", instance,
+                          extra={"failed": sorted(known_failed)})
+        if _time.monotonic() > deadline:
+            raise AgreementError(f"p2p agree {instance} timed out at {me}")
+        _time.sleep(0.002)

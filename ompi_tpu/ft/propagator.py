@@ -105,12 +105,58 @@ def _on_prop_frag(frag) -> None:
 
 
 def report_revoke(rte, cid: int, epoch: int, job: str = "0") -> None:
+    """Dual-carrier revocation, like failures: event bus + p2p flood
+    (``comm_ft_revoke.c``'s resilient broadcast — revocation must reach
+    members blocked in unrelated operations even with the coordination
+    service dead)."""
     ft_state.mark_revoked(cid, epoch, job)
     try:
         rte.event_notify("comm_revoked",
                          {"cid": cid, "epoch": epoch, "job": job})
     except Exception:
         pass
+    _flood_revoke(rte, cid, epoch, job)
+
+
+def _flood_revoke(rte, cid: int, epoch: int, job: str) -> None:
+    from ompi_tpu.mca.bml import resolve_bml
+    from ompi_tpu.mca.btl.base import CTL, Frag
+    from ompi_tpu.runtime import init as rt
+
+    world = rt.get_world_if_initialized()
+    if world is None:
+        return
+    bml = resolve_bml(world.pml)
+    if bml is None:
+        return
+    me = rte.my_world_rank
+    meta = {"proto": "ft_rev", "cid": cid, "epoch": epoch, "job": job}
+    for wr in world.group.world_ranks:
+        if wr == me or ft_state.is_failed(wr):
+            continue
+        try:
+            ep = bml.endpoint(wr)
+            if ep is not None:
+                ep.btl.send(ep, Frag(0, me, wr, -1, 0, CTL, meta=meta))
+        except Exception:
+            pass
+
+
+def _on_rev_frag(frag) -> None:
+    """First receipt marks + re-floods (epidemic, like proc failures)."""
+    cid = int(frag.meta["cid"])
+    epoch = int(frag.meta.get("epoch", 0))
+    job = str(frag.meta.get("job", "0"))
+    if ft_state.is_comm_revoked(cid, epoch, job):
+        return
+    _output.output(_stream, 1, "comm cid=%d revoked (p2p flood from %d)",
+                   cid, frag.src)
+    ft_state.mark_revoked(cid, epoch, job)
+    from ompi_tpu.runtime import init as rt
+
+    rte = rt.get_rte()
+    if rte is not None:
+        _flood_revoke(rte, cid, epoch, job)
 
 
 class EventPoller:
@@ -175,6 +221,7 @@ def start(rte, with_detector: bool = False) -> None:
         from ompi_tpu.mca.pml import ob1
 
         ob1.register_ctl_handler("ft_prop", _on_prop_frag)
+        ob1.register_ctl_handler("ft_rev", _on_rev_frag)
         _poller = EventPoller(rte)
         _poller.start()
     if with_detector and _detector is None:

@@ -27,7 +27,7 @@ class FtAgreeModule:
 
     def agree(self, comm, flag: int) -> int:
         from ompi_tpu.api.errors import ProcFailedError
-        from ompi_tpu.ft.agreement import agree_kv, agree_tree
+        from ompi_tpu.ft.agreement import agree_kv, agree_p2p, agree_tree
 
         members = list(comm.group.world_ranks)
         live = [r for r in members if not ft_state.is_failed(r)]
@@ -46,7 +46,14 @@ class FtAgreeModule:
                 if seq > 2 else None)
         combine = lambda a, b: (a[0] & b[0], a[1] | b[1], a[2] or b[2])
         contribution = (int(flag), known_failed, my_unacked)
-        if (self._c.alg_var.value or "era").strip() == "era":
+        alg = (self._c.alg_var.value or "era").strip()
+        if alg == "era":
+            # coordination-free ERA: decisions never touch the coord
+            # server (it stays restricted to wire-up)
+            (agreed_flag, agreed_failed, any_unacked), _ = agree_p2p(
+                comm, instance, contribution, live, combine,
+                prev_instance=prev)
+        elif alg == "tree":
             (agreed_flag, agreed_failed, any_unacked), _ = agree_tree(
                 comm, instance, contribution, live, combine,
                 prev_instance=prev)
@@ -80,9 +87,11 @@ class FtAgreeComponent(Component):
             help="Selection priority of coll/ftagree")
         self.alg_var = self.register_var(
             "algorithm", vtype=VarType.STRING, default="era",
-            help="Agreement algorithm: 'era' (binomial-tree p2p reduce "
-                 "with KV-anchored uniform decision) or 'kv' "
-                 "(coordinator-decides over the coordination service)")
+            help="Agreement algorithm: 'era' (coordination-free p2p "
+                 "tree reduce + pledge-guarded takeover, the default), "
+                 "'tree' (binomial p2p reduce with KV-anchored uniform "
+                 "decision), or 'kv' (coordinator-decides over the "
+                 "coordination service)")
 
     def comm_query(self, comm):
         # the consensus needs the out-of-band KV service: multi-process only

@@ -44,10 +44,14 @@ def _ring_kernels(n: int, axis: str, interpret: bool):
     """Build the kernel-constructor namespace once per (n, axis, mode)."""
     jax, jnp, lax, pl, pltpu = _mods()
 
-    def compiler_params():
+    def compiler_params(collective_id: int):
+        # distinct collective_id per kernel family: concurrent pallas
+        # collectives must not share barrier/semaphore identity on real
+        # hardware (Mosaic matches collective instances by this id)
         if interpret:
             return None
-        return pltpu.CompilerParams(has_side_effects=True, collective_id=0)
+        return pltpu.CompilerParams(has_side_effects=True,
+                                    collective_id=collective_id)
 
     return jax, jnp, lax, pl, pltpu, compiler_params
 
@@ -70,7 +74,7 @@ def _build_right_permute(n: int, axis: str, shape, dtype_str: str,
 
     def call(x):
         kw = {}
-        cp = cparams()
+        cp = cparams(1)
         if cp is not None:
             kw["compiler_params"] = cp
         return pl.pallas_call(
@@ -117,7 +121,7 @@ def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
 
     def call(x):
         kw = {}
-        cp = cparams()
+        cp = cparams(2)
         if cp is not None:
             kw["compiler_params"] = cp
         return pl.pallas_call(
@@ -199,7 +203,7 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
 
     def call(x):  # x: (n, blk) per device
         kw = {}
-        cp = cparams()
+        cp = cparams(3)
         if cp is not None:
             kw["compiler_params"] = cp
         return pl.pallas_call(

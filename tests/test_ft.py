@@ -244,6 +244,97 @@ class TestMultiprocessFt:
         assert r.returncode == 0, r.stdout + r.stderr
 
 
+class TestCoordFreeAgreement:
+    def test_agree_survives_root_death_with_coord_gagged(self, tmp_path):
+        """ERA p2p agreement: the tree ROOT (rank 0) dies mid-agreement
+        while every survivor's coordination-service KV ops are gagged —
+        decisions must ride only the p2p carrier (takeover root gathers
+        pledge replies, decides, broadcasts).  The coord stays restricted
+        to wire-up, matching ``coll_ftagree_earlyreturning.c``'s
+        no-central-arbiter property."""
+        script = tmp_path / "rootdeath.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            import ompi_tpu
+            from ompi_tpu.ft import state as ft_state
+
+            w = ompi_tpu.init()
+            w.barrier()
+            if w.rank == 0:
+                time.sleep(0.3)
+                os._exit(11)   # the agreement tree's root dies
+            # gag the shared coord client's KV surface: any decision-path
+            # use of the coordination service now fails loudly
+            client = w.rte.client
+            def _gagged(*a, **k):
+                raise AssertionError("agreement touched the coord service")
+            client.get = _gagged
+            client.put_new = _gagged
+            client.delete = _gagged
+            got = w.agree(0b1101 if w.rank == 1 else 0b0111)
+            assert got == 0b0101, got
+            # the agreed failed-set is uniform too: everyone saw rank 0
+            deadline = time.time() + 60
+            while not ft_state.is_failed(0):
+                if time.time() > deadline:
+                    sys.exit("root death never detected")
+                time.sleep(0.05)
+            w.ack_failed()
+            got2 = w.agree(0b11)
+            assert got2 == 0b11, got2
+            print(f"ROOTDEATH OK {w.rank}", flush=True)
+            ompi_tpu.finalize()
+        """))
+        r = _tpurun(4, script, recovery=True, timeout=150,
+                    mca=[("ft_detector", "true"),
+                         ("ft_detector_period", "0.2"),
+                         ("ft_detector_timeout", "1.5"),
+                         ("ft_detector_startup_grace", "2.0")])
+        assert r.stdout.count("ROOTDEATH OK") == 3, r.stdout + r.stderr
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_revoke_floods_with_event_bus_down(self, tmp_path):
+        """Revocation propagation must not depend on the coordination
+        service's event bus: stop the event poller on every rank, revoke,
+        and require the p2p flood (``comm_ft_revoke.c`` resilient
+        broadcast analog) to deliver it."""
+        script = tmp_path / "revflood.py"
+        script.write_text(textwrap.dedent("""
+            import sys, time
+            import ompi_tpu
+            from ompi_tpu.api.errors import RevokedError
+            from ompi_tpu.api.errhandler import ERRORS_RETURN
+            from ompi_tpu.ft import propagator
+            from ompi_tpu.runtime.progress import progress
+
+            w = ompi_tpu.init()
+            w.set_errhandler(ERRORS_RETURN)
+            d = w.dup()
+            # kill the event-bus leg everywhere: only the p2p flood remains
+            propagator._poller.stop()
+            w.barrier()
+            if w.rank == 0:
+                d.revoke()
+            deadline = time.time() + 60
+            while not d.is_revoked():
+                if time.time() > deadline:
+                    sys.exit("revocation never arrived over p2p")
+                progress()   # a rank blocked in MPI drives the engine;
+                             # the CTL flood rides it
+                time.sleep(0.002)
+            try:
+                d.barrier()
+                sys.exit("expected RevokedError")
+            except RevokedError:
+                pass
+            print(f"REVFLOOD OK {w.rank}", flush=True)
+            ompi_tpu.finalize()
+        """))
+        r = _tpurun(3, script)
+        assert r.stdout.count("REVFLOOD OK") == 3, r.stdout + r.stderr
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
 class TestMultiFailure:
     def test_detector_survives_double_failure(self, tmp_path):
         """TWO adjacent ranks die; the ring rotates past both and every
