@@ -74,7 +74,11 @@ class PessimistPml:
     # -- send side: envelope (+ payload when sender-based logging) -------
     def _log_send(self, comm, buf, dest, tag) -> None:
         arr = np.asarray(buf)
-        rec = dict(cid=comm.cid, dst=int(dest), tag=int(tag),
+        grp = comm.remote_group if comm.is_inter else comm.group
+        # WORLD ranks in the log: events.<world>.log files are keyed by
+        # world rank, so replay's cross-log pairing must be too
+        rec = dict(cid=comm.cid, dst=int(grp.world_rank(dest)),
+                   tag=int(tag),
                    nbytes=int(arr.nbytes), sha=self._digest(arr))
         if self._payloads:
             rec["payload"] = np.ascontiguousarray(arr).view(np.uint8) \
@@ -91,15 +95,17 @@ class PessimistPml:
 
     # -- recv side: the nondeterministic event is the MATCH --------------
     def _log_match(self, comm, req) -> None:
-        st = req.status
-        self._event("recv", cid=comm.cid, src=int(st.source),
-                    tag=int(st.tag))
+        self._log_match_st(comm, req.status)
 
     def recv(self, comm, buf, source, tag):
         st = self._inner.recv(comm, buf, source, tag)
-        self._event("recv", cid=comm.cid, src=int(st.source),
-                    tag=int(st.tag))
+        self._log_match_st(comm, st)
         return st
+
+    def _log_match_st(self, comm, st) -> None:
+        grp = comm.remote_group if comm.is_inter else comm.group
+        self._event("recv", cid=comm.cid,
+                    src=int(grp.world_rank(st.source)), tag=int(st.tag))
 
     def irecv(self, comm, buf, source, tag):
         req = self._inner.irecv(comm, buf, source, tag)
@@ -114,7 +120,206 @@ class PessimistPml:
         return self._inner.finalize()
 
 
+_replay_var = registry.register(
+    "vprotocol", "pessimist", "replay", vtype=VarType.STRING, default="",
+    help="Replay directory: re-drive this rank's execution from the "
+         "pessimist logs (recvs satisfied from logged delivery order, "
+         "sends envelope-verified + suppressed when provably delivered), "
+         "then fall through to live execution")
+_replay_rank_var = registry.register(
+    "vprotocol", "pessimist", "replay_rank", vtype=VarType.INT, default=-1,
+    help="World rank whose log to replay (default: this process's rank)")
+
+
+def replay_enabled() -> bool:
+    return bool((_replay_var.value or "").strip())
+
+
+class ReplayDivergence(RuntimeError):
+    """The re-executed program issued an operation that does not match
+    the logged envelope — the piecewise-deterministic assumption broke."""
+
+
+class ReplayPml:
+    """Re-drive a restarted rank from the pessimist logs.
+
+    The reference's pessimist replay (``ompi/mca/vprotocol/pessimist/``)
+    re-delivers logged messages in their logged order until the restarted
+    rank catches up, then switches to live execution.  Same model here,
+    receiver-pull form over the shared log directory:
+
+    - each **recv** consumes the next logged delivery event: the source
+      is pinned to the logged one (the any-source nondeterminism this
+      protocol exists to remove), and the payload is pulled from the
+      SENDER's log (which is why replay requires
+      ``otpu_vprotocol_pessimist_log_payloads=1`` job-wide — full
+      sender-based logging);
+    - each **send** is verified against the next logged send envelope
+      (dst/tag/bytes/sha — a mismatch raises :class:`ReplayDivergence`)
+      and then SUPPRESSED iff the receiver's log proves delivery
+      (its recv-event count from me covers this send); an in-flight
+      send the receiver never matched is re-sent live, so a peer
+      resuming just past the crash boundary still receives it;
+    - when the log is exhausted every operation passes through to the
+      live pml.
+
+    Matching is ORDER-based per rank (the k-th recv of the re-execution
+    consumes the k-th logged delivery): the piecewise-deterministic
+    execution assumption pessimistic logging is built on.  All log ranks
+    are WORLD ranks.  Known limitation: payload pairing between a
+    (sender, receiver) pair is by global send order, which is exact for
+    traffic on one communicator (pml ordering is non-overtaking per
+    peer) but can interleave when two communicators carry concurrent
+    traffic between the same pair — the reference's pessimist uses full
+    event clocks there (``vprotocol_pessimist_eventlog``).
+    """
+
+    def __init__(self, inner, rte) -> None:
+        self._inner = inner
+        self._dir = (_replay_var.value or "").strip()
+        rr = int(_replay_rank_var.value)
+        self._rank = rr if rr >= 0 else rte.my_world_rank
+        events = read_log(self._dir, self._rank)
+        self._sends = [e for e in events if e["kind"] == "send"]
+        self._recvs = [e for e in events if e["kind"] == "recv"]
+        self._si = 0
+        self._ri = 0
+        # per-source queues of the sender's logged sends addressed to me
+        self._src_sends: dict[int, list] = {}
+        # delivery proof: how many of MY sends each dst's log shows
+        # matched (order-based count); sends beyond it are re-sent live
+        self._delivered: dict[int, int] = {}
+        self._sent_to: dict[int, int] = {}
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def replay_active(self) -> bool:
+        return self._si < len(self._sends) or self._ri < len(self._recvs)
+
+    # -- log plumbing ----------------------------------------------------
+    def _sends_from(self, src: int) -> list:
+        q = self._src_sends.get(src)
+        if q is None:
+            q = [e for e in read_log(self._dir, src)
+                 if e["kind"] == "send" and int(e["dst"]) == self._rank]
+            self._src_sends[src] = q
+        return q
+
+    def _delivered_count(self, dst: int) -> int:
+        got = self._delivered.get(dst)
+        if got is None:
+            try:
+                got = sum(1 for e in read_log(self._dir, dst)
+                          if e["kind"] == "recv"
+                          and int(e["src"]) == self._rank)
+            except OSError:
+                got = 0    # peer never logged: nothing provably delivered
+            self._delivered[dst] = got
+        return got
+
+    # -- send side -------------------------------------------------------
+    def _replay_send(self, comm, buf, dest, tag) -> bool:
+        """True when the send was consumed by the log (suppressed or
+        re-sent live); False when the log is exhausted."""
+        if self._si >= len(self._sends):
+            return False
+        e = self._sends[self._si]
+        arr = np.asarray(buf)
+        grp = comm.remote_group if comm.is_inter else comm.group
+        dst_world = int(grp.world_rank(dest))
+        if (int(e["dst"]) != dst_world or int(e["tag"]) != int(tag)
+                or int(e["nbytes"]) != int(arr.nbytes)):
+            raise ReplayDivergence(
+                f"send #{self._si} diverged: logged "
+                f"(dst={e['dst']} tag={e['tag']} nbytes={e['nbytes']}) "
+                f"vs replayed (dst={dst_world} tag={tag} "
+                f"nbytes={arr.nbytes})")
+        sha = hashlib.sha1(np.ascontiguousarray(arr)
+                           .view(np.uint8)).hexdigest()[:16]
+        if e.get("sha") not in ("?", sha):
+            raise ReplayDivergence(
+                f"send #{self._si} payload hash diverged "
+                f"(logged {e['sha']}, replayed {sha})")
+        self._si += 1
+        seq = self._sent_to.get(dst_world, 0)
+        self._sent_to[dst_world] = seq + 1
+        if seq < self._delivered_count(dst_world):
+            return True            # provably delivered: suppress
+        self._inner.send(comm, buf, dest, tag)   # in-flight at crash
+        return True
+
+    def send(self, comm, buf, dest, tag, **kw):
+        if self._replay_send(comm, buf, dest, tag):
+            return None
+        return self._inner.send(comm, buf, dest, tag, **kw)
+
+    def isend(self, comm, buf, dest, tag, **kw):
+        from ompi_tpu.api.request import CompletedRequest
+
+        if self._replay_send(comm, buf, dest, tag):
+            return CompletedRequest()
+        return self._inner.isend(comm, buf, dest, tag, **kw)
+
+    # -- recv side -------------------------------------------------------
+    def _replay_recv(self, comm, buf, source, tag):
+        from ompi_tpu.api.status import ANY_SOURCE, ANY_TAG, Status
+
+        if self._ri >= len(self._recvs):
+            return None
+        e = self._recvs[self._ri]
+        src = int(e["src"])            # world rank
+        grp = comm.remote_group if comm.is_inter else comm.group
+        if source != ANY_SOURCE and int(grp.world_rank(source)) != src:
+            raise ReplayDivergence(
+                f"recv #{self._ri} diverged: logged src world {src}, "
+                f"replayed explicit source {source}")
+        if tag != ANY_TAG and int(e["tag"]) != int(tag):
+            raise ReplayDivergence(
+                f"recv #{self._ri} diverged: logged tag {e['tag']}, "
+                f"replayed tag {tag}")
+        self._ri += 1
+        q = self._sends_from(src)
+        if not q:
+            raise ReplayDivergence(
+                f"recv #{self._ri - 1}: rank {src}'s log has no remaining "
+                f"send for me — was the job run with "
+                f"otpu_vprotocol_pessimist_log_payloads=1?")
+        se = q.pop(0)
+        if "payload" not in se:
+            raise ReplayDivergence(
+                f"sender {src} logged no payloads; replay requires "
+                "otpu_vprotocol_pessimist_log_payloads=1 job-wide")
+        data = bytes.fromhex(se["payload"])
+        from ompi_tpu.api.comm import as_buffer
+        from ompi_tpu.datatype import Convertor
+
+        arr, count, dt = as_buffer(buf)
+        conv = Convertor(dt, count, arr)
+        n = conv.unpack(data[:conv.packed_size])
+        return Status(source=int(grp.rank_of(src)), tag=int(e["tag"]),
+                      _nbytes=n)
+
+    def recv(self, comm, buf, source, tag):
+        st = self._replay_recv(comm, buf, source, tag)
+        if st is not None:
+            return st
+        return self._inner.recv(comm, buf, source, tag)
+
+    def irecv(self, comm, buf, source, tag):
+        from ompi_tpu.api.request import CompletedRequest
+
+        st = self._replay_recv(comm, buf, source, tag)
+        if st is not None:
+            return CompletedRequest(st)
+        return self._inner.irecv(comm, buf, source, tag)
+
+
 def maybe_wrap_pml(pml_module, rte):
+    if replay_enabled() and getattr(rte, "client", None) is not None:
+        # replay takes precedence; live ops after log exhaustion are not
+        # re-logged (appending to the consumed log would corrupt it)
+        return ReplayPml(pml_module, rte)
     if enabled() and getattr(rte, "client", None) is not None:
         return PessimistPml(pml_module, rte)
     return pml_module

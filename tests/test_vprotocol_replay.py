@@ -1,0 +1,136 @@
+"""vprotocol/pessimist replay — a restarted rank is re-driven from the
+message logs to its pre-failure state, then continues live with peers
+(``ompi/mca/vprotocol/pessimist`` re-delivery semantics).
+
+Scenario: 3 ranks run a deterministic ring recurrence with full
+sender-based logging; rank 1 dies MID-iteration (after its sends, before
+its recvs).  A second job replays every rank from the logs: suppressed
+sends where delivery is proven by the receiver's log, a live re-send for
+the in-flight message the dead rank never received, pinned-source recvs
+satisfied from the senders' logged payloads — then the log runs dry and
+live execution finishes the remaining iterations.  Final states must
+match the failure-free recurrence computed locally.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NITER_TOTAL = 5
+DIE_ROUND = 2   # rank 1 dies in round 2 after sending, before receiving
+
+_PROGRAM = """
+import os, sys
+import numpy as np
+import ompi_tpu
+
+niter = int(os.environ["VP_NITER"])
+die = os.environ.get("VP_DIE", "") == "1"
+w = ompi_tpu.init()
+n, r = w.size, w.rank
+state = np.full(4, float(r + 1), np.float64)
+for it in range(niter):
+    req = w.isend(state.copy(), dest=(r + 1) % n, tag=7)
+    if die and r == 1 and it == {die_round}:
+        os._exit(9)     # mid-iteration: sent but never received
+    inbuf = np.empty_like(state)
+    w.recv(inbuf, source=(r - 1) % n, tag=7)
+    req.wait()
+    state = 0.5 * state + 0.5 * inbuf + float(it)
+np.save(os.environ["VP_OUT"] + f".{{r}}.npy", state)
+print(f"DONE {{r}} " + " ".join(f"{{x:.6f}}" for x in state), flush=True)
+ompi_tpu.finalize()
+"""
+
+
+def _run(n, script, env_extra, mca=(), timeout=180):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra)
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+           "--enable-recovery"]
+    for k, v in mca:
+        cmd += ["--mca", k, v]
+    cmd += [sys.executable, str(script)]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO, env=env)
+
+
+def _expected(niter, n=3):
+    states = [np.full(4, float(r + 1), np.float64) for r in range(n)]
+    for it in range(niter):
+        prev = [s.copy() for s in states]
+        for r in range(n):
+            states[r] = 0.5 * prev[r] + 0.5 * prev[(r - 1) % n] + float(it)
+    return states
+
+
+def test_replay_after_midround_death(tmp_path):
+    logdir = tmp_path / "logs"
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(
+        _PROGRAM.format(die_round=DIE_ROUND)))
+
+    # phase A: run up to the crash boundary; rank 1 dies mid-round
+    ra = _run(3, prog,
+              {"VP_NITER": str(DIE_ROUND + 1), "VP_DIE": "1",
+               "VP_OUT": str(tmp_path / "a")},
+              mca=[("vprotocol_pessimist_log", str(logdir)),
+                   ("vprotocol_pessimist_log_payloads", "1"),
+                   ("ft_detector", "true"),
+                   ("ft_detector_period", "0.2"),
+                   ("ft_detector_timeout", "1.5")])
+    assert ra.stdout.count("DONE") == 2, ra.stdout + ra.stderr
+    assert not (tmp_path / f"a.1.npy").exists()   # rank 1 really died
+    for r in (0, 2):
+        assert (tmp_path / f"a.{r}.npy").exists(), ra.stdout + ra.stderr
+
+    # phase B: "respawn" — every rank re-driven from the logs, the dead
+    # rank catching the in-flight re-send live, then all finish the
+    # remaining rounds live
+    rb = _run(3, prog,
+              {"VP_NITER": str(NITER_TOTAL), "VP_DIE": "0",
+               "VP_OUT": str(tmp_path / "b")},
+              mca=[("vprotocol_pessimist_replay", str(logdir))])
+    assert rb.returncode == 0, rb.stdout + rb.stderr
+    assert rb.stdout.count("DONE") == 3, rb.stdout + rb.stderr
+
+    want = _expected(NITER_TOTAL)
+    for r in range(3):
+        got = np.load(tmp_path / f"b.{r}.npy")
+        np.testing.assert_allclose(got, want[r], rtol=1e-12, err_msg=(
+            f"rank {r} state diverged after replay"))
+
+
+def test_replay_divergence_detected(tmp_path):
+    """A re-execution that does not match the log must fail loudly, not
+    silently corrupt recovery (envelope verification)."""
+    logdir = tmp_path / "logs"
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(
+        _PROGRAM.format(die_round=DIE_ROUND)))
+    ra = _run(3, prog,
+              {"VP_NITER": "2", "VP_DIE": "0",
+               "VP_OUT": str(tmp_path / "a")},
+              mca=[("vprotocol_pessimist_log", str(logdir)),
+                   ("vprotocol_pessimist_log_payloads", "1")])
+    assert ra.returncode == 0, ra.stdout + ra.stderr
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        w = ompi_tpu.init()
+        try:
+            # logged program used tag=7; this diverges
+            w.send(np.zeros(4), dest=(w.rank + 1) % w.size, tag=99)
+        except Exception as e:
+            assert type(e).__name__ == "ReplayDivergence", e
+            print(f"DIVERGED {w.rank}", flush=True)
+        ompi_tpu.finalize()
+    """))
+    rb = _run(3, bad, {"VP_OUT": str(tmp_path / "x")},
+              mca=[("vprotocol_pessimist_replay", str(logdir))])
+    assert rb.stdout.count("DIVERGED") == 3, rb.stdout + rb.stderr
