@@ -281,8 +281,6 @@ def _lowbit(x: int) -> int:
 
 def _decide(rte, instance, participants, combine, deadline, poll):
     """Coordinator side: gather live contributions, reduce, decide."""
-    import time as _time
-
     ckey = _key(instance, "c")
     values: dict[int, Any] = {}
     known_failed: set[int] = set()
@@ -299,10 +297,10 @@ def _decide(rte, instance, participants, combine, deadline, poll):
                 still.append(r)
         pending = still
         if pending:
-            if _time.monotonic() > deadline:
+            if time.monotonic() > deadline:
                 raise AgreementError(
                     f"agreement {instance} timed out waiting for {pending}")
-            _time.sleep(poll)
+            time.sleep(poll)
     out = None
     for r in sorted(values):
         out = values[r] if out is None else combine(out, values[r])
@@ -525,8 +523,6 @@ def agree_p2p(
     liveness rests only on the failure detector's p2p carriers.
     ``combine`` folds contributions in ascending-rank order.
     """
-    import time as _time
-
     from ompi_tpu.runtime.progress import progress
 
     rte = comm.rte
@@ -541,12 +537,14 @@ def agree_p2p(
         st["vals"][me] = contribution
     original_root = participants[0]
     parent, children, subtree = _p2p_tree(participants, me)
-    deadline = _time.monotonic() + timeout
+    deadline = time.monotonic() + timeout
 
     sent_up = False
     last_push_root = original_root
     last_known_failed: frozenset = frozenset()
-    last_query = 0.0
+    # throttle clocks start NOW: a 0.0 epoch would fire every query path
+    # on the first iteration and drown the tree fast path in O(n) pulls
+    last_query = time.monotonic()
     last_prep = 0.0
 
     def _commit(decision):
@@ -587,7 +585,7 @@ def agree_p2p(
         if not live:
             raise AgreementError(f"agreement {instance}: no live participants")
         root = live[0]
-        now = _time.monotonic()
+        now = time.monotonic()
 
         if me == root:
             if prepared is not None:
@@ -644,6 +642,6 @@ def agree_p2p(
                 last_query = now
                 _p2p_send(rte, root, "query", instance,
                           extra={"failed": sorted(known_failed)})
-        if _time.monotonic() > deadline:
+        if time.monotonic() > deadline:
             raise AgreementError(f"p2p agree {instance} timed out at {me}")
-        _time.sleep(0.002)
+        time.sleep(0.002)

@@ -93,13 +93,30 @@ class Btl(Component):
         """Return an endpoint if this BTL can reach the peer, else None."""
         return None
 
+    #: True when this BTL implements the one-sided prepare_src/get/put
+    #: RMA triple (``btl.h:949`` btl_put / ``:987`` btl_get); pml/ob1's
+    #: RGET protocol engages only on rdma-capable transports and falls
+    #: back to pull-streaming emulation elsewhere
+    rdma = False
+
     def send(self, ep: Endpoint, frag: Frag) -> None:
         raise NotImplementedError
 
-    def put(self, ep: Endpoint, local: memoryview, remote_key: Any) -> None:
+    def prepare_src(self, ep: Endpoint, arr) -> Any:
+        """Expose a contiguous byte region for one-sided peer access;
+        returns a picklable remote key (``btl_register_mem`` +
+        descriptor prepare, ``btl.h:1095``)."""
+        raise NotImplementedError("this BTL has no RDMA registration")
+
+    def release_src(self, key: Any) -> None:
+        """Tear down a prepare_src exposure (deregistration)."""
+
+    def put(self, ep: Endpoint, local, remote_key: Any) -> None:
+        """Write ``local`` bytes into the peer region (btl.h:949)."""
         raise NotImplementedError("this BTL has no RDMA put")
 
-    def get(self, ep: Endpoint, local: memoryview, remote_key: Any) -> None:
+    def get(self, ep: Endpoint, local, remote_key: Any) -> None:
+        """Read the peer region into ``local`` bytes (btl.h:987)."""
         raise NotImplementedError("this BTL has no RDMA get")
 
     def progress(self) -> int:

@@ -366,6 +366,86 @@ class SmBtl(Btl):
                 events += 1
         return events
 
+    # -- one-sided RMA (btl.h:949 put / :987 get) ------------------------
+    #
+    # Same-host "RDMA" is a mapped-segment copy: prepare_src stages the
+    # contiguous bytes into a shared-memory segment (one copy); the peer's
+    # get() copies straight into its destination buffer (one copy).  Two
+    # copies and ONE ring handoff total — the rendezvous stream costs
+    # three copies and a frame per max_send_size.  Segments are POOLED by
+    # size class and peers CACHE their attachments (a registration cache,
+    # opal rcache's role): creating + faulting a fresh multi-MB mapping
+    # per message costs more than the copies themselves.
+    rdma = True
+    _RMA_POOL_CAP = 8
+
+    def prepare_src(self, ep: Endpoint, arr) -> dict:
+        src = _as_u8(arr)
+        # pow2 size class with a 64KB floor
+        size = 1 << max(16, (int(len(src)) - 1).bit_length())
+        pool = getattr(self, "_rma_pool", None)
+        if pool is None:
+            pool = self._rma_pool = {}
+            self._exposed = {}
+        shm = None
+        free = pool.get(size)
+        if free:
+            shm = free.pop()
+        if shm is None:
+            seq = self._expose_seq = getattr(self, "_expose_seq", 0) + 1
+            name = (f"otpu_rg_{self._rte.my_world_rank}_"
+                    f"{os.getpid() & 0xffff}_{seq}")
+            shm = shared_memory.SharedMemory(name=name, create=True,
+                                             size=size)
+        np.copyto(np.frombuffer(shm.buf, np.uint8, count=len(src)), src)
+        self._exposed[shm.name] = shm
+        return {"btl": "sm", "seg": shm.name, "size": size,
+                "nbytes": int(len(src))}
+
+    def release_src(self, key: dict) -> None:
+        shm = getattr(self, "_exposed", {}).pop(key["seg"], None)
+        if shm is None:
+            return
+        pool = self._rma_pool.setdefault(key["size"], [])
+        if len(pool) < self._RMA_POOL_CAP:
+            pool.append(shm)   # keep warm: name is stable, peers stay
+            return             # attached across reuses
+        try:
+            shm.close()
+            shm.unlink()
+        except OSError:
+            pass
+
+    def _rma_attach(self, name: str) -> shared_memory.SharedMemory:
+        cache = getattr(self, "_attached", None)
+        if cache is None:
+            cache = self._attached = {}
+        shm = cache.get(name)
+        if shm is None:
+            shm = cache[name] = _attach(name)
+            while len(cache) > 4 * self._RMA_POOL_CAP:
+                oldest = next(iter(cache))   # insertion order: never the
+                if oldest == name:           # entry just added
+                    break
+                old = cache.pop(oldest)
+                try:
+                    old.close()
+                except OSError:
+                    pass
+        return shm
+
+    def get(self, ep: Endpoint, local, remote_key: dict) -> None:
+        dst = _as_u8(local)
+        n = min(len(dst), remote_key["nbytes"])
+        shm = self._rma_attach(remote_key["seg"])
+        np.copyto(dst[:n], np.frombuffer(shm.buf, np.uint8, count=n))
+
+    def put(self, ep: Endpoint, local, remote_key: dict) -> None:
+        src = _as_u8(local)
+        n = min(len(src), remote_key["nbytes"])
+        shm = self._rma_attach(remote_key["seg"])
+        np.copyto(np.frombuffer(shm.buf, np.uint8, count=n), src[:n])
+
     def close(self) -> None:
         # Flush queued writes before teardown: a request may complete once
         # its frags are packed, so exiting with a non-empty pending queue
@@ -414,6 +494,25 @@ class SmBtl(Btl):
                 pass
         self._rings_in.clear()
         self._rings_out.clear()
+        for shm in getattr(self, "_attached", {}).values():
+            try:
+                shm.close()
+            except OSError:
+                pass
+        if hasattr(self, "_attached"):
+            self._attached.clear()
+        pool_segs = [s for segs in getattr(self, "_rma_pool", {}).values()
+                     for s in segs]
+        for shm in list(getattr(self, "_exposed", {}).values()) + pool_segs:
+            try:
+                shm.close()
+                shm.unlink()
+            except OSError:
+                pass
+        if hasattr(self, "_exposed"):
+            self._exposed.clear()
+        if hasattr(self, "_rma_pool"):
+            self._rma_pool.clear()
 
 
 COMPONENT = SmBtl()
