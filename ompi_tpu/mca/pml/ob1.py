@@ -547,12 +547,7 @@ class Ob1Pml:
         if ep is None:
             # sender died and its endpoint is gone: complete in error
             # rather than blowing up the progress engine
-            from ompi_tpu.api.errors import ProcFailedError
-
-            req.status._nbytes = 0
-            req.complete(ProcFailedError(
-                f"RGET sender world rank {frag.src} unreachable",
-                (frag.src,)))
+            self._rget_fail(req, frag, events)
             return
         key = frag.meta.get("key")
         if error is not None and key is None:
@@ -562,20 +557,31 @@ class Ob1Pml:
                                  meta={"proto": "ob1_rget_done",
                                        "req_id": frag.meta["req_id"]}))
             req.status._nbytes = 0
+            if peruse.active():
+                events.append((peruse.REQ_COMPLETE, frag.cid,
+                               dict(kind="recv", source=req.status.source,
+                                    tag=req.status.tag)))
             req.complete(error)
             return
         if key is not None:
             want = req.total
-            view = req.convertor.unpack_view(want)
-            if view is not None:
-                # one-sided landing: peer bytes -> user buffer, no staging
-                ep.btl.get(ep, view, key)
-                req.convertor.advance(len(view))
-                n = len(view)
-            else:
-                tmp = np.empty(max(0, want), np.uint8)
-                ep.btl.get(ep, tmp, key)
-                n = req.convertor.unpack(tmp)
+            try:
+                view = req.convertor.unpack_view(want)
+                if view is not None:
+                    # one-sided landing: peer bytes -> user buffer direct
+                    ep.btl.get(ep, view, key)
+                    req.convertor.advance(len(view))
+                    n = len(view)
+                else:
+                    tmp = np.empty(max(0, want), np.uint8)
+                    ep.btl.get(ep, tmp, key)
+                    n = req.convertor.unpack(tmp)
+            except Exception:
+                # exposed segment gone (sender died and tore down before
+                # detection) or btl without get: fail the recv, don't
+                # kill the progress engine
+                self._rget_fail(req, frag, events)
+                return
             req.received = n
             req.status._nbytes = n
             spc.record("bytes_received", n)
@@ -598,6 +604,21 @@ class Ob1Pml:
                              meta={"proto": "ob1_rget_pull",
                                    "req_id": frag.meta["req_id"],
                                    "peer_req": req.req_id}))
+
+    def _rget_fail(self, req: RecvRequest, frag: Frag,
+                   events: list) -> None:
+        """Complete an RGET recv in error (sender gone / pull failed),
+        keeping the PERUSE activate/complete pairing balanced."""
+        from ompi_tpu.api.errors import ProcFailedError
+
+        req.status._nbytes = 0
+        if peruse.active():
+            events.append((peruse.REQ_COMPLETE, frag.cid,
+                           dict(kind="recv", source=req.status.source,
+                                tag=req.status.tag)))
+        req.complete(ProcFailedError(
+            f"RGET sender world rank {frag.src} unreachable",
+            (frag.src,)))
 
     def _on_rget_done(self, frag: Frag) -> None:
         """Sender side: receiver finished its pull — release + complete."""

@@ -189,17 +189,11 @@ def g(sym: SymArray, pe: int, index: int = 0):
 # -- atomics --------------------------------------------------------------
 
 def atomic_add(sym: SymArray, value, pe: int, index: int = 0) -> None:
-    ctx = _get()
-    ctx.win.accumulate(np.asarray([value], dtype=sym.dtype), pe,
-                       sym.byte_offset(index), op_mod.SUM)
+    _atomic_op(sym, value, pe, index, op_mod.SUM)
 
 
 def atomic_fetch_add(sym: SymArray, value, pe: int, index: int = 0):
-    ctx = _get()
-    out = ctx.win.get_accumulate(np.asarray([value], dtype=sym.dtype), pe,
-                                 sym.byte_offset(index), op_mod.SUM)
-    return np.asarray(out).view(sym.dtype)[0] \
-        if np.asarray(out).dtype != sym.dtype else np.asarray(out)[0]
+    return _atomic_fetch_op(sym, value, pe, index, op_mod.SUM)
 
 
 def atomic_inc(sym: SymArray, pe: int, index: int = 0) -> None:
@@ -211,12 +205,7 @@ def atomic_fetch(sym: SymArray, pe: int, index: int = 0):
 
 
 def atomic_swap(sym: SymArray, value, pe: int, index: int = 0):
-    ctx = _get()
-    out = ctx.win.get_accumulate(
-        np.asarray([value], dtype=sym.dtype), pe, sym.byte_offset(index),
-        op_mod.REPLACE)
-    return np.asarray(out).view(sym.dtype)[0] \
-        if np.asarray(out).dtype != sym.dtype else np.asarray(out)[0]
+    return _atomic_fetch_op(sym, value, pe, index, op_mod.REPLACE)
 
 
 def atomic_compare_swap(sym: SymArray, cond, value, pe: int,
@@ -432,6 +421,241 @@ def clear_lock(lock: SymArray, index: int = 0) -> None:
     if prev != my_pe() + 1:
         raise MpiError(ErrorClass.ERR_RMA_SYNC,
                        f"clear_lock by non-owner (lock word {prev})")
+
+
+# -- communication contexts (shmem_ctx_*, oshmem/include/shmem.h.in:207) --
+#
+# A context is an independent ordering/completion domain: quiet(ctx)
+# completes only the operations issued ON that context, so independent
+# streams (e.g. per-thread) never serialize against each other.  The
+# active-message spml tracks per-context outstanding-put counts; the
+# window flush is the completion point.
+
+class Ctx:
+    """``shmem_ctx_t``: an independent put/get/atomic issue stream."""
+
+    #: shmem_ctx_create option bits (shmem.h.in)
+    SERIALIZED = 1
+    PRIVATE = 2
+    NOSTORE = 4
+
+    def __init__(self, options: int = 0) -> None:
+        self.options = int(options)
+        self._destroyed = False
+
+    def _check(self) -> None:
+        if self._destroyed:
+            raise MpiError(ErrorClass.ERR_OTHER, "shmem ctx destroyed")
+
+    # issue surface: same verbs, bound to this context's domain
+    def put(self, sym, value, pe, index=0):
+        self._check()
+        return put(sym, value, pe, index)
+
+    def get(self, sym, count, pe, index=0):
+        self._check()
+        return get(sym, count, pe, index)
+
+    def p(self, sym, value, pe, index=0):
+        self._check()
+        return p(sym, value, pe, index)
+
+    def g(self, sym, pe, index=0):
+        self._check()
+        return g(sym, pe, index)
+
+    def atomic_add(self, sym, value, pe, index=0):
+        self._check()
+        return atomic_add(sym, value, pe, index)
+
+    def atomic_fetch_add(self, sym, value, pe, index=0):
+        self._check()
+        return atomic_fetch_add(sym, value, pe, index)
+
+    def atomic_compare_swap(self, sym, cond, value, pe, index=0):
+        self._check()
+        return atomic_compare_swap(sym, cond, value, pe, index)
+
+    def fence(self) -> None:
+        """Order THIS context's puts per target."""
+        self._check()
+        fence()
+
+    def quiet(self) -> None:
+        """Complete THIS context's outstanding operations.  The window
+        flush completes at least this context's ops (completing more is
+        spec-legal; contexts exist so callers need not wait on streams
+        they did not issue — the API contract, not a perf split, in the
+        active-message spml)."""
+        self._check()
+        quiet()
+
+    def destroy(self) -> None:
+        if not self._destroyed:
+            self.quiet()
+            self._destroyed = True
+
+
+#: ``SHMEM_CTX_DEFAULT``
+CTX_DEFAULT = Ctx()
+
+
+def ctx_create(options: int = 0) -> Ctx:
+    """``shmem_ctx_create`` (shmem.h.in:207)."""
+    _get()
+    return Ctx(options)
+
+
+def ctx_destroy(ctx: Ctx) -> None:
+    """``shmem_ctx_destroy``: implicit quiet, then invalidate."""
+    ctx.destroy()
+
+
+# -- bitwise / set atomics (shmem_atomic_{and,or,xor,set} + fetch) --------
+
+def _atomic_op(sym: SymArray, value, pe: int, index: int, op) -> None:
+    _get().win.accumulate(np.asarray([value], dtype=sym.dtype), pe,
+                          sym.byte_offset(index), op)
+
+
+def _atomic_fetch_op(sym: SymArray, value, pe: int, index: int, op):
+    out = _get().win.get_accumulate(
+        np.asarray([value], dtype=sym.dtype), pe, sym.byte_offset(index),
+        op)
+    a = np.asarray(out)
+    return a.view(sym.dtype)[0] if a.dtype != sym.dtype else a[0]
+
+
+def atomic_and(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    _atomic_op(sym, value, pe, index, op_mod.BAND)
+
+
+def atomic_or(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    _atomic_op(sym, value, pe, index, op_mod.BOR)
+
+
+def atomic_xor(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    _atomic_op(sym, value, pe, index, op_mod.BXOR)
+
+
+def atomic_fetch_and(sym: SymArray, value, pe: int, index: int = 0):
+    return _atomic_fetch_op(sym, value, pe, index, op_mod.BAND)
+
+
+def atomic_fetch_or(sym: SymArray, value, pe: int, index: int = 0):
+    return _atomic_fetch_op(sym, value, pe, index, op_mod.BOR)
+
+
+def atomic_fetch_xor(sym: SymArray, value, pe: int, index: int = 0):
+    return _atomic_fetch_op(sym, value, pe, index, op_mod.BXOR)
+
+
+def atomic_set(sym: SymArray, value, pe: int, index: int = 0) -> None:
+    """``shmem_atomic_set``: atomic store (REPLACE accumulate)."""
+    _atomic_op(sym, value, pe, index, op_mod.REPLACE)
+
+
+# -- strided alltoall (shmem_alltoalls32/64) ------------------------------
+
+def alltoalls(sym: SymArray, dst: int, sst: int, nelems: int) -> np.ndarray:
+    """``shmem_alltoalls``: strided alltoall — PE j takes elements
+    ``[j*sst*nelems : +nelems*sst : sst]``... per the spec, element k of
+    the block for PE j is read at ``sst*(j*nelems + k)`` and written at
+    ``dst*(j*nelems + k)``.  Returns the received (contiguous) blocks and
+    scatters them into ``sym.local`` at target stride ``dst``."""
+    ctx = _get()
+    n = ctx.world.size
+    need_src = sst * (n * nelems - 1) + 1
+    need_dst = dst * (n * nelems - 1) + 1
+    if max(need_src, need_dst) > sym.count:
+        raise MpiError(ErrorClass.ERR_BUFFER,
+                       f"alltoalls needs {max(need_src, need_dst)} "
+                       f"elements, symmetric array has {sym.count}")
+    src = np.array(sym.local[: sst * n * nelems : sst], copy=True)
+    out = ctx.world.alltoall(src.reshape(n, nelems))
+    flat = np.asarray(out).reshape(-1).astype(sym.dtype, copy=False)
+    sym.local[: dst * n * nelems : dst] = flat
+    return flat
+
+
+# -- accessibility probes (shmem.h.in:180-195) ----------------------------
+
+def pe_accessible(pe: int) -> bool:
+    """``shmem_pe_accessible``: a valid, live PE."""
+    ctx = _get()
+    if not 0 <= pe < ctx.world.size:
+        return False
+    from ompi_tpu.ft import state as ft_state
+
+    return not ft_state.is_failed(ctx.world.group.world_rank(pe))
+
+
+def addr_accessible(sym: SymArray, pe: int) -> bool:
+    """``shmem_addr_accessible``: symmetric address valid on that PE."""
+    if not pe_accessible(pe):
+        return False
+    ctx = _get()
+    return 0 <= sym.offset and sym.offset + sym.nbytes <= ctx.heap_bytes
+
+
+def shmem_ptr(sym: SymArray, pe: int):
+    """``shmem_ptr`` (shmem.h.in:195): a direct load/store view of the
+    peer's symmetric object when its heap is locally mapped (same-host
+    shared segments / the single-controller device world); None
+    otherwise — NULL is always a legal return per the spec."""
+    ctx = _get()
+    if pe == ctx.world.rank:
+        return sym.local
+    try:
+        base = ctx.win.shared_query(pe)
+    except Exception:
+        return None
+    if base is None:
+        return None
+    raw = np.asarray(base).view(np.uint8)
+    return raw[sym.offset:sym.offset + sym.nbytes].view(sym.dtype)
+
+
+# -- allocation variants (shmem_calloc / align / realloc) -----------------
+
+def calloc(count: int, dtype=np.float64) -> SymArray:
+    """``shmem_calloc``: zero-initialized symmetric allocation."""
+    sym = array(count, dtype)
+    sym.local[:] = 0
+    return sym
+
+
+def align(alignment: int, count: int, dtype=np.float64) -> SymArray:
+    """``shmem_align``: symmetric allocation at the given alignment."""
+    ctx = _get()
+    dt = np.dtype(dtype)
+    nbytes = count * dt.itemsize
+    off = ctx.alloc(nbytes, align=max(16, int(alignment)))
+    local = ctx.win.local[off:off + nbytes].view(dt)
+    return SymArray(off, nbytes, dt, count, local)
+
+
+def realloc(sym: SymArray, count: int) -> SymArray:
+    """``shmem_realloc``: collective; preserves the common prefix."""
+    new = array(count, sym.dtype)
+    keep = min(count, sym.count)
+    new.local[:keep] = sym.local[:keep]
+    free(sym)
+    return new
+
+
+def global_exit(status: int = 0) -> None:
+    """``shmem_global_exit``: terminate ALL PEs with ``status``."""
+    ctx = _get()
+    rte = ctx.world.rte
+    try:
+        abort = getattr(rte, "abort", None)
+        if abort is not None:
+            abort(int(status))
+    finally:
+        import os
+
+        os._exit(int(status))
 
 
 def reset_for_testing() -> None:

@@ -33,18 +33,24 @@ if w.rank == 0:
     x = np.arange(n, dtype=np.float64)
     w.send(x, dest=1, tag=3)
     assert spc.read("rget_msgs") >= 1, "sender never took the RGET branch"
-    # noncontiguous datatype: strided send falls back to the packed path
-    # but must still arrive intact through RGET
-    y = np.arange(2 * n, dtype=np.float64)[::2]
-    w.send(np.ascontiguousarray(y) * 2, dest=1, tag=4)
+    # derived (vector) datatype: pack_borrow cannot hand out a view, so
+    # RGET exposes the PACKED temporary — the non-borrowed branch
+    from ompi_tpu.datatype import core
+    nblk = n // 4
+    dt = core.vector(nblk, 2, 4, core.FLOAT64)   # 2-of-4 stride pattern
+    y = np.arange(4 * nblk, dtype=np.float64)
+    w.send((y, 1, dt), dest=1, tag=4)
     print("SENDER OK", flush=True)
 else:
     r = np.empty(n, np.float64)
     w.recv(r, source=0, tag=3)
     assert r[0] == 0 and r[-1] == n - 1 and r[n // 2] == n // 2, r
-    r2 = np.empty(n, np.float64)
+    nblk = n // 4
+    r2 = np.empty(2 * nblk, np.float64)
     w.recv(r2, source=0, tag=4)
-    assert r2[1] == 4.0 and r2[-1] == (2 * n - 2) * 2.0, r2
+    # packed stream = elements 0,1, 4,5, 8,9, ... of the source
+    assert r2[0] == 0 and r2[1] == 1 and r2[2] == 4 and r2[3] == 5, r2[:4]
+    assert r2[-1] == 4 * (nblk - 1) + 1, r2[-1]
     print("RECEIVER OK", flush=True)
 ompi_tpu.finalize()
 """

@@ -197,3 +197,117 @@ def test_shmem_sync_locks_strided(tmp_path):
     r = _tpurun(4, [sys.executable, str(script)])
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("shmem sync OK") == 4
+
+
+def test_shmem_contexts_bitwise_accessibility(tmp_path):
+    """shmem_ctx_* ordering domains, bitwise/set atomics, strided
+    alltoalls, pe/addr accessibility, calloc/align/realloc
+    (oshmem/include/shmem.h.in:180-207 families)."""
+    script = tmp_path / "shmem_new.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import ompi_tpu.shmem as shmem
+
+        shmem.init()
+        me, n = shmem.my_pe(), shmem.n_pes()
+
+        # -- contexts: independent issue streams, implicit quiet on destroy
+        flags = shmem.calloc(1, np.int64)
+        shmem.barrier_all()
+        ctx = shmem.ctx_create(shmem.Ctx.PRIVATE)
+        ctx.atomic_add(flags, 1, pe=0)
+        ctx.quiet()
+        shmem.barrier_all()
+        if me == 0:
+            assert flags.local[0] == n, flags.local
+        shmem.ctx_destroy(ctx)
+        try:
+            ctx.put(flags, 1, 0)
+            raise SystemExit("destroyed ctx accepted an op")
+        except Exception:
+            pass
+        # default context is always usable
+        shmem.CTX_DEFAULT.fence()
+
+        # -- bitwise + set atomics
+        bits = shmem.calloc(1, np.int64)
+        shmem.barrier_all()
+        shmem.atomic_or(bits, 1 << me, pe=0)
+        shmem.quiet()
+        shmem.barrier_all()
+        if me == 0:
+            assert bits.local[0] == (1 << n) - 1, bits.local
+        shmem.barrier_all()
+        old = shmem.atomic_fetch_and(bits, ~(1 << me), pe=0)
+        assert old >= 0
+        shmem.barrier_all()
+        if me == 0:
+            assert bits.local[0] == 0, bits.local
+        shmem.barrier_all()   # readers finish before the next mutation
+        shmem.atomic_set(bits, 7, pe=0)
+        shmem.barrier_all()
+        if me == 0:
+            assert bits.local[0] == 7
+        shmem.barrier_all()
+        x = shmem.calloc(1, np.int64)
+        shmem.barrier_all()
+        shmem.atomic_xor(x, me + 1, pe=(me + 1) % n)
+        shmem.quiet()
+        shmem.barrier_all()
+        assert x.local[0] == ((me - 1) % n) + 1, x.local
+
+        # -- strided alltoalls (spec: src index sst*(j*ne+k))
+        ne, sst, dst = 2, 2, 3
+        a = shmem.array(dst * n * ne, np.int64)
+        a.local[:] = -1
+        a.local[: sst * n * ne : sst] = [
+            me * 100 + v for v in range(n * ne)]
+        shmem.barrier_all()
+        got = shmem.alltoalls(a, dst=dst, sst=sst, nelems=ne)
+        want = []
+        for j in range(n):
+            want += [j * 100 + me * ne, j * 100 + me * ne + 1]
+        assert got.tolist() == want, (got.tolist(), want)
+        assert a.local[: dst * n * ne : dst].tolist() == want
+
+        # -- accessibility + ptr
+        assert shmem.pe_accessible(me) and shmem.pe_accessible(0)
+        assert not shmem.pe_accessible(n) and not shmem.pe_accessible(-1)
+        assert shmem.addr_accessible(a, (me + 1) % n)
+        ptr = shmem.shmem_ptr(a, me)
+        assert ptr is not None and ptr[0] == a.local[0]
+
+        # -- allocation variants
+        c = shmem.calloc(8, np.float32)
+        assert c.local.tolist() == [0.0] * 8
+        al = shmem.align(256, 4, np.float64)
+        assert al.offset % 256 == 0
+        al.local[:] = me
+        r = shmem.realloc(al, 8)
+        assert r.count == 8 and r.local[:4].tolist() == [me] * 4
+
+        print(f"SHMEM NEW OK {me}", flush=True)
+        shmem.finalize()
+    """))
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.stdout.count("SHMEM NEW OK") == 4, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_shmem_global_exit(tmp_path):
+    """shmem_global_exit terminates every PE with the given status."""
+    script = tmp_path / "gexit.py"
+    script.write_text(textwrap.dedent("""
+        import time
+        import ompi_tpu.shmem as shmem
+
+        shmem.init()
+        shmem.barrier_all()
+        if shmem.my_pe() == 1:
+            shmem.global_exit(3)
+        time.sleep(30)   # never reached on any PE if global_exit works
+        print("SURVIVED", flush=True)
+    """))
+    r = _tpurun(3, [sys.executable, str(script)], timeout=60)
+    assert "SURVIVED" not in r.stdout, r.stdout + r.stderr
+    assert r.returncode != 0
