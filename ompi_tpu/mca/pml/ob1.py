@@ -565,23 +565,37 @@ class Ob1Pml:
             return
         if key is not None:
             want = req.total
+            view = req.convertor.unpack_view(want)
             try:
-                view = req.convertor.unpack_view(want)
                 if view is not None:
                     # one-sided landing: peer bytes -> user buffer direct
                     ep.btl.get(ep, view, key)
-                    req.convertor.advance(len(view))
-                    n = len(view)
                 else:
                     tmp = np.empty(max(0, want), np.uint8)
                     ep.btl.get(ep, tmp, key)
-                    n = req.convertor.unpack(tmp)
             except Exception:
                 # exposed segment gone (sender died and tore down before
-                # detection) or btl without get: fail the recv, don't
-                # kill the progress engine
+                # detection) or btl without get: fail the recv — and
+                # best-effort notify a still-alive sender so its request
+                # completes and the exposure is released — instead of
+                # killing the progress engine.  Only the btl.get is
+                # guarded: a local convertor bug must NOT masquerade as
+                # a peer failure.
+                try:
+                    ep.btl.send(ep, Frag(frag.cid, frag.dst, frag.src,
+                                         -1, 0, CTL,
+                                         meta={"proto": "ob1_rget_done",
+                                               "req_id":
+                                                   frag.meta["req_id"]}))
+                except Exception:
+                    pass
                 self._rget_fail(req, frag, events)
                 return
+            if view is not None:
+                req.convertor.advance(len(view))
+                n = len(view)
+            else:
+                n = req.convertor.unpack(tmp)
             req.received = n
             req.status._nbytes = n
             spc.record("bytes_received", n)

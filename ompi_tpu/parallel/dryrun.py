@@ -4,26 +4,37 @@ from __future__ import annotations
 import numpy as np
 
 
-def make_step_and_args(devices, spec=None):
+def make_step_and_args(devices, spec=None, layers=None):
     """Shared flagship-path setup: (jitted step, (params, x)) on a mesh."""
     from ompi_tpu.parallel.mesh import make_mesh
     from ompi_tpu.parallel.train import (build_train_step, init_params,
                                          model_dims)
 
     mesh, mspec = make_mesh(devices, spec)
-    dims = model_dims(mspec)
-    step, place = build_train_step(mesh, mspec)
+    dims = model_dims(mspec, layers)
+    step, place = build_train_step(mesh, mspec, layers=layers)
     rng = np.random.RandomState(1)
     x = rng.normal(0, 1, (dims["batch"], dims["seq"], dims["d"]))
-    params, xd = place(init_params(mspec), x)
+    params, xd = place(init_params(mspec, layers=layers), x)
     return step, (params, xd), mspec
 
 
-def run_training_step(devices) -> float:
+def parse_spec(text: str):
+    """'dp=1,pp=2,sp=2,tp=2' -> MeshSpec (the driver/dryrun override)."""
+    from ompi_tpu.parallel.mesh import MeshSpec
+
+    sizes = {}
+    for part in str(text).split(","):
+        k, _, v = part.partition("=")
+        sizes[k.strip()] = int(v)
+    return MeshSpec(**sizes)
+
+
+def run_training_step(devices, spec=None) -> float:
     """Jit + run one train step over a mesh of the given devices."""
     import jax
 
-    step, (params, xd), spec = make_step_and_args(devices)
+    step, (params, xd), spec = make_step_and_args(devices, spec)
     new_params, loss = step(params, xd)
     jax.block_until_ready(new_params)
     loss = float(loss)

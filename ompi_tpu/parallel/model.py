@@ -42,9 +42,12 @@ def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None):
     scale = 1.0 / math.sqrt(hd)
     if use_flash is None:
         use_flash = _use_flash_default()
-    m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
-    num0 = jnp.zeros_like(q)
-    den0 = jnp.zeros(q.shape[:-1], q.dtype)
+    # derive the accumulator inits FROM q (0*q + const) so they inherit
+    # q's varying-manifest axes: fresh jnp.zeros/full would be unvarying
+    # and the scan carry would trip the vma checker under check_vma=True
+    m0 = q[..., 0] * 0 - jnp.inf
+    num0 = q * 0
+    den0 = q[..., 0] * 0
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
     def body(carry, _):

@@ -12,18 +12,30 @@ import jax
 import jax.numpy as jnp
 
 
-def pipeline_apply(stage_fn, stage_params, x_microbatches, *, pp: int):
+def pipeline_apply(stage_fn, stage_params, x_microbatches, *, pp: int,
+                   vary_axes: tuple = ("pp",)):
     """Run microbatches through pp stages; returns (M, *mb_shape) outputs.
 
     ``stage_fn(stage_params, x_mb) -> y_mb`` is this device's stage (its
     shard of the layer stack).  ``x_microbatches``: (M, *mb_shape), only
     read at stage 0; outputs are collected at stage pp-1 and zero elsewhere.
+
+    ``vary_axes``: mesh axes the stage outputs are device-varying over
+    beyond the input's own (``pp`` always; add e.g. ``tp`` when stage_fn
+    runs tensor-parallel collectives).  The carries are pre-marked with
+    ``pcast(to="varying")`` so the scan type-checks under ``check_vma=True`` — which is
+    load-bearing: vma tracking is what makes the ppermute/psum
+    TRANSPOSES correct, and with it off the pp>=2 backward silently
+    computes wrong gradients (caught by test_pp2_matches_pp1_same_model).
     """
     M = x_microbatches.shape[0]
     r = jax.lax.axis_index("pp") if pp > 1 else 0
     perm = [(i, (i + 1) % pp) for i in range(pp)]
     state = jnp.zeros_like(x_microbatches[0])
     outbuf = jnp.zeros_like(x_microbatches)
+    state = jax.lax.pcast(state, vary_axes, to="varying")
+    outbuf = jax.lax.pcast(outbuf, vary_axes, to="varying")
+    x_microbatches = jax.lax.pcast(x_microbatches, vary_axes, to="varying")
 
     def body(carry, t):
         state, outbuf = carry

@@ -30,8 +30,14 @@ _sp_impl_var = registry.register(
          "head<->seq reshard, 2 collectives; local heads must divide sp)")
 
 
-def model_dims(spec: MeshSpec) -> dict:
+def model_dims(spec: MeshSpec, layers: int = None) -> dict:
+    """``layers`` defaults to one per pipeline stage; override (a
+    multiple of pp) to hold model depth fixed across mesh specs — the
+    pp=2-vs-pp=1 equivalence tests depend on it."""
     tp, sp, dp, pp = spec.tp, spec.sp, spec.dp, spec.pp
+    L = pp if layers is None else int(layers)
+    if L % pp:
+        raise ValueError(f"layers={L} not divisible by pp={pp}")
     d = 8
     hd = 4
     n_heads = 2 * tp
@@ -47,12 +53,12 @@ def model_dims(spec: MeshSpec) -> dict:
         d=d, hd=hd, n_heads=n_heads, h_local=n_heads // tp, ff=ff,
         n_experts=n_experts, ffe=ffe, seq=s_local * sp, s_local=s_local,
         M=M, mb=mb, batch=mb * M * dp, b_local=mb * M, capacity=cap,
-        layers=pp, layers_local=1,
+        layers=L, layers_local=L // pp,
     )
 
 
-def init_params(spec: MeshSpec, seed: int = 0) -> dict:
-    dims = model_dims(spec)
+def init_params(spec: MeshSpec, seed: int = 0, layers: int = None) -> dict:
+    dims = model_dims(spec, layers)
     rng = np.random.RandomState(seed)
     d, L = dims["d"], dims["layers"]
     hh = dims["n_heads"] * dims["hd"]
@@ -81,7 +87,8 @@ def param_specs(P) -> dict:
     }
 
 
-def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4):
+def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
+                     layers: int = None):
     """Return (jitted_step, place) where step(params, x) -> (params, loss).
 
     ``place(params, x_np)`` device_puts globals with the right shardings.
@@ -91,7 +98,7 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4):
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    dims = model_dims(spec)
+    dims = model_dims(spec, layers)
     tp, sp_n, pp = spec.tp, spec.sp, spec.pp
     M, mb, s_l, d = dims["M"], dims["mb"], dims["s_local"], dims["d"]
     sp_impl = str(_sp_impl_var.value)
@@ -109,11 +116,18 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4):
     def body(params, x):
         def loss_fn(ps):
             xmb = x.reshape(M, mb, s_l, d)
-            y = pipeline_apply(stage_fn, ps, xmb, pp=pp)
-            # pipeline_apply outputs are zero off the last pp stage, so the
-            # psum over pp collects exactly the last stage's loss
+            y = pipeline_apply(stage_fn, ps, xmb, pp=pp,
+                               vary_axes=("pp", "tp"))
+            # pipeline_apply outputs are zero off the last pp stage, so
+            # the psum over pp collects exactly the last stage's loss.
+            # y is value-replicated across tp but vma-varying (it came
+            # through tp collectives): count the tp=0 replica only, so
+            # the psum over ALL axes is both value-correct and provably
+            # unvarying — gradients to the other tp shards still flow
+            # through the block's internal tp-psum transposes
             local = 0.5 * jnp.sum(y * y)
-            return jax.lax.psum(local, ("dp", "pp", "sp"))
+            local = jnp.where(jax.lax.axis_index("tp") == 0, local, 0.0)
+            return jax.lax.psum(local, ("dp", "pp", "sp", "tp"))
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = jax.tree.map(
@@ -124,11 +138,16 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4):
         return new, loss
 
     pspecs = param_specs(P)
+    # check_vma=True is LOAD-BEARING for correctness, not just a lint:
+    # the varying-manifest tracking is what makes the ppermute/psum
+    # transposes in the pp>=2 backward correct.  With it off the
+    # composed step compiles and descends — with silently wrong
+    # pipeline gradients (caught by test_pp2_matches_pp1_same_model).
     step = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(pspecs, P("dp", "sp", None)),
         out_specs=(pspecs, P()),
-        check_vma=False))
+        check_vma=True))
 
     def place(params, x_np):
         p = {k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))

@@ -144,12 +144,12 @@ def n_pes() -> int:
 
 # -- symmetric allocation ------------------------------------------------
 
-def array(count: int, dtype=np.float64) -> SymArray:
+def array(count: int, dtype=np.float64, align_bytes: int = 16) -> SymArray:
     """``shmem_malloc``: collective; identical offset on every PE."""
     ctx = _get()
     dt = np.dtype(dtype)
     nbytes = count * dt.itemsize
-    off = ctx.alloc(nbytes)
+    off = ctx.alloc(nbytes, align=max(16, int(align_bytes)))
     local = ctx.win.local[off:off + nbytes].view(dt)
     return SymArray(off, nbytes, dt, count, local)
 
@@ -565,6 +565,12 @@ def alltoalls(sym: SymArray, dst: int, sst: int, nelems: int) -> np.ndarray:
     scatters them into ``sym.local`` at target stride ``dst``."""
     ctx = _get()
     n = ctx.world.size
+    if dst < 1 or sst < 1 or nelems < 0:
+        raise MpiError(ErrorClass.ERR_ARG
+                       if hasattr(ErrorClass, "ERR_ARG")
+                       else ErrorClass.ERR_OTHER,
+                       f"alltoalls strides must be >= 1 "
+                       f"(dst={dst}, sst={sst}, nelems={nelems})")
     need_src = sst * (n * nelems - 1) + 1
     need_dst = dst * (n * nelems - 1) + 1
     if max(need_src, need_dst) > sym.count:
@@ -627,12 +633,7 @@ def calloc(count: int, dtype=np.float64) -> SymArray:
 
 def align(alignment: int, count: int, dtype=np.float64) -> SymArray:
     """``shmem_align``: symmetric allocation at the given alignment."""
-    ctx = _get()
-    dt = np.dtype(dtype)
-    nbytes = count * dt.itemsize
-    off = ctx.alloc(nbytes, align=max(16, int(alignment)))
-    local = ctx.win.local[off:off + nbytes].view(dt)
-    return SymArray(off, nbytes, dt, count, local)
+    return array(count, dtype, align_bytes=alignment)
 
 
 def realloc(sym: SymArray, count: int) -> SymArray:
