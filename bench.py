@@ -108,18 +108,13 @@ class DeviceBench:
             "allgather": lambda x: w.allgather_array(x),
         }[coll]
 
-    def point(self, coll: str, nbytes: int, iters: int = 10) -> dict:
-        if coll == "reduce_scatter":
-            # (n, n, S): each rank contributes n blocks of nbytes/n
-            nelem = max(self.ndev, nbytes // 4 // self.ndev * self.ndev)
-            x = self.xla_mod.make_world_array(np.ones(
-                (self.world.size, self.ndev, nelem // self.ndev),
-                np.float32))
-            xr = self.make(nbytes)
-        else:
-            x = xr = self.make(nbytes)
-        # interleave fw/raw samples so tunnel/clock drift cancels
-        fw, raw = self.fw_fn(coll), self.raw_fn(coll)
+    def _timed_pair(self, coll: str, fw, raw, x, xr, nbytes: int,
+                    iters: int) -> dict:
+        """ONE measurement protocol for every row: warmup, interleaved
+        fw/raw samples (tunnel/clock drift hits both sides of a pair
+        equally), medians + median pairwise ratio.  Shared so no row can
+        drift onto a skewed protocol again (round 2's 'persistent slower
+        than one-shot' artifact was exactly that)."""
         for _ in range(2):
             out = fw(x)
             out2 = raw(xr)
@@ -134,12 +129,8 @@ class DeviceBench:
             fw_s.append(t1 - t0)
             raw_s.append(t2 - t1)
         fw_t, raw_t = statistics.median(fw_s), statistics.median(raw_s)
-        # ratio from per-iteration PAIRS: fw and raw run back-to-back, so
-        # tunnel latency drift hits both sides of a pair equally and the
-        # median pairwise ratio is far more stable run-to-run than the
-        # ratio of independent medians
         pair_ratio = statistics.median(r / f_ for f_, r in zip(fw_s, raw_s))
-        f = _bus_factor(coll, self.ndev)
+        f = _bus_factor(coll.split("_")[0], self.ndev)
         return {
             "coll": coll, "nbytes": nbytes,
             "fw_lat_us": round(fw_t * 1e6, 2),
@@ -149,14 +140,27 @@ class DeviceBench:
             "ratio": round(pair_ratio, 4),
         }
 
-    def persistent_point(self, nbytes: int) -> dict:
+    def point(self, coll: str, nbytes: int, iters: int = 10) -> dict:
+        if coll == "reduce_scatter":
+            # (n, n, S): each rank contributes n blocks of nbytes/n
+            nelem = max(self.ndev, nbytes // 4 // self.ndev * self.ndev)
+            x = self.xla_mod.make_world_array(np.ones(
+                (self.world.size, self.ndev, nelem // self.ndev),
+                np.float32))
+            xr = self.make(nbytes)
+        else:
+            x = xr = self.make(nbytes)
+        return self._timed_pair(coll, self.fw_fn(coll), self.raw_fn(coll),
+                                x, xr, nbytes, iters)
+
+    def persistent_point(self, nbytes: int, iters: int = 40) -> dict:
+        """MPI_Allreduce_init analog, measured by the same interleaved
+        protocol as every other row."""
         x = self.make(nbytes)
         h = self.world.allreduce_array_init(x)
-        t = _time_fn(h, x)
-        f = _bus_factor("allreduce", self.ndev)
-        return {"coll": "allreduce_persistent", "nbytes": nbytes,
-                "fw_lat_us": round(t * 1e6, 2),
-                "fw_bw_gbs": round(f * nbytes / t / 1e9, 3)}
+        return self._timed_pair("allreduce_persistent", h,
+                                self.raw_fn("allreduce"), x, x, nbytes,
+                                iters)
 
 
 def host_ring_smoke() -> dict:
@@ -232,6 +236,62 @@ def host_allreduce_points(n: int = 4) -> list:
         os.unlink(script)
 
 
+MULTIDEV_SIZES = (8, 4096, 262144, 4 << 20)
+MULTIDEV_SPOT = 262144
+
+
+def multidev_child() -> None:
+    """Child body: 8-virtual-CPU-device ratio sweep (correctness-grade).
+
+    Ratios here measure framework dispatch + algorithm choice against
+    raw shard_map programs on the SAME 8-device CPU mesh — they make
+    tuned-ladder and xla-program regressions visible without pod access
+    (SURVEY.md §4's "fake backend MPI never had").  They are NOT
+    bandwidth numbers: CPU rings move bytes through host memory.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    b = DeviceBench()
+    rows = []
+    for nbytes in MULTIDEV_SIZES:
+        rows.append(b.point("allreduce", nbytes))
+    for coll in ("bcast", "allgather", "reduce_scatter"):
+        rows.append(b.point(coll, MULTIDEV_SPOT))
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_SWEEP_8DEV.json"), "w") as f:
+        json.dump({"ndev": b.ndev, "grade": "correctness",
+                   "results": rows}, f, indent=1)
+    import ompi_tpu
+
+    ompi_tpu.finalize()
+
+
+def multidev_sweep(ndev: int = 8) -> list:
+    """Run the virtual-multidevice sweep hermetically (fresh interpreter:
+    the parent's jax may be pinned to one real TPU chip) and return its
+    rows (empty on failure)."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={ndev}").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multidev-child"],
+        env=env, cwd=here, capture_output=True, text=True, timeout=900)
+    if proc.returncode:
+        print(f"multidev sweep failed (rc={proc.returncode}):\n"
+              f"{proc.stderr[-1500:]}", file=sys.stderr)
+        return []
+    try:
+        with open(os.path.join(here, "BENCH_SWEEP_8DEV.json")) as f:
+            return json.load(f)["results"]
+    except (OSError, KeyError, ValueError):
+        return []
+
+
 def main() -> None:
     fast = os.environ.get("OTPU_BENCH_FAST", "") not in ("", "0")
     try:
@@ -287,21 +347,39 @@ def main() -> None:
         except Exception as exc:
             print(f"host allreduce failed: {exc}", file=sys.stderr)
 
+        try:
+            multidev_rows = multidev_sweep()
+        except Exception as exc:
+            print(f"multidev sweep failed: {exc}", file=sys.stderr)
+            multidev_rows = []
+
+        def table(rows):
+            out = ["| coll | bytes | fw lat us | raw lat us | fw GB/s | "
+                   "raw GB/s | ratio |",
+                   "|---|---|---|---|---|---|---|"]
+            for r in rows:
+                out.append(
+                    f"| {r['coll']} | {r.get('nbytes', '-')} | "
+                    f"{r.get('fw_lat_us', '-')} | "
+                    f"{r.get('raw_lat_us', '-')} | "
+                    f"{r.get('fw_bw_gbs', '-')} | "
+                    f"{r.get('raw_bw_gbs', '-')} | "
+                    f"{r.get('ratio', '-')} |")
+            return out
+
         here = os.path.dirname(os.path.abspath(__file__))
         with open(os.path.join(here, "BENCH_SWEEP.json"), "w") as f:
             json.dump({"ndev": b.ndev, "results": results}, f, indent=1)
         lines = ["# Collective sweep (OSU protocol, BASELINE.md configs "
                  "#1-#5)", "",
-                 f"Devices: {b.ndev}", "",
-                 "| coll | bytes | fw lat us | raw lat us | fw GB/s | "
-                 "raw GB/s | ratio |",
-                 "|---|---|---|---|---|---|---|"]
-        for r in results:
-            lines.append(
-                f"| {r['coll']} | {r.get('nbytes', '-')} | "
-                f"{r.get('fw_lat_us', '-')} | {r.get('raw_lat_us', '-')} | "
-                f"{r.get('fw_bw_gbs', '-')} | {r.get('raw_bw_gbs', '-')} | "
-                f"{r.get('ratio', '-')} |")
+                 f"Devices: {b.ndev}", ""] + table(results)
+        if multidev_rows:
+            lines += ["", "## 8 virtual CPU devices (correctness-grade)",
+                      "",
+                      "Framework-vs-raw ratios on an 8-device CPU mesh: "
+                      "dispatch + algorithm-choice regressions show up "
+                      "here without pod access.  NOT bandwidth numbers.",
+                      ""] + table(multidev_rows)
         with open(os.path.join(here, "BENCH_SWEEP.md"), "w") as f:
             f.write("\n".join(lines) + "\n")
 
@@ -317,4 +395,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--multidev-child" in sys.argv:
+        multidev_child()
+    elif "--multidev" in sys.argv:
+        for row in multidev_sweep():
+            print(row)
+    else:
+        main()

@@ -170,6 +170,9 @@ class Comm(AttributeHost):
         return self.info.dup()
 
     def _check_state(self, peer: Optional[int] = None) -> None:
+        # NOTE: allreduce_array inlines the peer=None predicate
+        # (freed + is_revoked) on its fast path — mirror any new
+        # comm-wide check added here into that method too
         if self.freed:
             raise MpiError(ErrorClass.ERR_COMM, "communicator was freed")
         if self.is_revoked():
@@ -298,8 +301,15 @@ class Comm(AttributeHost):
 
     # device-array collectives (jax.Array over the ICI mesh) ------------
     def allreduce_array(self, x, op: op_mod.Op = op_mod.SUM):
-        self._check_state()
-        return self._coll("allreduce_array")(self, x, op)
+        # THE hot call of the framework (DP gradient sync): inline the
+        # state check and skip the _coll indirection — one dict probe on
+        # the per-comm vtable, then straight into the module fast path
+        if self.freed or self.is_revoked():
+            self._check_state()
+        fn = self.c_coll.get("allreduce_array")
+        if fn is None:
+            return self._coll("allreduce_array")(self, x, op)  # raise path
+        return fn(self, x, op)
 
     def bcast_array(self, x, root: int = 0):
         self._check_state()

@@ -566,11 +566,9 @@ def alltoalls(sym: SymArray, dst: int, sst: int, nelems: int) -> np.ndarray:
     ctx = _get()
     n = ctx.world.size
     if dst < 1 or sst < 1 or nelems < 0:
-        raise MpiError(ErrorClass.ERR_ARG
-                       if hasattr(ErrorClass, "ERR_ARG")
-                       else ErrorClass.ERR_OTHER,
-                       f"alltoalls strides must be >= 1 "
-                       f"(dst={dst}, sst={sst}, nelems={nelems})")
+        raise MpiError(ErrorClass.ERR_ARG,
+                       f"alltoalls needs dst >= 1, sst >= 1, nelems >= 0 "
+                       f"(got dst={dst}, sst={sst}, nelems={nelems})")
     need_src = sst * (n * nelems - 1) + 1
     need_dst = dst * (n * nelems - 1) + 1
     if max(need_src, need_dst) > sym.count:
