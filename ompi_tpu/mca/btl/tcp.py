@@ -52,7 +52,11 @@ class TcpBtl(Btl):
         self._rte = None
         self._listener: Optional[socket.socket] = None
         self._sel = selectors.DefaultSelector()
-        self._by_rank: dict[int, _Conn] = {}
+        # multi-link (btl_tcp_links): several connections per peer, frames
+        # round-robined across them — the reference's per-link striping
+        self._by_rank: dict[int, list[_Conn]] = {}
+        self._rr: dict[int, int] = {}
+        self._links = 1
         self._addr_cache: dict[int, tuple] = {}
         self._locks_guard = threading.Lock()
         self._connect_locks: dict[int, threading.Lock] = {}  # per peer
@@ -67,6 +71,11 @@ class TcpBtl(Btl):
             "max_send_size", vtype=VarType.SIZE, default="128k",
             help="Max fragment size for rendezvous streaming over tcp",
             on_set=lambda v: setattr(self, "max_send_size", v))
+        self.register_var(
+            "links", vtype=VarType.INT, default=1,
+            help="TCP connections per peer; frames stripe round-robin "
+                 "across them (btl_tcp_links)",
+            on_set=lambda v: setattr(self, "_links", max(1, int(v))))
 
     # -- lifecycle -------------------------------------------------------
     def setup(self, rte) -> bool:
@@ -112,15 +121,15 @@ class TcpBtl(Btl):
 
     # -- send path -------------------------------------------------------
     def _connect(self, rank: int, best_effort: bool = False) -> _Conn:
-        conn = self._by_rank.get(rank)
-        if conn is not None:
-            return conn
+        conns = self._by_rank.get(rank)
+        if conns:
+            return self._pick(rank, conns)
         with self._locks_guard:
             lock = self._connect_locks.setdefault(rank, threading.Lock())
-        with lock:   # one connection per PEER — peers connect in parallel
-            conn = self._by_rank.get(rank)
-            if conn is not None:
-                return conn
+        with lock:   # one connect round per PEER — peers connect in parallel
+            conns = self._by_rank.get(rank)
+            if conns:
+                return self._pick(rank, conns)
             # failed-connect backoff gates only BEST-EFFORT traffic (FT
             # heartbeats/floods): a dead host blackholes SYNs and a
             # blocking retry per tick would stall the sender for the full
@@ -138,32 +147,52 @@ class TcpBtl(Btl):
                     self._addr_cache[rank] = tuple(addr)
             if addr is None:
                 raise ConnectionError(f"no tcp address for rank {rank}")
-            sock = None
-            try:
-                sock = socket.create_connection(tuple(addr), timeout=5)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                # handshake: tell the peer who we are (framed like any
-                # fragment: header pickle + empty payload)
-                hello = pickle.dumps({"rank": self._rte.my_world_rank})
-                sock.sendall(_LEN.pack(_LEN.size + len(hello))
-                             + _LEN.pack(len(hello)) + hello)
-            except OSError:
-                if sock is not None:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
-                self._connect_backoff[rank] = time.monotonic() + 10.0
-                raise
-            self._connect_backoff.pop(rank, None)
-            conn = _Conn(sock, rank)
-            sock.setblocking(False)
-            self._sel.register(sock, selectors.EVENT_READ, conn)
-            from ompi_tpu.runtime import progress as progress_mod
+            conns = []
+            for _link in range(self._links):
+                sock = None
+                try:
+                    sock = socket.create_connection(tuple(addr), timeout=5)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    # handshake: tell the peer who we are (framed like
+                    # any fragment: header pickle + empty payload)
+                    hello = pickle.dumps({"rank": self._rte.my_world_rank})
+                    sock.sendall(_LEN.pack(_LEN.size + len(hello))
+                                 + _LEN.pack(len(hello)) + hello)
+                except OSError:
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    if not conns:
+                        self._connect_backoff[rank] = \
+                            time.monotonic() + 10.0
+                        raise
+                    break   # some links up: run with what connected
+                conn = _Conn(sock, rank)
+                sock.setblocking(False)
+                self._sel.register(sock, selectors.EVENT_READ, conn)
+                from ompi_tpu.runtime import progress as progress_mod
 
-            progress_mod.register_waiter(sock)
-            self._by_rank[rank] = conn
-            return conn
+                progress_mod.register_waiter(sock)
+                conns.append(conn)
+            self._connect_backoff.pop(rank, None)
+            # MERGE, never assign: _drain's handshake path may have
+            # appended accepted reply rails for this rank concurrently
+            self._by_rank.setdefault(rank, []).extend(conns)
+            return self._pick(rank, self._by_rank[rank])
+
+    def _pick(self, rank: int, conns: list) -> _Conn:
+        """Round-robin link selection (frames are self-contained; pml
+        sequence numbers reorder across links at the receiver)."""
+        i = self._rr.get(rank, 0)
+        self._rr[rank] = i + 1
+        try:
+            return conns[i % len(conns)]
+        except (ZeroDivisionError, IndexError):
+            # the progress thread dropped the last link concurrently
+            raise ConnectionError(f"no live tcp links to rank {rank}")
 
     def send(self, ep: Endpoint, frag: Frag) -> None:
         # FT control traffic is best-effort: it honours connect backoff
@@ -173,10 +202,11 @@ class TcpBtl(Btl):
         meta = frag.meta or {}
         ft = str(meta.get("proto", "")).startswith("ft_")
         if meta.get("est_only"):
-            conn = self._by_rank.get(ep.world_rank)
-            if conn is None:
+            conns = self._by_rank.get(ep.world_rank)
+            if not conns:
                 raise ConnectionError(
                     f"no established connection to rank {ep.world_rank}")
+            conn = self._pick(ep.world_rank, conns)
         else:
             conn = self._connect(ep.world_rank, best_effort=ft)
         # wire format: [u32 frame][u32 hlen][hdr pickle][payload raw] —
@@ -213,8 +243,7 @@ class TcpBtl(Btl):
                 # hard error (EPIPE/ECONNRESET): the bytes can never be
                 # delivered — drop them so close()'s flush loop terminates
                 conn.outbuf.clear()
-                if conn.rank is not None:
-                    self._by_rank.pop(conn.rank, None)
+                self._drop_conn(conn)
                 return
             if n == 0:
                 return
@@ -257,15 +286,26 @@ class TcpBtl(Btl):
                     conn.sock.close()
                 except (OSError, KeyError):
                     pass
-                if conn.rank is not None:
-                    self._by_rank.pop(conn.rank, None)
+                self._drop_conn(conn)
                 continue
             conn.inbuf += data
             events += self._drain(conn)
-        for conn in list(self._by_rank.values()):
+        for conn in self._all_conns():
             if conn.outbuf:
                 self._flush(conn)
         return events
+
+    def _all_conns(self) -> list:
+        return [c for conns in self._by_rank.values() for c in conns]
+
+    def _drop_conn(self, conn: "_Conn") -> None:
+        if conn.rank is None:
+            return
+        conns = self._by_rank.get(conn.rank)
+        if conns and conn in conns:
+            conns.remove(conn)
+            if not conns:
+                self._by_rank.pop(conn.rank, None)
 
     def _drain(self, conn: _Conn) -> int:
         import numpy as np
@@ -283,8 +323,8 @@ class TcpBtl(Btl):
             obj = pickle.loads(memoryview(frame)[_LEN.size:_LEN.size + hlen])
             if isinstance(obj, dict) and "rank" in obj and conn.rank is None:
                 conn.rank = obj["rank"]
-                # keep at most one conn per rank (cross-connect resolution)
-                self._by_rank.setdefault(conn.rank, conn)
+                # accepted links become reply rails for this rank too
+                self._by_rank.setdefault(conn.rank, []).append(conn)
                 continue
             cid, src, dst, tag, seq, kind, total_len, offset, meta = obj
             frag = Frag(cid, src, dst, tag, seq, kind,
@@ -299,12 +339,12 @@ class TcpBtl(Btl):
         # flush queued outbound bytes before closing (same delivered-but-
         # unsent exit hazard as btl/sm — see its close())
         deadline = time.monotonic() + 30.0
-        while (any(c.outbuf for c in self._by_rank.values())
+        while (any(c.outbuf for c in self._all_conns())
                and time.monotonic() < deadline):
-            for conn in list(self._by_rank.values()):
+            for conn in self._all_conns():
                 if conn.outbuf:
                     self._flush(conn)
-            if any(c.outbuf for c in self._by_rank.values()):
+            if any(c.outbuf for c in self._all_conns()):
                 time.sleep(0.0005)
         from ompi_tpu.runtime import progress as progress_mod
 

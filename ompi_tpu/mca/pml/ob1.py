@@ -289,12 +289,34 @@ class Ob1Pml:
         self.isend(comm, buf, dest, tag).wait()
 
     def _stream_rest(self, req: SendRequest, ack: Frag) -> None:
-        """Receiver matched our RNDV: push remaining FRAGs (RPUT analog)."""
+        """Receiver matched our RNDV: push remaining FRAGs (RPUT analog).
+
+        Multi-rail: FRAG frames are offset-addressed and reassembled by
+        req-id at the receiver, so the stream can stripe round-robin
+        across EVERY endpoint that reaches the peer, weighted by btl
+        bandwidth (``bml_r2.c``'s bandwidth-proportional scheduling /
+        btl/tcp link striping).  Eager/RNDV heads stay on the
+        lowest-latency rail — order matters only for the matched head.
+        """
         dst_world, peer_req = ack.src, ack.meta["peer_req"]
-        ep = self.bml.endpoint(dst_world)
+        rails = self._stripe_rails(dst_world, req.nbytes)
+        assigned = [0] * len(rails)
         while not req.convertor.finished:
+            if len(rails) == 1:
+                j = 0
+            else:
+                # finish-time greedy: give the frag to the rail that
+                # would complete its assigned bytes soonest — long-run
+                # bandwidth-proportional, and a 100x-slower rail never
+                # receives a frag a fast rail could finish first
+                j = min(range(len(rails)),
+                        key=lambda k: (assigned[k]
+                                       + rails[k].btl.max_send_size)
+                        / max(1, rails[k].btl.bandwidth))
+            ep = rails[j]
             off = req.convertor.position
             data, borrowed = req.convertor.pack_borrow(ep.btl.max_send_size)
+            assigned[j] += len(data)
             ep.btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
                                  -1, 0, FRAG, data, total_len=req.nbytes,
                                  offset=off, meta={"req_id": peer_req},
@@ -304,6 +326,17 @@ class Ob1Pml:
         if peruse.active():
             peruse.fire(peruse.REQ_COMPLETE, ack.cid, kind="send",
                         dest=req.dest, tag=req.tag)
+
+    def _stripe_rails(self, dst_world: int, nbytes: int) -> list:
+        """Endpoints eligible to carry one large transfer's FRAG stream
+        (the per-frag schedule itself is finish-time greedy in
+        _stream_rest)."""
+        eps = self.bml.endpoints(dst_world)
+        if (len(eps) < 2 or not self.component.stripe_enabled()
+                or nbytes < self.component.stripe_min()):
+            return eps[:1] or [self.bml.endpoint(dst_world)]
+        spc.record("striped_msgs")
+        return list(eps)
 
     # -- recv path -------------------------------------------------------
     def irecv(self, comm, buf, source: int, tag: int) -> Request:
@@ -704,10 +737,25 @@ class Ob1Component(Component):
                  "the receiver-pull RGET protocol "
                  "(pml_ob1_sendreq.h:375-401); 0 disables RGET — measured "
                  "~1.7x the RNDV stream's bandwidth at 4MB over btl/sm")
+        self._stripe_var = self.register_var(
+            "stripe", vtype=VarType.BOOL, default=True,
+            help="Stripe large RNDV/pull streams across every btl that "
+                 "reaches the peer, bandwidth-weighted (bml/r2 multi-rail)")
+        self._stripe_min_var = self.register_var(
+            "stripe_min", vtype=VarType.SIZE, default="2m",
+            help="Smallest message that stripes across rails")
 
     def rget_limit(self) -> int:
         var = getattr(self, "_rget_var", None)
         return int(var.value) if var is not None else 512 << 10
+
+    def stripe_enabled(self) -> bool:
+        var = getattr(self, "_stripe_var", None)
+        return bool(var.value) if var is not None else True
+
+    def stripe_min(self) -> int:
+        var = getattr(self, "_stripe_min_var", None)
+        return int(var.value) if var is not None else 2 << 20
 
     def get_module(self, rte) -> Ob1Pml:
         self._module = Ob1Pml(self, rte)

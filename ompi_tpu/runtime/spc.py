@@ -11,7 +11,7 @@ _COUNTERS = (
     "ibcast", "iallreduce", "ibarrier",
     "bytes_sent", "bytes_received", "bytes_packed", "bytes_unpacked",
     "unexpected_msgs", "out_of_sequence_msgs", "matched_msgs",
-    "rget_msgs",
+    "rget_msgs", "striped_msgs",
     "device_collectives", "device_bytes",
 )
 
