@@ -96,6 +96,15 @@ class PallasCollModule:
         return pc.all_gather(x, self.mesh, self.axis,
                              interpret=self.interpret)
 
+    def reduce_scatter_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        x = self._place(comm, x)
+        if op is not op_mod.SUM or not self._supported(x):
+            return self._delegate("reduce_scatter_array", comm, x, op)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        return pc.reduce_scatter_sum(x, self.mesh, self.axis,
+                                     interpret=self.interpret)
+
     def ppermute_array(self, comm, x, perm):
         perm = tuple((int(s), int(d)) for s, d in perm)
         rot = tuple((i, (i + 1) % self.n) for i in range(self.n))

@@ -224,6 +224,65 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
     return call
 
 
+@functools.lru_cache(maxsize=64)
+def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
+                          interpret: bool):
+    """Ring reduce-scatter (sum): n-1 steps, add fused into the ring;
+    device i ends owning fully-reduced block i (the first half of
+    ``coll_base_allreduce.c:341``'s ring, block-owner aligned)."""
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+
+    def kernel(x_ref, out_ref, acc_ref, recv_ref,
+               local_sem, send_sem, rs_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
+        cp.start()
+        cp.wait()
+
+        def rs_step(k, carry):
+            send_idx = lax.rem(my - 1 - k + 2 * n, n)
+            recv_idx = lax.rem(my - 2 - k + 2 * n, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=acc_ref.at[send_idx], dst_ref=recv_ref.at[k],
+                send_sem=send_sem, recv_sem=rs_sems.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait()
+            part = recv_ref[pl.ds(k, 1), :]
+            cur = acc_ref[pl.ds(recv_idx, 1), :]
+            acc_ref[pl.ds(recv_idx, 1), :] = cur + part
+            return carry
+
+        lax.fori_loop(0, n - 1, rs_step, 0)
+        # block `my` is now fully reduced here — it IS my result
+        cp2 = pltpu.make_async_copy(acc_ref.at[my], out_ref, local_sem)
+        cp2.start()
+        cp2.wait()
+
+    def call(x):  # x: (n, blk) per device -> (blk,) per device
+        kw = {}
+        cp = cparams(4)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((blk,), dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.VMEM((n, blk), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((n - 1, blk), jnp.dtype(dtype_str)),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
 # -- public entry points (shard_map wrappers) ----------------------------
 
 def right_permute(x, mesh, axis: str, interpret: bool = True):
@@ -259,6 +318,28 @@ def all_gather(x, mesh, axis: str, interpret: bool = True):
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
                              out_specs=P(), check_vma=False))(x)
+
+
+def reduce_scatter_sum(x, mesh, axis: str, interpret: bool = True):
+    """(n, n, *S) sharded on the leading rank axis -> (n, *S) sharded:
+    rank i receives the sum of everyone's block i via the DMA ring."""
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    payload_shape = tuple(x.shape[2:])
+    if n == 1:
+        return x.reshape((1,) + payload_shape)
+    blk = int(np.prod(payload_shape)) if payload_shape else 1
+    inner = _build_reduce_scatter(n, axis, blk, str(x.dtype), interpret)
+
+    def body(t):                       # t: (1, n, *S)
+        out = inner(t[0].reshape(n, blk))      # (blk,)
+        return out.reshape((1,) + payload_shape)
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False))(x)
 
 
 def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):

@@ -78,10 +78,12 @@ def pallas_world():
 
 def test_component_owns_slots_when_raised(pallas_world):
     w = pallas_world
-    owner = w.c_coll["allreduce_array"].__self__.__class__.__name__
-    assert owner == "PallasCollModule"
+    for slot in ("allreduce_array", "allgather_array",
+                 "reduce_scatter_array", "ppermute_array"):
+        assert w.c_coll[slot].__self__.__class__.__name__ \
+            == "PallasCollModule", slot
     # slots pallas does not implement stay with xla
-    assert w.c_coll["reduce_scatter_array"].__self__.__class__.__name__ \
+    assert w.c_coll["alltoall_array"].__self__.__class__.__name__ \
         == "XlaCollModule"
 
 
@@ -113,3 +115,31 @@ def test_component_allgather_and_permute(pallas_world):
     s = np.asarray(w.ppermute_array(host, swap))
     np.testing.assert_allclose(
         s, host[[i ^ 1 for i in range(8)]], rtol=1e-6)
+
+
+@pytest.mark.parametrize("payload", [(6,), (3, 5)])
+def test_kernel_reduce_scatter_sum(mesh, payload):
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(4).standard_normal(
+        (8, 8, *payload)).astype(np.float32)
+    y = np.asarray(pc.reduce_scatter_sum(jax.device_put(x), mesh, "x"))
+    want = x.sum(axis=0)         # (8, *payload): block i to rank i
+    np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+
+def test_component_reduce_scatter(pallas_world):
+    from ompi_tpu.api import op
+
+    w = pallas_world
+    host = np.random.default_rng(5).standard_normal(
+        (8, 8, 3)).astype(np.float32)
+    out = np.asarray(w.reduce_scatter_array(host))
+    np.testing.assert_allclose(out, host.sum(0), rtol=1e-4, atol=1e-5)
+    # non-SUM falls through to coll/xla
+    mx = np.asarray(w.reduce_scatter_array(host, op.MAX))
+    np.testing.assert_allclose(mx, host.max(0), rtol=1e-6)
+    assert w.c_coll["reduce_scatter_array"].__self__.__class__.__name__ \
+        == "PallasCollModule"
