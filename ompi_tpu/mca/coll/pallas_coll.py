@@ -105,6 +105,10 @@ class PallasCollModule:
         return pc.reduce_scatter_sum(x, self.mesh, self.axis,
                                      interpret=self.interpret)
 
+    def psum_scatter_array(self, comm, x):
+        # the SUM reduce-scatter by another name (coll/xla parity)
+        return self.reduce_scatter_array(comm, x, op_mod.SUM)
+
     def ppermute_array(self, comm, x, perm):
         perm = tuple((int(s), int(d)) for s, d in perm)
         rot = tuple((i, (i + 1) % self.n) for i in range(self.n))

@@ -139,6 +139,35 @@ def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
     return call
 
 
+def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
+              send_sem, rs_sems, align: int):
+    """The shared ring reduce-scatter phase: n-1 steps, each sending the
+    running partial for block (my+align-k) to the right neighbor and
+    fusing the incoming partial into block (my+align-1-k).  After the
+    loop, block (my+align+1) % n is fully reduced on this device —
+    align=0 for the all-reduce schedule (owner my+1), align=-1 for
+    owner-aligned reduce-scatter (owner my).  ONE copy of the DMA /
+    semaphore / accumulate discipline, shared by both kernels."""
+
+    def rs_step(k, carry):
+        send_idx = lax.rem(my + align - k + 2 * n, n)
+        recv_idx = lax.rem(my + align - 1 - k + 2 * n, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=acc_ref.at[send_idx], dst_ref=recv_ref.at[k],
+            send_sem=send_sem, recv_sem=rs_sems.at[k],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()   # my partial for block recv_idx arrived
+        part = recv_ref[pl.ds(k, 1), :]
+        cur = acc_ref[pl.ds(recv_idx, 1), :]
+        acc_ref[pl.ds(recv_idx, 1), :] = cur + part
+        return carry
+
+    lax.fori_loop(0, n - 1, rs_step, 0)
+    return lax.rem(my + align + 1 + n, n)   # the completed block
+
+
 @functools.lru_cache(maxsize=64)
 def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
                       interpret: bool):
@@ -162,26 +191,9 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
         cp.start()
         cp.wait()
 
-        # -- reduce-scatter phase -------------------------------------
-        def rs_step(k, carry):
-            send_idx = lax.rem(my - k + n, n)
-            recv_idx = lax.rem(my - k - 1 + n, n)
-            rdma = pltpu.make_async_remote_copy(
-                src_ref=acc_ref.at[send_idx], dst_ref=recv_ref.at[k],
-                send_sem=send_sem, recv_sem=rs_sems.at[k],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            rdma.start()
-            rdma.wait()   # my partial for block recv_idx arrived
-            part = recv_ref[pl.ds(k, 1), :]
-            cur = acc_ref[pl.ds(recv_idx, 1), :]
-            acc_ref[pl.ds(recv_idx, 1), :] = cur + part
-            return carry
-
-        lax.fori_loop(0, n - 1, rs_step, 0)
-
-        # after n-1 steps block (my+1)%n is fully reduced here
-        done = lax.rem(my + 1, n)
+        done = _rs_phase(lax, pl, pltpu, n=n, my=my, right=right,
+                         acc_ref=acc_ref, recv_ref=recv_ref,
+                         send_sem=send_sem, rs_sems=rs_sems, align=0)
         cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref.at[done],
                                     local_sem)
         cp2.start()
@@ -240,24 +252,11 @@ def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
         cp.start()
         cp.wait()
 
-        def rs_step(k, carry):
-            send_idx = lax.rem(my - 1 - k + 2 * n, n)
-            recv_idx = lax.rem(my - 2 - k + 2 * n, n)
-            rdma = pltpu.make_async_remote_copy(
-                src_ref=acc_ref.at[send_idx], dst_ref=recv_ref.at[k],
-                send_sem=send_sem, recv_sem=rs_sems.at[k],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            rdma.start()
-            rdma.wait()
-            part = recv_ref[pl.ds(k, 1), :]
-            cur = acc_ref[pl.ds(recv_idx, 1), :]
-            acc_ref[pl.ds(recv_idx, 1), :] = cur + part
-            return carry
-
-        lax.fori_loop(0, n - 1, rs_step, 0)
-        # block `my` is now fully reduced here — it IS my result
-        cp2 = pltpu.make_async_copy(acc_ref.at[my], out_ref, local_sem)
+        # align=-1: the completed block is `my` — it IS my result
+        done = _rs_phase(lax, pl, pltpu, n=n, my=my, right=right,
+                         acc_ref=acc_ref, recv_ref=recv_ref,
+                         send_sem=send_sem, rs_sems=rs_sems, align=-1)
+        cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref, local_sem)
         cp2.start()
         cp2.wait()
 
