@@ -31,7 +31,26 @@ def parse_spec(text: str):
 
 
 def run_training_step(devices, spec=None) -> float:
-    """Jit + run one train step over a mesh of the given devices."""
+    """Jit + run one train step over a mesh of the given devices.
+
+    When no spec override is given and the default mesh leaves the
+    pipeline axis inactive (pp only self-activates at >=16 devices), a
+    second pp-active step runs on the same devices so every dry run
+    validates the composed dp x pp x sp x tp program — the round-2 gap
+    where the pp>=2 backward had silently-wrong gradients."""
+    from ompi_tpu.parallel.mesh import MeshSpec, default_axis_sizes
+
+    loss = _one_descending_step(devices, spec)
+    n = len(devices)
+    if spec is None and n >= 4 and n % 2 == 0 \
+            and default_axis_sizes(n).pp == 1:
+        sizes = default_axis_sizes(n // 2).sizes()
+        sizes["pp"] = 2
+        _one_descending_step(devices[:2 * (n // 2)], MeshSpec(**sizes))
+    return loss
+
+
+def _one_descending_step(devices, spec) -> float:
     import jax
 
     step, (params, xd), spec = make_step_and_args(devices, spec)
