@@ -42,8 +42,8 @@ def run_training_step(devices, spec=None) -> float:
 
     loss = _one_descending_step(devices, spec)
     n = len(devices)
-    if spec is None and n >= 4 and n % 2 == 0 \
-            and default_axis_sizes(n).pp == 1:
+    if spec is None and n >= 4 and default_axis_sizes(n).pp == 1:
+        # pp=2 over half the factorization; odd counts drop one device
         sizes = default_axis_sizes(n // 2).sizes()
         sizes["pp"] = 2
         _one_descending_step(devices[:2 * (n // 2)], MeshSpec(**sizes))
