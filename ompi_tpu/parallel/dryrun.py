@@ -42,9 +42,13 @@ def run_training_step(devices, spec=None) -> float:
 
     loss = _one_descending_step(devices, spec)
     n = len(devices)
-    if spec is None and n >= 4 and default_axis_sizes(n).pp == 1:
-        # pp=2 over half the factorization; odd counts drop one device
-        sizes = default_axis_sizes(n // 2).sizes()
+    half = default_axis_sizes(n // 2) if n >= 4 else None
+    if (spec is None and half is not None and half.pp == 1
+            and default_axis_sizes(n).pp == 1):
+        # pp=2 over half the factorization; odd counts drop one device.
+        # half.pp must itself be 1 or doubling it would not cover
+        # 2*(n//2) devices (e.g. n=33: half=16 already has pp=2)
+        sizes = half.sizes()
         sizes["pp"] = 2
         _one_descending_step(devices[:2 * (n // 2)], MeshSpec(**sizes))
     return loss
