@@ -216,10 +216,10 @@ class SmCollComponent(Component):
             "priority", vtype=VarType.INT, default=35,
             help="Selection priority of coll/sm (mapped-segment colls)")
         self.slot_var = self.register_var(
-            "slot_size", vtype=VarType.SIZE, default="1m",
+            "slot_size", vtype=VarType.SIZE, default="2m",
             help="Per-rank shared slot size; larger payloads fall through "
                  "to the next coll module (measured crossover vs the "
-                 "tuned ring ~1-2MB on the oversubscribed host path)")
+                 "tuned ring ~2-4MB on the oversubscribed host path)")
 
     def comm_query(self, comm):
         rte = comm.rte
