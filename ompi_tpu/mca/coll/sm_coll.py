@@ -83,17 +83,33 @@ class SmCollModule:
         # job-qualified: a spawned job's cid-0 world must not collide with
         # the parent job's
         name = f"otpu_csm_{tag}_{getattr(rte, 'job', '0')}_{comm.cid}"
-        if comm.rank == 0:
-            shm = shared_memory.SharedMemory(name=name, create=True,
-                                             size=size)
-            shm.buf[:_HDR] = b"\0" * _HDR
-            rte.modex_put(f"coll_sm_{comm.cid}", name)
-        else:
-            # rank 0 publishes during ITS comm_enable; comm creation is
-            # collective so the blocking get cannot deadlock
-            got = rte.modex_get(comm.group.world_rank(0),
-                                f"coll_sm_{comm.cid}")
-            shm = _attach(got)
+        try:
+            if comm.rank == 0:
+                shm = shared_memory.SharedMemory(name=name, create=True,
+                                                 size=size)
+                shm.buf[:_HDR] = b"\0" * _HDR
+                rte.modex_put(f"coll_sm_{comm.cid}", name)
+            else:
+                # rank 0 publishes during ITS comm_enable; comm creation
+                # is collective so the blocking get cannot deadlock
+                got = rte.modex_get(comm.group.world_rank(0),
+                                    f"coll_sm_{comm.cid}")
+                if got is False:
+                    raise OSError("peer could not create the segment")
+                shm = _attach(got)
+        except OSError as exc:
+            # constrained /dev/shm (container defaults are as small as
+            # 64MB): surrender the slots to the fallback module instead
+            # of failing the communicator.  rank 0 publishes False so
+            # peers don't block on a name that will never appear.
+            if comm.rank == 0:
+                rte.modex_put(f"coll_sm_{comm.cid}", False)
+            from ompi_tpu.base.output import show_help
+
+            show_help("help-coll-sm", "no-segment", comm=comm.name,
+                      error=str(exc))
+            self._seg = None
+            return
         import ctypes
 
         self._seg = shm
@@ -155,6 +171,8 @@ class SmCollModule:
 
     # -- collectives ------------------------------------------------------
     def barrier(self, comm) -> None:
+        if self._seg is None:
+            return self._fallback.barrier(comm)
         self._rounds["bar"] += 1
         self._bump(_BAR_ARRIVE)
         self._wait_at_least(_BAR_ARRIVE, self._rounds["bar"] * comm.size,
@@ -162,7 +180,7 @@ class SmCollModule:
 
     def bcast(self, comm, buf, root=0):
         arr = np.ascontiguousarray(buf)
-        if arr.nbytes > self._slot:
+        if self._seg is None or arr.nbytes > self._slot:
             return self._fallback.bcast(comm, arr, root)
         self._rounds["bc"] += 1
         rnd, n = self._rounds["bc"], comm.size
@@ -180,7 +198,7 @@ class SmCollModule:
 
     def allreduce(self, comm, sendbuf, op: op_mod.Op = op_mod.SUM):
         arr = np.ascontiguousarray(sendbuf)
-        if arr.nbytes > self._slot:
+        if self._seg is None or arr.nbytes > self._slot:
             return self._fallback.allreduce(comm, arr, op)
         self._rounds["ar"] += 1
         rnd, n = self._rounds["ar"], comm.size
@@ -249,6 +267,10 @@ COMPONENT = SmCollComponent()
 
 from ompi_tpu.base.output import register_help as _rh
 
+_rh("help-coll-sm", "no-segment",
+    "coll/sm on {comm} could not create/attach its shared segment "
+    "({error}); mapped-segment collectives are disabled for this "
+    "communicator and the next coll module serves everything.")
 _rh("help-coll-sm", "no-fallback",
     "coll/sm on {comm}: no other selected coll module provides the "
     "above-slot collectives, so payloads larger than slot_size use the "
