@@ -265,6 +265,25 @@ class Comm(AttributeHost):
         self._check_state()
         return self._coll("reduce_scatter")(self, sendbuf, recvcounts, op)
 
+    def reduce_scatter_block(self, sendbuf, op: op_mod.Op = op_mod.SUM):
+        """``MPI_Reduce_scatter_block``: equal-sized blocks — sendbuf has
+        size*blockcount elements, each rank receives its reduced block."""
+        self._check_state()
+        arr = np.asarray(sendbuf)
+        lead = arr.shape[-1] if arr.ndim else arr.size
+        n = self.size
+        if lead % n:
+            raise MpiError(
+                ErrorClass.ERR_BUFFER,
+                f"reduce_scatter_block needs length divisible by {n}, "
+                f"got {lead}")
+        out = self._coll("reduce_scatter")(self, sendbuf,
+                                           [lead // n] * n, op)
+        if (isinstance(out, list) and len(out) == n
+                and self.rte is not None and self.rte.is_device_world):
+            return np.stack(out)   # single-controller: the whole table
+        return out                  # multiprocess: my block
+
     def scan(self, sendbuf, op: op_mod.Op = op_mod.SUM):
         self._check_state()
         return self._coll("scan")(self, sendbuf, op)
@@ -298,6 +317,80 @@ class Comm(AttributeHost):
                 root: int = 0) -> Request:
         self._check_state()
         return self._coll("ireduce")(self, sendbuf, op, root)
+
+    def _icompleted(self, fn, *args) -> Request:
+        """Eager "nonblocking" form for slots without an overlapped
+        schedule: runs the collective NOW and returns a born-complete
+        request.  LIMITATION vs MPI locality: the call blocks until the
+        collective finishes, so a program that interleaves one of these
+        with dependent point-to-point before waiting can deadlock where
+        a true nonblocking implementation would not (libnbc-backed slots
+        — iallreduce/ibcast/iscan/... — do overlap properly)."""
+        self._check_state()
+        r = CompletedRequest()
+        r.result = fn(*args)
+        return r
+
+    def _icoll(self, name: str, blocking, *args) -> Request:
+        """Route to a module-provided overlapped schedule (libnbc) when
+        one filled the slot; eager completed-request form otherwise."""
+        fn = self.c_coll.get(name)
+        if fn is not None:
+            self._check_state()
+            return fn(self, *args)
+        return self._icompleted(blocking, *args)
+
+    def iscan(self, sendbuf, op: op_mod.Op = op_mod.SUM) -> Request:
+        return self._icoll("iscan", self.scan, sendbuf, op)
+
+    def iexscan(self, sendbuf, op: op_mod.Op = op_mod.SUM) -> Request:
+        return self._icoll("iexscan", self.exscan, sendbuf, op)
+
+    def igather(self, sendbuf, root: int = 0) -> Request:
+        return self._icoll("igather", self.gather, sendbuf, root)
+
+    def igatherv(self, sendbuf, root: int = 0) -> Request:
+        return self._icompleted(self.gatherv, sendbuf, root)
+
+    def iscatter(self, sendbuf, root: int = 0) -> Request:
+        return self._icoll("iscatter", self.scatter, sendbuf, root)
+
+    def iscatterv(self, sendbufs, root: int = 0) -> Request:
+        return self._icompleted(self.scatterv, sendbufs, root)
+
+    def iallgatherv(self, sendbuf) -> Request:
+        return self._icompleted(self.allgatherv, sendbuf)
+
+    def ialltoallv(self, sendbufs) -> Request:
+        return self._icompleted(self.alltoallv, sendbufs)
+
+    def ialltoallw(self, sendbufs, recvtypes=None) -> Request:
+        return self._icompleted(self.alltoallw, sendbufs, recvtypes)
+
+    def ireduce_scatter(self, sendbuf, recvcounts=None,
+                        op: op_mod.Op = op_mod.SUM) -> Request:
+        return self._icoll("ireduce_scatter", self.reduce_scatter,
+                           sendbuf, recvcounts, op)
+
+    def ireduce_scatter_block(self, sendbuf,
+                              op: op_mod.Op = op_mod.SUM) -> Request:
+        return self._icompleted(self.reduce_scatter_block, sendbuf, op)
+
+    def ineighbor_allgather(self, sendbuf) -> Request:
+        return self._icompleted(self.neighbor_allgather, sendbuf)
+
+    def ineighbor_allgatherv(self, sendbuf) -> Request:
+        return self._icompleted(self.neighbor_allgatherv, sendbuf)
+
+    def ineighbor_alltoall(self, sendbufs) -> Request:
+        return self._icompleted(self.neighbor_alltoall, sendbufs)
+
+    def ineighbor_alltoallv(self, sendbufs) -> Request:
+        return self._icompleted(self.neighbor_alltoallv, sendbufs)
+
+    def ineighbor_alltoallw(self, sendbufs, recvtypes=None) -> Request:
+        return self._icompleted(self.neighbor_alltoallw, sendbufs,
+                                recvtypes)
 
     # device-array collectives (jax.Array over the ICI mesh) ------------
     def allreduce_array(self, x, op: op_mod.Op = op_mod.SUM):
@@ -458,6 +551,19 @@ class Comm(AttributeHost):
         return PersistentP2P(
             lambda: self.pml.isend(self, buf, dest, tag, sync=True))
 
+    def bsend_init(self, buf, dest: int, tag: int = 0) -> Request:
+        """``MPI_Bsend_init``: persistent buffered-mode send — every
+        start() claims attach-buffer space and completes locally."""
+        from ompi_tpu.api.request import PersistentP2P
+
+        self._check_state(dest)
+        return PersistentP2P(lambda: self.ibsend(buf, dest, tag))
+
+    def rsend_init(self, buf, dest: int, tag: int = 0) -> Request:
+        """``MPI_Rsend_init``: ready mode shares the standard path (with
+        a posted recv they are identical, like pml/ob1)."""
+        return self.send_init(buf, dest, tag)
+
     def recv_init(self, buf, source: int = ANY_SOURCE,
                   tag: int = ANY_TAG) -> Request:
         from ompi_tpu.api.request import CompletedRequest as _CR, \
@@ -527,6 +633,20 @@ class Comm(AttributeHost):
         hdr = np.array([payload.size], dtype=np.int64)
         return [self.isend(hdr, dest, tag), self.isend(payload, dest, tag)]
 
+    def bcast_obj(self, obj: Any = None, root: int = 0) -> Any:
+        """Broadcast an arbitrary picklable object (size agreed first)."""
+        import pickle
+
+        if self.rank == root:
+            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+            self.bcast(np.array([payload.size], np.int64), root=root)
+            self.bcast(payload, root=root)
+            return obj
+        hdr = np.asarray(self.bcast(np.zeros(1, np.int64), root=root))
+        payload = np.asarray(self.bcast(
+            np.zeros(int(hdr[0]), np.uint8), root=root))
+        return pickle.loads(payload.tobytes())
+
     def recv_obj(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Any:
         import pickle
 
@@ -587,6 +707,13 @@ class Comm(AttributeHost):
         req = CompletedRequest()
         req.result = newcomm
         return newcomm, req
+
+    def dup_with_info(self, info: Info) -> "Comm":
+        """``MPI_Comm_dup_with_info``: dup, with the new comm's hints
+        REPLACED by ``info`` instead of inherited."""
+        newcomm = self.dup()
+        newcomm.info = info.dup()
+        return newcomm
 
     def compare(self, other: "Comm") -> int:
         """``MPI_Comm_compare``: IDENT (same object), CONGRUENT (same
@@ -791,6 +918,30 @@ class Comm(AttributeHost):
         sub.name = f"{self.name}~cart"
         return sub
 
+    def cart_map(self, dims: Sequence[int], periods=None) -> int:
+        """``MPI_Cart_map``: the rank this process WOULD get in a
+        reordered cart over ``dims`` — UNDEFINED when it would be left
+        out (``ompi/mpi/c/cart_map.c``; base mapping + the node-major
+        treematch ordering cart_create(reorder=True) uses)."""
+        from ompi_tpu.api.status import UNDEFINED
+
+        dims = list(dims)
+        grid = int(np.prod(dims)) if dims else 1
+        if grid > self.size:
+            raise MpiError(ErrorClass.ERR_DIMS,
+                           f"grid {dims} larger than comm size {self.size}")
+        order = self._node_major_order()
+        newrank = order.index(self.rank) if order is not None else self.rank
+        return newrank if newrank < grid else UNDEFINED
+
+    def graph_map(self, index: Sequence[int], edges: Sequence[int]) -> int:
+        """``MPI_Graph_map``: identity-family mapping like the base
+        component (``mca/topo/base/topo_base_graph_map.c``)."""
+        from ompi_tpu.api.status import UNDEFINED
+
+        nnodes = len(index)
+        return self.rank if self.rank < nnodes else UNDEFINED
+
     def _node_major_order(self) -> Optional[list]:
         """Comm ranks sorted by (node, rank); None if locality unknown."""
         rte = self.rte
@@ -957,6 +1108,76 @@ class Comm(AttributeHost):
         waitall(reqs)
         return out
 
+    # neighbor v/w variants: per-neighbor sizes (and dtypes for w) ride
+    # the object channel — FIFO per (src, dst) pair like the fixed-size
+    # forms, with the single-controller table model mirrored
+    def neighbor_allgatherv(self, sendbuf) -> list:
+        self._require_any_topo()
+        srcs, dsts = self.topo.neighbors(self.rank)
+        if self.rte is not None and self.rte.is_device_world:
+            table = sendbuf   # table[r] = rank r's (arbitrary-size) buffer
+            return [None if s == PROC_NULL else np.asarray(table[s]).copy()
+                    for s in srcs]
+        from ompi_tpu.api.request import waitall
+
+        arr = np.ascontiguousarray(sendbuf)
+        reqs = [r for d in dsts if d != PROC_NULL
+                for r in self.isend_obj(arr, d, tag=-6)]
+        out = [None if s == PROC_NULL else self.recv_obj(s, tag=-6)
+               for s in srcs]
+        waitall(reqs)
+        return out
+
+    def neighbor_alltoallv(self, sendbufs) -> list:
+        self._require_any_topo()
+        srcs, dsts = self.topo.neighbors(self.rank)
+        if self.rte is not None and self.rte.is_device_world:
+            from collections import defaultdict, deque
+
+            chan: dict = defaultdict(deque)
+            for r in range(self.size):
+                _, r_dsts = self.topo.neighbors(r)
+                for k, d in enumerate(r_dsts):
+                    if d != PROC_NULL:
+                        chan[(r, d)].append(np.asarray(sendbufs[r][k]))
+            return [None if s == PROC_NULL
+                    else chan[(s, self.rank)].popleft().copy()
+                    for s in srcs]
+        if len(sendbufs) != len(dsts):
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"need {len(dsts)} send buffers, got "
+                           f"{len(sendbufs)}")
+        from ompi_tpu.api.request import waitall
+
+        reqs = [r for b, d in zip(sendbufs, dsts) if d != PROC_NULL
+                for r in self.isend_obj(np.ascontiguousarray(b), d,
+                                        tag=-6)]
+        out = [None if s == PROC_NULL else self.recv_obj(s, tag=-6)
+               for s in srcs]
+        waitall(reqs)
+        return out
+
+    def neighbor_alltoallw(self, sendbufs, recvtypes=None) -> list:
+        """Per-neighbor buffers AND per-neighbor receive dtypes."""
+        out = self.neighbor_alltoallv(sendbufs)
+        if recvtypes is None:
+            return out
+        typed = []
+        for j, b in enumerate(out):
+            if b is None:
+                typed.append(None)
+                continue
+            rt_ = recvtypes[j] if isinstance(recvtypes, (list, tuple)) \
+                else recvtypes
+            typed.append(np.ascontiguousarray(b).reshape(-1)
+                         .view(np.uint8).view(np.dtype(rt_)))
+        return typed
+
+    def _require_any_topo(self) -> None:
+        if self.topo is None:
+            raise MpiError(ErrorClass.ERR_TOPOLOGY,
+                           f"{self.name} has no topology")
+
     def release_coll_modules(self) -> None:
         """Tear down per-comm coll module state (shared segments etc.).
 
@@ -993,6 +1214,67 @@ class Comm(AttributeHost):
         from ompi_tpu import dpm
 
         return dpm.spawn(self, command, maxprocs, root)
+
+    def spawn_multiple(self, commands, maxprocs, root: int = 0) -> "Comm":
+        from ompi_tpu import dpm
+
+        return dpm.spawn_multiple(self, commands, maxprocs, root)
+
+    def create_intercomm(self, local_leader: int, bridge_comm: "Comm",
+                         remote_leader: int, tag: int = 0) -> "Comm":
+        """``MPI_Intercomm_create``: join two disjoint intracomms into an
+        intercommunicator through leaders that share ``bridge_comm``
+        (``ompi/communicator/comm.c`` ``ompi_intercomm_create``).
+
+        Leaders exchange group membership + a proposed CID over the
+        bridge (MAX wins), then EVERY member of both groups confirms the
+        winner is locally free — per-process CID bitmaps diverge, so the
+        multi-round confirm of ``_next_cid``/``create_group`` is needed
+        here too; on a conflict both sides re-propose above the loser.
+        """
+        from ompi_tpu.runtime import init as rt
+
+        self._check_state()
+        btag = -(1 << 22) - (int(tag) % (1 << 20))
+        remote = None
+        floor = 0
+        while True:
+            if self.rank == local_leader:
+                proposed = rt.candidate_cid(floor)
+                bridge_comm.send_obj(
+                    {"cid": proposed,
+                     "ranks": list(self.group.world_ranks)},
+                    remote_leader, tag=btag)
+                theirs = bridge_comm.recv_obj(remote_leader, tag=btag)
+                payload = {"cid": max(int(proposed), int(theirs["cid"])),
+                           "remote": theirs["ranks"]}
+            else:
+                payload = None
+            payload = self.bcast_obj(payload, root=local_leader)
+            cid = int(payload["cid"])
+            remote = payload["remote"]
+            ok = 1 if rt.is_cid_free(cid) else 0
+            grp_ok = int(np.asarray(self.allreduce(
+                np.array([ok], np.int64), op_mod.MIN)).ravel()[0])
+            if self.rank == local_leader:
+                bridge_comm.send_obj(grp_ok, remote_leader, tag=btag)
+                their_ok = int(bridge_comm.recv_obj(remote_leader,
+                                                    tag=btag))
+                both = min(grp_ok, their_ok)
+            else:
+                both = None
+            both = int(self.bcast_obj(both, root=local_leader))
+            if both:
+                break
+            floor = cid + 1
+        rt.reserve_cid(cid)
+        inter = Comm(self.group, cid, self.rte,
+                     name=f"{self.name}~inter", epoch=self.epoch,
+                     parent=self, remote_group=Group(
+                         [int(r) for r in remote]))
+        inter.local_comm = self
+        self._finish_create(inter)
+        return inter
 
     def accept(self, port: str, root: int = 0) -> "Comm":
         from ompi_tpu import dpm

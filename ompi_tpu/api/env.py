@@ -48,3 +48,19 @@ def alloc_mem(nbytes: int, info=None) -> np.ndarray:
 
 def free_mem(buf) -> None:
     """``MPI_Free_mem`` (the GC owns it; exists for API parity)."""
+
+
+_pcontrol_level = 1
+
+
+def pcontrol(level: int = 1, *args) -> None:
+    """``MPI_Pcontrol``: profiling-level hint.  The Python-layer tracers
+    (monitoring components, PERUSE subscribers) are toggled by their own
+    MCA vars; this records the application's requested level for them to
+    consult (``ompi/mpi/c/pcontrol.c`` is likewise a no-op hook)."""
+    global _pcontrol_level
+    _pcontrol_level = int(level)
+
+
+def pcontrol_level() -> int:
+    return _pcontrol_level

@@ -76,6 +76,25 @@ def _stream_nbytes(buf) -> int:
     return np.asarray(buf).nbytes
 
 
+# -- data representations (MPI_Register_datarep, io ompio datareps) -----
+#
+# "native" = bytes as-is; "external32" = canonical big-endian per etype
+# item; user reps registered here convert the whole byte stream between
+# file and memory representation (read_fn: file->memory bytes,
+# write_fn: memory->file bytes), the MPI_Register_datarep contract with
+# the dtype-conversion collapsed to the byte stream.
+_datareps: dict = {}
+
+
+def register_datarep(name: str, read_fn, write_fn,
+                     extent_fn=None) -> None:
+    """``MPI_Register_datarep``."""
+    if name in ("native", "external32") or name in _datareps:
+        raise MpiError(ErrorClass.ERR_ARG,
+                       f"datarep {name!r} already defined")
+    _datareps[name] = (read_fn, write_fn, extent_fn)
+
+
 def _bytes_to_buffer(data: bytes, buf) -> int:
     """Unpack stream bytes into the user buffer; returns element count."""
     if isinstance(buf, tuple):
@@ -208,6 +227,48 @@ class File:
         if self.closed:
             raise MpiError(ErrorClass.ERR_FILE, "file is closed")
 
+    # -- datarep conversion (applied at the stream boundary) -------------
+    def _convert(self, data, direction: str):
+        rep = getattr(self, "datarep", "native")
+        if rep == "native":
+            return data
+        if rep == "external32":
+            # segment-wise byteswap of the packed stream — derived
+            # etypes swap each field at its own itemsize (the convertor
+            # owns that walk; reuse it rather than re-deriving)
+            from ompi_tpu.datatype.convertor import (Convertor,
+                                                     ConvertorFlags)
+
+            size = max(1, self.etype.size)
+            if len(data) % size:
+                raise MpiError(ErrorClass.ERR_ARG,
+                               f"external32 stream of {len(data)} bytes "
+                               f"not a multiple of etype size {size}")
+            arr = np.frombuffer(data, np.uint8).copy()
+            cv = Convertor(self.etype, len(data) // size,
+                           flags=ConvertorFlags.EXTERNAL32)
+            cv._swap_external32(arr, 0)
+            return arr.tobytes()
+        read_fn, write_fn, _ = _datareps[rep]
+        fn = read_fn if direction == "read" else write_fn
+        out = fn(bytes(data), self.etype)
+        if len(out) != len(data):
+            # the read-sizing and file-pointer math assume the file and
+            # memory representations have equal extents
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"datarep {rep!r} changed the stream size "
+                           f"({len(data)} -> {len(out)}); only "
+                           "size-preserving representations are "
+                           "supported")
+        return out
+
+    def _to_stream(self, buf):
+        data, keep = _buffer_to_bytes(buf)
+        return self._convert(data, "write"), keep
+
+    def _from_stream(self, data, buf) -> int:
+        return _bytes_to_buffer(self._convert(data, "read"), buf)
+
     # -- view -------------------------------------------------------------
     def set_view(self, disp: int = 0, etype: Optional[Datatype] = None,
                  filetype: Optional[Datatype] = None,
@@ -219,11 +280,13 @@ class File:
         if self.filetype.size % max(1, self.etype.size):
             raise MpiError(ErrorClass.ERR_ARG,
                            "filetype size must be a multiple of etype size")
-        if datarep != "native":
+        if datarep not in ("native", "external32") \
+                and datarep not in _datareps:
             raise MpiError(ErrorClass.ERR_UNSUPPORTED_DATAREP
                            if hasattr(ErrorClass, "ERR_UNSUPPORTED_DATAREP")
                            else ErrorClass.ERR_ARG,
                            f"unsupported datarep {datarep!r}")
+        self.datarep = datarep
         self._fp = 0
         if self.comm is None or self.comm.rank == 0:
             self._shared_reset(0)
@@ -239,23 +302,23 @@ class File:
     # -- explicit-offset I/O ---------------------------------------------
     def write_at(self, offset: int, buf) -> int:
         self._check()
-        data, _ = _buffer_to_bytes(buf)
+        data, _ = self._to_stream(buf)
         return self.io_module.write_at(self, offset, data)
 
     def read_at(self, offset: int, buf) -> int:
         self._check()
         data = self.io_module.read_at(self, offset, _stream_nbytes(buf))
-        return _bytes_to_buffer(data, buf)
+        return self._from_stream(data, buf)
 
     def write_at_all(self, offset: int, buf) -> int:
         self._check()
-        data, _ = _buffer_to_bytes(buf)
+        data, _ = self._to_stream(buf)
         return self.io_module.write_at_all(self, offset, data)
 
     def read_at_all(self, offset: int, buf) -> int:
         self._check()
         data = self.io_module.read_at_all(self, offset, _stream_nbytes(buf))
-        return _bytes_to_buffer(data, buf)
+        return self._from_stream(data, buf)
 
     # nonblocking variants (MPI_File_iwrite_at & friends): the I/O path is
     # synchronous POSIX, so requests complete eagerly — same shape the
@@ -276,7 +339,7 @@ class File:
 
     def write(self, buf) -> int:
         self._check()
-        data, _ = _buffer_to_bytes(buf)
+        data, _ = self._to_stream(buf)
         n = self.io_module.write_at(self, self._fp, data)
         self._advance(buf, len(data))
         return n
@@ -285,11 +348,11 @@ class File:
         self._check()
         data = self.io_module.read_at(self, self._fp, _stream_nbytes(buf))
         self._advance(buf, len(data))
-        return _bytes_to_buffer(data, buf)
+        return self._from_stream(data, buf)
 
     def write_all(self, buf) -> int:
         self._check()
-        data, _ = _buffer_to_bytes(buf)
+        data, _ = self._to_stream(buf)
         n = self.io_module.write_at_all(self, self._fp, data)
         self._advance(buf, len(data))
         return n
@@ -298,7 +361,7 @@ class File:
         self._check()
         data = self.io_module.read_at_all(self, self._fp, _stream_nbytes(buf))
         self._advance(buf, len(data))
-        return _bytes_to_buffer(data, buf)
+        return self._from_stream(data, buf)
 
     def seek(self, offset: int, whence: int = SEEK_SET) -> None:
         self._check()
@@ -342,7 +405,7 @@ class File:
 
     def write_shared(self, buf) -> int:
         self._check()
-        data, _ = _buffer_to_bytes(buf)
+        data, _ = self._to_stream(buf)
         n_et = -(-len(data) // max(1, self.etype.size))
         pos = self._shared_fetch_add(n_et)
         return self.io_module.write_at(self, pos, data)
@@ -353,7 +416,7 @@ class File:
         n_et = -(-nbytes // max(1, self.etype.size))
         pos = self._shared_fetch_add(n_et)
         data = self.io_module.read_at(self, pos, nbytes)
-        return _bytes_to_buffer(data, buf)
+        return self._from_stream(data, buf)
 
     def seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
         """Collective in MPI; here any rank may reset the shared counter."""

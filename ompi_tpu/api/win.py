@@ -328,6 +328,17 @@ class Win(AttributeHost):
         self._check()
         self.module.wait(self)
 
+    def test(self) -> bool:
+        """``MPI_Win_test``: nonblocking ``wait`` — True iff the exposure
+        epoch completed (all access-group members called complete)."""
+        self._check()
+        fn = getattr(self.module, "pscw_test", None)
+        if fn is None:
+            raise MpiError(ErrorClass.ERR_RMA_SYNC,
+                           f"{self.name}'s osc module has no "
+                           "nonblocking PSCW test")
+        return bool(fn(self))
+
     # -- lifecycle -------------------------------------------------------
     def free(self) -> None:
         if self.freed:

@@ -437,3 +437,19 @@ def darray(size: int, rank: int, gsizes: Sequence[int],
                    contents=(size, rank, tuple(gsizes), tuple(distribs),
                              tuple(dargs), tuple(psizes), order, old))
     return out
+
+
+def match_size(typeclass: str, size: int) -> Datatype:
+    """``MPI_Type_match_size``: the named type of ``typeclass``
+    ("integer" | "real" | "complex") with exactly ``size`` bytes
+    (``ompi/mpi/c/type_match_size.c``)."""
+    table = {
+        "integer": {1: INT8, 2: INT16, 4: INT32, 8: INT64},
+        "real": {2: BFLOAT16, 4: FLOAT32, 8: FLOAT64},
+        "complex": {8: COMPLEX64, 16: COMPLEX128},
+    }
+    try:
+        return table[str(typeclass).lower()][int(size)]
+    except KeyError:
+        raise ValueError(
+            f"no {typeclass!r} type of {size} bytes") from None

@@ -103,6 +103,99 @@ def spawn(comm, command: Sequence[str], maxprocs: int,
                            name=f"{comm.name}~spawn")
 
 
+def spawn_multiple(comm, commands: Sequence[Sequence[str]],
+                   maxprocs: Sequence[int], root: int = 0) -> Comm:
+    """``MPI_Comm_spawn_multiple``: one child WORLD running several
+    executables — child ranks [0, maxprocs[0]) run commands[0], the next
+    maxprocs[1] run commands[1], ... (``ompi/mpi/c/comm_spawn_multiple.c``
+    semantics).  Returns the parent↔children intercommunicator."""
+    if len(commands) != len(maxprocs):
+        raise MpiError(ErrorClass.ERR_ARG,
+                       f"{len(commands)} commands vs {len(maxprocs)} counts")
+    per_rank: list = []
+    for cmd, cnt in zip(commands, maxprocs):
+        per_rank.extend([list(cmd)] * int(cnt))
+    comm._check_state()
+    total = len(per_rank)
+    info = np.zeros(2 + total, np.int64)
+    err = None
+    if comm.rank == root:
+        try:
+            client = _client(comm)
+            cid = _new_bridge_cid(client)
+            parent_ranks = ",".join(str(w) for w in comm.group.world_ranks)
+            ranks, job = client.spawn(
+                per_rank, total,
+                env={"OTPU_PARENT_RANKS": parent_ranks,
+                     "OTPU_PARENT_CID": str(cid)})
+            if len(ranks) != total:
+                raise MpiError(ErrorClass.ERR_SPAWN,
+                               f"spawn returned {len(ranks)} ranks")
+            info[0] = cid
+            info[1] = total
+            info[2:2 + total] = ranks
+        except Exception as exc:
+            err = exc
+            info[0] = -1
+    info = np.asarray(comm.bcast(info, root=root))
+    if int(info[0]) < 0:
+        if err is not None:
+            raise err
+        raise MpiError(ErrorClass.ERR_SPAWN, "spawn_multiple failed at root")
+    children = [int(r) for r in info[2:2 + int(info[1])]]
+    return _make_intercomm(comm, int(info[0]), children,
+                           name=f"{comm.name}~spawnm")
+
+
+def join(fd) -> Comm:
+    """``MPI_Comm_join``: build the 1x1 intercommunicator with whatever
+    process sits at the other end of the connected socket ``fd``
+    (``ompi/dpm/dpm.c`` ``ompi_dpm_dyn_init`` join path).
+
+    The socket carries only the rendezvous (a port name, like the
+    reference exchanges port strings over it); the intercomm itself is
+    wired through the coordination service, so both processes must
+    belong to the same coordination domain (same ``OTPU_COORD``).
+    """
+    import socket as _socket
+
+    import ompi_tpu
+
+    self_comm = ompi_tpu.COMM_SELF
+    sock = (fd if isinstance(fd, _socket.socket)
+            else _socket.socket(fileno=fd))
+    try:
+        # deterministic role election: both send their world rank
+        me = self_comm.rte.my_world_rank
+        sock.sendall(int(me).to_bytes(8, "big"))
+        other = int.from_bytes(_recv_exact(sock, 8), "big")
+        if me == other:
+            raise MpiError(ErrorClass.ERR_INTERN,
+                           "join requires two distinct processes")
+        if me < other:
+            port = open_port(self_comm)
+            blob = port.encode()
+            sock.sendall(len(blob).to_bytes(4, "big") + blob)
+            return accept(self_comm, port)
+        n = int.from_bytes(_recv_exact(sock, 4), "big")
+        port = _recv_exact(sock, n).decode()
+        return connect(self_comm, port)
+    finally:
+        if not isinstance(fd, _socket.socket):
+            sock.detach()   # the caller still owns the raw fd
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise MpiError(ErrorClass.ERR_INTERN,
+                           "join peer closed the socket")
+        out += chunk
+    return out
+
+
 _parent_intercomm: Optional[Comm] = None
 
 

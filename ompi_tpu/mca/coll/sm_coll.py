@@ -108,6 +108,23 @@ class SmCollModule:
 
             show_help("help-coll-sm", "no-segment", comm=comm.name,
                       error=str(exc))
+            shm = None
+        # the enable/disable decision must be COLLECTIVE: one rank whose
+        # attach failed running message-based collectives while the rest
+        # spin on shared counters would hang the communicator.  Vote over
+        # the fallback module (comm creation is collective, so everyone
+        # is here).
+        ok = np.array([1 if shm is not None else 0], np.int64)
+        all_ok = int(np.asarray(self._fallback.allreduce(
+            comm, ok, op_mod.MIN)).ravel()[0])
+        if not all_ok:
+            if shm is not None:
+                try:
+                    shm.close()
+                    if comm.rank == 0:
+                        shm.unlink()
+                except OSError:
+                    pass
             self._seg = None
             return
         import ctypes

@@ -239,16 +239,24 @@ class Pt2ptModule:
 
     def wait(self, win) -> None:
         """Close the exposure epoch: wait for every access-group member's
-        complete (MPI_Win_wait)."""
+        complete (MPI_Win_wait) — expressed over the one-copy
+        ``pscw_test`` accounting."""
+        while not self.pscw_test(win):
+            with self._pscw_cond:
+                self._pscw_cond.wait(0.05)
+            if self._stop.is_set():
+                return
+
+    def pscw_test(self, win) -> bool:
+        """Nonblocking ``wait`` (MPI_Win_test)."""
         starters = getattr(self, "_post_group", [])
         with self._pscw_cond:
-            while not all(s in self._completes for s in starters):
-                self._pscw_cond.wait(0.05)
-                if self._stop.is_set():
-                    return
+            if not all(s in self._completes for s in starters):
+                return False
             for s in starters:
                 self._completes.discard(s)
         self._post_group = []
+        return True
 
     # -- target side (the exposure agent) --------------------------------
     def _serve(self, win) -> None:

@@ -248,11 +248,18 @@ class RdmaModule:
         self._start_group = None
 
     def wait(self, win) -> None:
+        while not self.pscw_test(win):
+            time.sleep(0)
+
+    def pscw_test(self, win) -> bool:
+        """Nonblocking ``wait`` (MPI_Win_test) — the one copy of the
+        epoch-close accounting; ``wait`` spins on it."""
         seg = self._segs[win.comm.rank]
         want = self._post_group_size
-        while self._native.atomic_load_u64(seg.addr + _COMPLETE_CNT) < want:
-            time.sleep(0)
+        if self._native.atomic_load_u64(seg.addr + _COMPLETE_CNT) < want:
+            return False
         self._native.atomic_add_i64(seg.addr + _COMPLETE_CNT, -want)
+        return True
 
 
 class RdmaOscComponent(Component):

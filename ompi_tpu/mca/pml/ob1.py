@@ -90,6 +90,13 @@ class Message:
         self._pml._deliver_to_request(req, self._frag)
         return req.wait()
 
+    def irecv(self, buf) -> Request:
+        """``MPI_Imrecv``: nonblocking receive of the matched message."""
+        req = RecvRequest(self._pml, self._comm, buf,
+                          self.status.source, self.status.tag)
+        self._pml._deliver_to_request(req, self._frag)
+        return req
+
 
 class _MatchState:
     """Per-(cid, receiver) matching queues."""

@@ -100,8 +100,18 @@ def main(argv=None) -> int:
 
     def _spawn_handler(spawn_cmd, ranks, job, extra_env) -> None:
         """MPI_Comm_spawn execution: launch new global ranks as their own
-        job (their own COMM_WORLD), wired to the same coord server."""
-        for rank in ranks:
+        job (their own COMM_WORLD), wired to the same coord server.
+
+        ``spawn_cmd`` is one argv (every rank runs it) or a per-rank list
+        of argvs (MPI_Comm_spawn_multiple: one child world, several
+        executables)."""
+        per_rank = (list(spawn_cmd)
+                    if spawn_cmd and isinstance(spawn_cmd[0], (list, tuple))
+                    else [list(spawn_cmd)] * len(ranks))
+        if len(per_rank) != len(ranks):
+            raise ValueError(
+                f"spawn got {len(per_rank)} argvs for {len(ranks)} ranks")
+        for i, rank in enumerate(ranks):
             env = dict(env_base)
             env.update({k: str(v) for k, v in extra_env.items()})
             env["OTPU_RANK"] = str(rank)
@@ -110,7 +120,7 @@ def main(argv=None) -> int:
             env["OTPU_NPROCS"] = str(len(ranks))
             if args.fake_nodes > 0:
                 env["OTPU_NODE_ID"] = f"node{rank % args.fake_nodes}"
-            _launch(rank, env, argv=list(spawn_cmd))
+            _launch(rank, env, argv=list(per_rank[i]))
 
     server.set_spawn_handler(_spawn_handler)
 

@@ -1,0 +1,295 @@
+"""API-completeness batch: the remaining reference bindings
+(``ompi/mpi/c``) — spawn_multiple, intercomm_create, comm_join,
+reduce_scatter_block, nonblocking v-variants, neighbor v/w variants,
+persistent buffered/ready sends, imrecv, MPI_Win_test, cart/graph_map,
+type_match_size, MPI_Pcontrol, and MPI_Register_datarep/external32
+file views."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpurun(n, script, extra=(), timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+           *extra, sys.executable, str(script)]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO, env=env)
+
+
+@pytest.fixture(scope="module")
+def world():
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    yield w
+    rt.reset_for_testing()
+
+
+def test_dup_with_info_and_compare(world):
+    from ompi_tpu.api.info import Info
+
+    info = Info()
+    info.set("foo", "bar")
+    d = world.dup_with_info(info)
+    assert d.get_info().get("foo") == "bar"
+    assert world.get_info().get("foo") is None
+    assert world.compare(d) == world.CONGRUENT
+    d.free()
+
+
+def test_reduce_scatter_block_device_world(world):
+    n = world.size
+    x = np.arange(n * n * 3, dtype=np.float64).reshape(n, n * 3)
+    out = world.reduce_scatter_block(x)
+    want = x.sum(0).reshape(n, 3)
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_nonblocking_variants_smoke(world):
+    n = world.size
+    x = np.arange(n * 4, dtype=np.float64).reshape(n, 4)
+    r = world.iscan(x)
+    np.testing.assert_allclose(
+        np.asarray(r.result), np.cumsum(x, axis=0))
+    r = world.iexscan(x)
+    assert np.asarray(r.result)[0].sum() == 0
+    r = world.igatherv(list(x))
+    assert len(r.result) == n
+    r = world.ireduce_scatter_block(np.ones((n, n * 2)))
+    np.testing.assert_allclose(np.asarray(r.result),
+                               np.full((n, 2), float(n)))
+
+
+def test_neighbor_v_variants_cart(world):
+    cart = world.cart_create([world.size], periods=[True])
+    # device world: table of per-rank buffers with DIFFERENT sizes
+    table = [np.arange(r + 1, dtype=np.float64) * (r + 1)
+             for r in range(world.size)]
+    out = cart.neighbor_allgatherv(table)
+    srcs, _ = cart.topo.neighbors(cart.rank)
+    for got, s in zip(out, srcs):
+        np.testing.assert_allclose(got, table[s])
+    r = cart.ineighbor_allgatherv(table)
+    for got, s in zip(r.result, srcs):
+        np.testing.assert_allclose(got, table[s])
+    cart.free()
+
+
+def test_cart_and_graph_map(world):
+    from ompi_tpu.api.status import UNDEFINED
+
+    n = world.size
+    assert world.cart_map([n]) == world.rank
+    assert world.cart_map([1]) == (0 if world.rank == 0 else UNDEFINED)
+    assert world.graph_map([2, 3], [1, 0, 0]) in (world.rank, UNDEFINED)
+
+
+def test_type_match_size():
+    from ompi_tpu.datatype import core
+
+    assert core.match_size("integer", 4) is core.INT32
+    assert core.match_size("real", 8) is core.FLOAT64
+    assert core.match_size("complex", 16) is core.COMPLEX128
+    with pytest.raises(ValueError):
+        core.match_size("integer", 3)
+
+
+def test_pcontrol():
+    from ompi_tpu.api import env
+
+    env.pcontrol(0)
+    assert env.pcontrol_level() == 0
+    env.pcontrol(2, "extra", "args")
+    assert env.pcontrol_level() == 2
+    env.pcontrol()
+    assert env.pcontrol_level() == 1
+
+
+def test_file_external32_and_register_datarep(tmp_path, world):
+    from ompi_tpu.api import file as fmod
+    from ompi_tpu.datatype import core
+
+    path = str(tmp_path / "ext32.bin")
+    f = fmod.File.open(None, path,
+                       fmod.MODE_CREATE | fmod.MODE_RDWR)
+    f.set_view(etype=core.INT32, datarep="external32")
+    data = np.array([1, 2, 3, 4], np.int32)
+    f.write_at(0, data)
+    raw = open(path, "rb").read()
+    assert raw == data.byteswap().tobytes()   # big-endian on disk
+    out = np.zeros(4, np.int32)
+    f.read_at(0, out)
+    np.testing.assert_array_equal(out, data)
+    f.close()
+
+    # user-registered rep: xor-masked stream both ways
+    def mask(data, etype):
+        return bytes(b ^ 0x5A for b in data)
+
+    fmod.register_datarep("xor5a", mask, mask)
+    path2 = str(tmp_path / "xor.bin")
+    f = fmod.File.open(None, path2,
+                       fmod.MODE_CREATE | fmod.MODE_RDWR)
+    f.set_view(datarep="xor5a")
+    payload = np.frombuffer(b"hello-datarep!", np.uint8)
+    f.write_at(0, payload)
+    assert open(path2, "rb").read() == mask(payload.tobytes(), None)
+    back = np.zeros(payload.size, np.uint8)
+    f.read_at(0, back)
+    np.testing.assert_array_equal(back, payload)
+    f.close()
+    with pytest.raises(Exception):
+        fmod.register_datarep("external32", mask, mask)
+
+
+def test_win_pscw_test_rdma(tmp_path):
+    script = tmp_path / "wtest.py"
+    script.write_text(textwrap.dedent("""
+        import time
+        import numpy as np, ompi_tpu
+        from ompi_tpu.api.win import Win
+
+        w = ompi_tpu.init()
+        win = Win.create(w, size=8, dtype=np.float64)
+        grp_other = w.group.incl([1 - w.rank])
+        if w.rank == 0:
+            win.post(grp_other)
+            spins = 0
+            while not win.test():        # MPI_Win_test polling loop
+                time.sleep(0.005)
+                spins += 1
+                assert spins < 2000, "win.test never completed"
+            assert win.local[0] == 7.0, win.local
+            print("WTEST OK", flush=True)
+        else:
+            win.start(grp_other)
+            win.put(np.array([7.0]), 0, 0)
+            time.sleep(0.2)   # target must poll test() a few times
+            win.complete()
+        win.free()
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(2, script)
+    assert "WTEST OK" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_spawn_multiple_and_join(tmp_path):
+    childa = tmp_path / "childa.py"
+    childa.write_text(textwrap.dedent("""
+        import ompi_tpu
+        w = ompi_tpu.init()
+        inter = ompi_tpu.get_parent()
+        full = inter.merge(high=True)
+        import numpy as np
+        out = full.allreduce(np.array([1.0]))
+        print(f"CHILD-A rank {w.rank} of {w.size} sum {out[0]}",
+              flush=True)
+    """))
+    childb = tmp_path / "childb.py"
+    childb.write_text(childa.read_text().replace("CHILD-A", "CHILD-B"))
+    script = tmp_path / "spawnm.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        import numpy as np, ompi_tpu
+        w = ompi_tpu.init()
+        inter = w.spawn_multiple(
+            [[sys.executable, {str(childa)!r}],
+             [sys.executable, {str(childb)!r}]], [2, 1])
+        assert inter.remote_size == 3
+        full = inter.merge(high=False)
+        out = full.allreduce(np.array([1.0]))
+        assert out[0] == 5.0, out    # 2 parents + 3 children
+        print("SPAWNM OK", flush=True)
+    """))
+    r = _tpurun(2, script, timeout=300)
+    assert "SPAWNM OK" in r.stdout, r.stdout + r.stderr
+    # one child WORLD of 3 spanning both commands
+    assert "CHILD-A rank" in r.stdout and "of 3" in r.stdout
+    assert "CHILD-B rank" in r.stdout
+
+
+def test_comm_join_and_intercomm_create(tmp_path):
+    script = tmp_path / "join.py"
+    script.write_text(textwrap.dedent("""
+        import socket
+        import numpy as np, ompi_tpu
+        from ompi_tpu import dpm
+
+        w = ompi_tpu.init()
+        # build a plain connected socket pair between ranks 0 and 1
+        if w.rank == 0:
+            srv = socket.create_server(("127.0.0.1", 0))
+            w.send_obj(srv.getsockname(), 1, tag=9)
+            sock, _ = srv.accept()
+        else:
+            addr = w.recv_obj(0, tag=9)
+            sock = socket.create_connection(tuple(addr))
+        inter = dpm.join(sock)
+        assert inter.is_inter and inter.remote_size == 1
+        # talk across it
+        if w.rank == 0:
+            inter.send(np.array([42.0]), dest=0, tag=1)
+        else:
+            buf = np.zeros(1)
+            inter.recv(buf, source=0, tag=1)
+            assert buf[0] == 42.0
+        # MPI_Intercomm_create: two SELF "groups" bridged over world
+        half = w.split(w.rank)         # 1-rank comms
+        inter2 = half.create_intercomm(0, w, 1 - w.rank, tag=3)
+        assert inter2.is_inter and inter2.remote_size == 1
+        if w.rank == 0:
+            inter2.send(np.array([7.0]), dest=0, tag=2)
+        else:
+            buf = np.zeros(1)
+            inter2.recv(buf, source=0, tag=2)
+            assert buf[0] == 7.0
+        print(f"JOIN OK {w.rank}", flush=True)
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(2, script)
+    assert r.stdout.count("JOIN OK") == 2, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_imrecv_and_persistent_send_modes(tmp_path):
+    script = tmp_path / "imrecv.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.api import buffer as bsendbuf
+
+        w = ompi_tpu.init()
+        if w.rank == 0:
+            bsendbuf.attach(1 << 16)
+            req = w.bsend_init(np.arange(8.0), dest=1, tag=4)
+            req.start(); req.wait()
+            req.start(); req.wait()
+            rreq = w.rsend_init(np.arange(4.0) * 2, dest=1, tag=5)
+            rreq.start(); rreq.wait()
+            bsendbuf.detach()
+        else:
+            for _ in range(2):
+                msg = w.mprobe(source=0, tag=4)
+                buf = np.zeros(8)
+                r = msg.irecv(buf)        # MPI_Imrecv
+                r.wait()
+                assert buf.tolist() == list(range(8)), buf
+            buf = np.zeros(4)
+            w.recv(buf, source=0, tag=5)
+            assert buf[3] == 6.0
+        print(f"IMRECV OK {w.rank}", flush=True)
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(2, script)
+    assert r.stdout.count("IMRECV OK") == 2, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
