@@ -293,3 +293,36 @@ def test_imrecv_and_persistent_send_modes(tmp_path):
     r = _tpurun(2, script)
     assert r.stdout.count("IMRECV OK") == 2, r.stdout + r.stderr
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_neighbor_v_variants_multiprocess(tmp_path):
+    script = tmp_path / "nv.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+
+        w = ompi_tpu.init()
+        cart = w.cart_create([w.size], periods=[True])
+        r = cart.rank
+        mine = np.arange(r + 1, dtype=np.float64) * (r + 1)
+        out = cart.neighbor_allgatherv(mine)
+        srcs, dsts = cart.topo.neighbors(r)
+        for got, s in zip(out, srcs):
+            want = np.arange(s + 1, dtype=np.float64) * (s + 1)
+            assert np.allclose(got, want), (r, s, got)
+        # alltoallv: distinct payload per destination, varying sizes
+        sends = [np.full(d + 2, float(r * 10 + d)) for d in dsts]
+        got = cart.neighbor_alltoallv(sends)
+        for g, s in zip(got, srcs):
+            # the peer s sent us a buffer labeled s*10 + (my rank)
+            assert g[0] == s * 10 + r and len(g) == r + 2, (r, s, g)
+        # alltoallw: reinterpret received bytes per source
+        gotw = cart.neighbor_alltoallw(
+            [b.view(np.uint8) for b in sends], recvtypes=np.float64)
+        for g, s in zip(gotw, srcs):
+            assert g.dtype == np.float64 and g[0] == s * 10 + r
+        print(f"NV OK {r}", flush=True)
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(3, script)
+    assert r.stdout.count("NV OK") == 3, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
