@@ -444,14 +444,62 @@ class Comm(AttributeHost):
         self._check_state()
         return self._coll("exscan_array")(self, x, op)
 
-    def coll_init(self, coll: str, template, *args):
-        """Persistent collective (MPI_Allreduce_init & friends, MPI-4):
-        pre-bind the compiled program for ``template``-shaped buffers."""
+    #: blocking collectives coll_init may bind (MPI_*_init set)
+    _PCOLL_NAMES = frozenset({
+        "barrier", "bcast", "reduce", "allreduce", "gather", "gatherv",
+        "scatter", "scatterv", "allgather", "allgatherv", "alltoall",
+        "alltoallv", "alltoallw", "reduce_scatter",
+        "reduce_scatter_block", "scan", "exscan"})
+
+    def coll_init(self, coll: str, template=None, *args):
+        """Persistent collective (MPI_Allreduce_init & friends, MPI-4 /
+        the reference's mpiext/pcollreq): ONE interface on every path —
+        a restartable request (``start()``/``wait()``/``.result``).  On
+        the device path each start() re-dispatches the pre-compiled
+        program bound at init; on host paths it re-runs the selected
+        algorithm (schedule reuse, which is what pcollreq provides).
+        ``template=None`` binds zero-argument collectives (barrier).
+        For the bare callable compiled-program handle on device arrays,
+        use ``allreduce_array_init``."""
         self._check_state()
-        return self._coll("persistent_coll")(self, coll, template, *args)
+        from ompi_tpu.api.request import PersistentP2P
+
+        fn = self.c_coll.get("persistent_coll")
+        if fn is not None and template is not None:
+            handle = fn(self, coll, template, *args)
+
+            def _start_dev():
+                r = CompletedRequest()
+                r.result = handle(template)
+                return r
+
+            return PersistentP2P(_start_dev)
+        if coll not in self._PCOLL_NAMES:
+            raise MpiError(ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                           f"no persistent binding for '{coll}'")
+        blocking = getattr(self, coll)
+        call_args = () if template is None and not args \
+            else (template, *args)
+
+        def _start():
+            r = CompletedRequest()
+            r.result = blocking(*call_args)
+            return r
+
+        return PersistentP2P(_start)
 
     def allreduce_array_init(self, template, op: op_mod.Op = op_mod.SUM):
-        return self.coll_init("allreduce", template, op)
+        """Low-level persistent DEVICE collective: the bound compiled
+        program as a bare callable handle (``h(x)`` = one SPC bump + the
+        XLA dispatch).  ``coll_init`` wraps the same binding in the
+        uniform MPI request interface."""
+        fn = self.c_coll.get("persistent_coll")
+        if fn is None:
+            raise MpiError(ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                           "no device persistent-collective provider on "
+                           f"{self.name}; use coll_init for the host "
+                           "persistent request form")
+        return fn(self, "allreduce", template, op)
 
     def alltoall_array(self, x):
         self._check_state()

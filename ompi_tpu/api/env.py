@@ -2,6 +2,7 @@
 ``get_processor_name.c``, ``alloc_mem.c`` family)."""
 from __future__ import annotations
 
+import os
 import socket
 import time
 
@@ -64,3 +65,30 @@ def pcontrol(level: int = 1, *args) -> None:
 
 def pcontrol_level() -> int:
     return _pcontrol_level
+
+
+def get_affinity() -> list:
+    """``MPIX_Get_affinity`` (mpiext/affinity): the CPU set this process
+    is bound to (empty when unbound / unsupported)."""
+    try:
+        return sorted(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return []
+
+
+def query_accelerator_support() -> bool:
+    """``MPIX_Query_cuda_support`` analog: True when this process's
+    initialized runtime is accelerator-backed (device-buffer collectives
+    select; the TPU plays the reference's CUDA slot).  Meaningful after
+    ``ompi_tpu.init()`` — like the reference macro, it reports the
+    support already compiled/configured in, and deliberately does NOT
+    initialize a backend as a side effect of a query."""
+    from ompi_tpu.runtime import init as rt
+
+    world = rt.get_world_if_initialized()
+    if world is None or world.rte is None:
+        return False
+    if not world.rte.is_device_world:
+        return False
+    devs = getattr(world.rte, "devices", ())
+    return any(getattr(d, "platform", "cpu") != "cpu" for d in devs)

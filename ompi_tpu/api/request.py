@@ -184,8 +184,22 @@ class PersistentP2P(Request):
         self._issue = issue
         self._inner: Optional[Request] = None
 
+    @property
+    def result(self):
+        """The inner request's payload (persistent collectives return
+        their output here, like CompletedRequest.result)."""
+        return getattr(self._inner, "result", None)
+
     def _start(self) -> None:
-        inner = self._issue()
+        try:
+            inner = self._issue()
+        except MpiError as exc:
+            # the issue path ran a whole algorithm (persistent
+            # collectives) and failed: complete-in-error so wait() does
+            # not spin forever and the request stays restartable, then
+            # surface the error like the blocking call would
+            self.complete(exc)
+            raise
         self._inner = inner
 
         def mirror(r: Request) -> None:

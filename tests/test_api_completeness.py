@@ -326,3 +326,32 @@ def test_neighbor_v_variants_multiprocess(tmp_path):
     r = _tpurun(3, script)
     assert r.stdout.count("NV OK") == 3, r.stdout + r.stderr
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_host_persistent_collective_and_ext_queries(tmp_path):
+    """mpiext analogs: pcollreq on the host path (restartable persistent
+    collective), MPIX_Get_affinity, MPIX_Query_cuda_support."""
+    from ompi_tpu.api import env
+
+    aff = env.get_affinity()
+    assert isinstance(aff, list)
+    assert isinstance(env.query_accelerator_support(), bool)
+
+    script = tmp_path / "pcoll.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+
+        w = ompi_tpu.init()
+        x = np.full(4, float(w.rank + 1))
+        req = w.coll_init("allreduce", x)
+        for _ in range(3):                 # restartable: MPI_Start loop
+            req.start()
+            req.wait()
+        total = w.size * (w.size + 1) / 2
+        assert np.allclose(req.result, total), req.result
+        print(f"PCOLL OK {w.rank}", flush=True)
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(3, script)
+    assert r.stdout.count("PCOLL OK") == 3, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
