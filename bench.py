@@ -268,6 +268,7 @@ def multidev_child() -> None:
         rows.append(b.point("allreduce", nbytes))
     for coll in ("bcast", "allgather", "reduce_scatter"):
         rows.append(b.point(coll, MULTIDEV_SPOT))
+    rows.append(b.persistent_point(MULTIDEV_SPOT, iters=10))
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "BENCH_SWEEP_8DEV.json"), "w") as f:
         json.dump({"ndev": b.ndev, "grade": "correctness",
