@@ -365,3 +365,24 @@ class TestMultiFailure:
                          ("ft_detector_timeout", "1.5"),
                          ("ft_detector_startup_grace", "2.0")])
         assert r.stdout.count("DOUBLE OK") == 2, r.stdout + r.stderr
+
+
+class TestAgreementAlgorithms:
+    def test_alternate_algorithms_agree(self, tmp_path):
+        """The non-default agreement algorithms ('tree' = p2p reduce with
+        KV-anchored decision, 'kv' = coordinator-decides) stay correct."""
+        script = tmp_path / "alg.py"
+        script.write_text(textwrap.dedent("""
+            import ompi_tpu
+
+            w = ompi_tpu.init()
+            got = w.agree(0b1011 if w.rank % 2 else 0b1110)
+            assert got == 0b1010, bin(got)
+            print(f"ALG OK {w.rank}", flush=True)
+            ompi_tpu.finalize()
+        """))
+        for alg in ("tree", "kv"):
+            r = _tpurun(3, script,
+                        mca=[("coll_ftagree_algorithm", alg)])
+            assert r.stdout.count("ALG OK") == 3, (alg, r.stdout + r.stderr)
+            assert r.returncode == 0, (alg, r.stdout + r.stderr)
