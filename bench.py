@@ -369,15 +369,35 @@ def _table(rows) -> list:
     return out
 
 
-def write_sweep(ndev, results, multidev_rows, header_note="") -> None:
+def _atomic_write(path: str, text: str) -> None:
+    """Write-then-replace: a mid-write failure must never leave a
+    truncated file (the carried-forward device rows live here)."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def write_sweep(ndev, results, multidev_rows, header_note="",
+                stale_device_rows=None, stale_rounds=0) -> None:
     here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "BENCH_SWEEP.json"), "w") as f:
-        json.dump({"ndev": ndev, "results": results}, f, indent=1)
+    payload = {"ndev": ndev, "results": results}
+    if stale_device_rows:
+        payload["stale_device_rows"] = stale_device_rows
+        payload["stale_rounds"] = stale_rounds
+    _atomic_write(os.path.join(here, "BENCH_SWEEP.json"),
+                  json.dumps(payload, indent=1))
     lines = ["# Collective sweep (OSU protocol, BASELINE.md configs "
              "#1-#5)", ""]
     if header_note:
         lines += [header_note, ""]
     lines += [f"Devices: {ndev}", ""] + _table(results)
+    if stale_device_rows:
+        age = (f"at least {stale_rounds} fallback round(s) old"
+               if stale_rounds else "previous round")
+        lines += ["", f"## Carried-forward DEVICE rows ({age}; the "
+                  "tunnel was unreachable this round)", ""] \
+                 + _table(stale_device_rows)
     if multidev_rows:
         lines += ["", "## 8 virtual CPU devices (correctness-grade)",
                   "",
@@ -385,8 +405,27 @@ def write_sweep(ndev, results, multidev_rows, header_note="") -> None:
                   "dispatch + algorithm-choice regressions show up "
                   "here without pod access.  NOT bandwidth numbers.",
                   ""] + _table(multidev_rows)
-    with open(os.path.join(here, "BENCH_SWEEP.md"), "w") as f:
-        f.write("\n".join(lines) + "\n")
+    _atomic_write(os.path.join(here, "BENCH_SWEEP.md"),
+                  "\n".join(lines) + "\n")
+
+
+def _previous_device_rows():
+    """(device rows, stale_rounds) from the last committed sweep —
+    carried forward when the tunnel is unreachable so a fallback run
+    cannot erase them.  Device rows are classified STRUCTURALLY (they
+    carry a fw-vs-raw ratio; host rows never do), not by name list."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
+            old = json.load(f)
+    except (OSError, ValueError):
+        return [], 0
+    rows = [r for r in old.get("results", []) if "ratio" in r
+            or r.get("coll") == "allreduce_persistent"]
+    if rows:
+        return rows, 1
+    return (old.get("stale_device_rows", []),
+            int(old.get("stale_rounds", 0)) + 1)
 
 
 def unreachable_fallback(detail: str, fast: bool) -> None:
@@ -401,22 +440,29 @@ def unreachable_fallback(detail: str, fast: bool) -> None:
     print(f"TPU backend unavailable: {detail}; vs_baseline=0",
           file=sys.stderr)
     rows, mrows = [], []
+    recorded = False
     if not fast:
         try:
+            stale, stale_rounds = _previous_device_rows()
             rows = host_rows()
             mrows = multidev_sweep()
             write_sweep(0, rows, mrows, header_note=(
-                "**TPU tunnel unreachable this round**: device rows "
-                "absent; host-path rows + the virtual-CPU section below "
-                "still ran."))
+                "**TPU tunnel unreachable this round**: fresh device "
+                "rows absent; host-path rows + the virtual-CPU section "
+                "ran, and older device rows are carried below for "
+                "reference."), stale_device_rows=stale,
+                stale_rounds=stale_rounds)
+            recorded = True
         except Exception as exc:
             # the honest-zero metric line below must print regardless
             print(f"fallback sweep recording failed: {exc}",
                   file=sys.stderr)
+    state = (f"host rows + 8-virtual-CPU correctness ratios recorded "
+             f"({len(rows)}+{len(mrows)} rows)" if recorded
+             else "sweep recording FAILED (see stderr)")
     emit_metric(0.0, 0.0, note=(
         f"TPU backend unavailable ({detail.splitlines()[0][:120]}); "
-        "framework TPU path did not run.  Host rows + 8-virtual-CPU "
-        f"correctness ratios recorded ({len(rows)}+{len(mrows)} rows)."))
+        f"framework TPU path did not run.  {state}."))
 
 
 def main() -> None:
