@@ -2,13 +2,17 @@
 
 Slots in below coll/xla (priority 85 < 90): XLA's compiler-scheduled
 collectives stay the default, and this component is the explicit-schedule
-alternative — ring allreduce / all-gather / neighbor permute written
-directly against the interconnect with ``pltpu.make_async_remote_copy``
-(``ompi_tpu/ops/pallas_collectives.py``).  Raise
-``--mca coll_pallas_priority 95`` to make it own those three slots; any
-call shape it does not cover (non-sum ops, general permutations, host
-buffers) delegates to the next module in the comm's stack, the way
-coll/tuned falls through to coll/basic.
+alternative — ring allreduce / reduce-scatter / all-gather / pipelined
+bcast / neighbor permute written directly against the interconnect with
+``pltpu.make_async_remote_copy`` (``ompi_tpu/ops/pallas_collectives.py``).
+Reductions cover sum/max/min/prod; payloads above ``vmem_max_bytes``
+use the segmented HBM-resident kernels (bounded VMEM window), so the
+size ceiling is HBM (``max_bytes``), not VMEM; ``bidirectional`` routes
+fused-size all-reduces over both ICI directions at once.  Raise
+``--mca coll_pallas_priority 95`` to make it own these slots; any call
+shape it does not cover (MINLOC/user ops, general permutations) delegates
+to the next module in the comm's stack, the way coll/tuned falls through
+to coll/basic.
 
 Capability probe: real multi-chip TPU runs the compiled kernels;
 elsewhere (tests, virtual CPU meshes) they run in Pallas interpreter
@@ -28,9 +32,21 @@ from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
 
 
+#: MPI op name -> ring-kernel fold name (ompi_tpu/ops/pallas_collectives)
+_RING_OPS = {"SUM": "sum", "MAX": "max", "MIN": "min", "PROD": "prod"}
+
+#: per-rank payload ceiling when the kernels run in the Pallas
+#: interpreter (tests, virtual meshes): the interpreter executes the
+#: segment loop in Python, so routing arbitrarily large payloads to it
+#: would turn sub-second coll/xla calls into minutes — above this,
+#: delegate regardless of max_bytes
+_INTERPRET_MAX_BYTES = 16 << 20
+
+
 class PallasCollModule:
     def __init__(self, comm, devices, axis_name: str, interpret: bool,
-                 max_bytes: int) -> None:
+                 max_bytes: int, vmem_max_bytes: int,
+                 seg_bytes: int, bidirectional: bool) -> None:
         import jax
         from jax.sharding import Mesh
 
@@ -40,6 +56,9 @@ class PallasCollModule:
         self.n = len(self.devices)
         self.interpret = interpret
         self.max_bytes = max_bytes
+        self.vmem_max_bytes = vmem_max_bytes
+        self.seg_bytes = seg_bytes
+        self.bidirectional = bidirectional
         self._jax_array = jax.Array
         self._fallback = None   # resolved at comm_enable
 
@@ -74,18 +93,38 @@ class PallasCollModule:
             np.asarray(x), NamedSharding(self.mesh, P(self.axis)))
 
     def _supported(self, x) -> bool:
+        cap = self.max_bytes
+        if self.interpret:
+            cap = min(cap, _INTERPRET_MAX_BYTES)
         return (x.dtype.kind == "f"
-                and x.nbytes // max(1, self.n) <= self.max_bytes)
+                and x.nbytes // max(1, self.n) <= cap)
+
+    def _route(self, x):
+        """Pick the accumulator regime from the per-rank payload size:
+        fused VMEM kernel below ``vmem_max_bytes``, segmented HBM kernel
+        (bounded VMEM window of ``seg_bytes``) above — the selection the
+        reference's tuned ladder does between its linear and segmented
+        rings (``coll_base_allreduce.c:618``)."""
+        per_rank = x.nbytes // max(1, self.n)
+        if per_rank > self.vmem_max_bytes:
+            seg_elems = max(1, self.seg_bytes // x.dtype.itemsize)
+            return "seg", seg_elems
+        if self.bidirectional:
+            return "bidi", None
+        return "fused", None
 
     # -- collective slots ------------------------------------------------
     def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
         x = self._place(comm, x)
-        if op is not op_mod.SUM or not self._supported(x):
+        ring_op = _RING_OPS.get(op.name)
+        if ring_op is None or not self._supported(x):
             return self._delegate("allreduce_array", comm, x, op)
         from ompi_tpu.ops import pallas_collectives as pc
 
-        return pc.all_reduce_sum(x, self.mesh, self.axis,
-                                 interpret=self.interpret)
+        variant, seg_elems = self._route(x)
+        return pc.all_reduce(x, self.mesh, self.axis, ring_op,
+                             interpret=self.interpret, variant=variant,
+                             seg_elems=seg_elems)
 
     def allgather_array(self, comm, x):
         x = self._place(comm, x)
@@ -98,12 +137,27 @@ class PallasCollModule:
 
     def reduce_scatter_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
         x = self._place(comm, x)
-        if op is not op_mod.SUM or not self._supported(x):
+        ring_op = _RING_OPS.get(op.name)
+        if ring_op is None or not self._supported(x):
             return self._delegate("reduce_scatter_array", comm, x, op)
         from ompi_tpu.ops import pallas_collectives as pc
 
-        return pc.reduce_scatter_sum(x, self.mesh, self.axis,
-                                     interpret=self.interpret)
+        variant, seg_elems = self._route(x)
+        if variant == "bidi":   # no bidi reduce-scatter kernel (yet)
+            variant, seg_elems = "fused", None
+        return pc.reduce_scatter(x, self.mesh, self.axis, ring_op,
+                                 interpret=self.interpret, variant=variant,
+                                 seg_elems=seg_elems)
+
+    def bcast_array(self, comm, x, root: int = 0):
+        x = self._place(comm, x)
+        if not self._supported(x):
+            return self._delegate("bcast_array", comm, x, root)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        seg_elems = max(1, self.seg_bytes // x.dtype.itemsize)
+        return pc.bcast(x, self.mesh, self.axis, root=root,
+                        interpret=self.interpret, seg_elems=seg_elems)
 
     def psum_scatter_array(self, comm, x):
         # the SUM reduce-scatter by another name (coll/xla parity)
@@ -135,10 +189,25 @@ class PallasCollComponent(Component):
             help="Run kernels in Pallas interpreter mode: auto = only off "
                  "real TPU devices, 0/1 to force")
         self._max = self.register_var(
-            "max_bytes", vtype=VarType.SIZE, default="8m",
-            help="Largest per-rank payload routed to the DMA ring (the "
-                 "accumulator lives in VMEM); bigger calls fall through "
-                 "to coll/xla")
+            "max_bytes", vtype=VarType.SIZE, default="1g",
+            help="Largest per-rank payload routed to the DMA ring; "
+                 "bigger calls fall through to coll/xla.  Large payloads "
+                 "use the segmented HBM-resident kernels, so this bounds "
+                 "HBM, not VMEM")
+        self._vmem_max = self.register_var(
+            "vmem_max_bytes", vtype=VarType.SIZE, default="8m",
+            help="Per-rank payload crossover from the fused all-VMEM "
+                 "ring kernel to the segmented HBM-resident one "
+                 "(bounded VMEM window)")
+        self._seg = self.register_var(
+            "seg_bytes", vtype=VarType.SIZE, default="512k",
+            help="VMEM window size per buffer for the segmented ring "
+                 "kernels (two double-buffered windows this size)")
+        self._bidi = self.register_var(
+            "bidirectional", vtype=VarType.BOOL, default=False,
+            help="Use the bidirectional ring all-reduce (both ICI "
+                 "directions carry half the payload each step) for "
+                 "fused-size payloads")
         self._axis = self.register_var(
             "axis_name", default="mpi",
             help="Mesh axis name for coll/pallas kernels")
@@ -164,7 +233,10 @@ class PallasCollComponent(Component):
             return None
         return self._prio.value, PallasCollModule(
             comm, devices, self._axis.value,
-            self._interpret_mode(devices), int(self._max.value))
+            self._interpret_mode(devices), int(self._max.value),
+            vmem_max_bytes=int(self._vmem_max.value),
+            seg_bytes=int(self._seg.value),
+            bidirectional=bool(self._bidi.value))
 
 
 COMPONENT = PallasCollComponent()

@@ -19,15 +19,47 @@ remote DMA".
 All kernels are SPMD under ``shard_map`` over a 1-D mesh axis; payloads
 are split into per-device ring blocks outside the kernel.  They run in
 interpreter mode on a virtual CPU mesh (tests) and compile for real
-multi-chip ICI unchanged.  VMEM bounds the block size (the accumulator
-lives on-chip): huge payloads belong to coll/xla — the component's
-``max_bytes`` var gates selection accordingly.
+multi-chip ICI unchanged.
+
+Two accumulator regimes (round 4):
+
+* **fused** — the whole (n, blk) accumulator lives in VMEM; lowest
+  latency, bounded by VMEM size (the component's ``vmem_max_bytes``).
+* **segmented** — the accumulator and receive buffers are HBM-resident
+  and only a bounded double-buffered window (2 × ``seg`` elements)
+  streams through VMEM for the reduction, so payload size is bounded by
+  HBM, not VMEM — the explicit-DMA twin of the reference's *segmented*
+  ring (``coll_base_allreduce.c:618`` ring_segmented) whose entire point
+  is pipelining large payloads through bounded buffers.
+
+The **bidirectional** ring variant splits the payload in half and runs
+mirrored clockwise/counter-clockwise schedules concurrently — ICI links
+are duplex, so both directions carry traffic every step and the bisection
+time halves (the reference gets the same effect from its two-proc-group
+rdb/segmented hybrids; here it is one kernel).
+
+Reduction is parameterized (sum/max/min/prod) — one op argument, the
+same way ``ompi_op``'s function table parameterizes the reference's ring
+(``coll_base_allreduce.c:341`` takes any ``ompi_op_t``).
 """
 from __future__ import annotations
 
 import functools
 
 import numpy as np
+
+def _op_fn(jnp, op: str):
+    """Elementwise fold for a ring-kernel reduction op name."""
+    try:
+        return {
+            "sum": lambda a, b: a + b,
+            "max": jnp.maximum,
+            "min": jnp.minimum,
+            "prod": lambda a, b: a * b,
+        }[op]
+    except KeyError:
+        raise ValueError(
+            f"unsupported ring reduction {op!r}: one of sum/max/min/prod")
 
 
 def _mods():
@@ -140,14 +172,15 @@ def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
 
 
 def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
-              send_sem, rs_sems, align: int):
+              send_sem, rs_sems, align: int, fold):
     """The shared ring reduce-scatter phase: n-1 steps, each sending the
     running partial for block (my+align-k) to the right neighbor and
     fusing the incoming partial into block (my+align-1-k).  After the
     loop, block (my+align+1) % n is fully reduced on this device —
     align=0 for the all-reduce schedule (owner my+1), align=-1 for
     owner-aligned reduce-scatter (owner my).  ONE copy of the DMA /
-    semaphore / accumulate discipline, shared by both kernels."""
+    semaphore / accumulate discipline, shared by both kernels.
+    ``fold`` is the elementwise reduction."""
 
     def rs_step(k, carry):
         send_idx = lax.rem(my + align - k + 2 * n, n)
@@ -161,7 +194,7 @@ def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
         rdma.wait()   # my partial for block recv_idx arrived
         part = recv_ref[pl.ds(k, 1), :]
         cur = acc_ref[pl.ds(recv_idx, 1), :]
-        acc_ref[pl.ds(recv_idx, 1), :] = cur + part
+        acc_ref[pl.ds(recv_idx, 1), :] = fold(cur, part)
         return carry
 
     lax.fori_loop(0, n - 1, rs_step, 0)
@@ -170,8 +203,8 @@ def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
 
 @functools.lru_cache(maxsize=64)
 def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
-                      interpret: bool):
-    """Ring all-reduce (sum): n-1 reduce-scatter steps with the add fused
+                      interpret: bool, op: str = "sum"):
+    """Ring all-reduce: n-1 reduce-scatter steps with the fold fused
     into the ring loop, then n-1 all-gather steps — one kernel, the
     explicit-DMA form of ``coll_base_allreduce.c:341``.
 
@@ -182,6 +215,7 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
     is deliberately traded for VMEM).
     """
     jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    fold = _op_fn(jnp, op)
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref,
                local_sem, send_sem, rs_sems, ag_sems):
@@ -193,25 +227,15 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
 
         done = _rs_phase(lax, pl, pltpu, n=n, my=my, right=right,
                          acc_ref=acc_ref, recv_ref=recv_ref,
-                         send_sem=send_sem, rs_sems=rs_sems, align=0)
+                         send_sem=send_sem, rs_sems=rs_sems, align=0,
+                         fold=fold)
         cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref.at[done],
                                     local_sem)
         cp2.start()
         cp2.wait()
 
-        # -- all-gather phase -----------------------------------------
-        def ag_step(k, carry):
-            fwd = lax.rem(my + 1 - k + n, n)
-            rdma = pltpu.make_async_remote_copy(
-                src_ref=out_ref.at[fwd], dst_ref=out_ref.at[fwd],
-                send_sem=send_sem, recv_sem=ag_sems.at[k],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            rdma.start()
-            rdma.wait()   # completed block (my-k)%n landed from the left
-            return carry
-
-        lax.fori_loop(0, n - 1, ag_step, 0)
+        _ag_phase(lax, pl, pltpu, n=n, my=my, right=right,
+                  out_ref=out_ref, send_sem=send_sem, ag_sems=ag_sems)
 
     def call(x):  # x: (n, blk) per device
         kw = {}
@@ -238,11 +262,12 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
 
 @functools.lru_cache(maxsize=64)
 def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
-                          interpret: bool):
-    """Ring reduce-scatter (sum): n-1 steps, add fused into the ring;
+                          interpret: bool, op: str = "sum"):
+    """Ring reduce-scatter: n-1 steps, fold fused into the ring;
     device i ends owning fully-reduced block i (the first half of
     ``coll_base_allreduce.c:341``'s ring, block-owner aligned)."""
     jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    fold = _op_fn(jnp, op)
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref,
                local_sem, send_sem, rs_sems):
@@ -255,7 +280,8 @@ def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
         # align=-1: the completed block is `my` — it IS my result
         done = _rs_phase(lax, pl, pltpu, n=n, my=my, right=right,
                          acc_ref=acc_ref, recv_ref=recv_ref,
-                         send_sem=send_sem, rs_sems=rs_sems, align=-1)
+                         send_sem=send_sem, rs_sems=rs_sems, align=-1,
+                         fold=fold)
         cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref, local_sem)
         cp2.start()
         cp2.wait()
@@ -278,6 +304,420 @@ def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
             interpret=interpret,
             **kw,
         )(x)
+
+    return call
+
+
+def _ag_phase(lax, pl, pltpu, *, n, my, right, out_ref, send_sem,
+              ag_sems):
+    """The shared ring all-gather phase of the all-reduce kernels: n-1
+    steps, each forwarding the freshest completed block (my+1-k) to the
+    right neighbor in place on ``out_ref`` — pure DMA, no window."""
+
+    def ag_step(k, carry):
+        fwd = lax.rem(my + 1 - k + n, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[fwd], dst_ref=out_ref.at[fwd],
+            send_sem=send_sem, recv_sem=ag_sems.at[k],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()   # completed block (my-k)%n landed from the left
+        return carry
+
+    lax.fori_loop(0, n - 1, ag_step, 0)
+
+
+def _seg_rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
+                  send_sem, rs_sems, align: int, fold, nseg: int, seg: int,
+                  va, vb, load_sems, wb_sems):
+    """Segmented twin of ``_rs_phase``: acc/recv live in HBM; only a
+    2-slot double-buffered VMEM window (``va``/``vb``, each (2, seg))
+    streams through on-chip memory for the fold.  While segment s
+    reduces, segment s+1's loads are already in flight, and writebacks
+    drain one segment behind — the bounded-buffer pipeline of the
+    reference's segmented ring (``coll_base_allreduce.c:618``), which
+    exists precisely so payload size is bounded by main memory, not the
+    staging buffer."""
+
+    def rs_step(k, carry):
+        send_idx = lax.rem(my + align - k + 2 * n, n)
+        recv_idx = lax.rem(my + align - 1 - k + 2 * n, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=acc_ref.at[send_idx], dst_ref=recv_ref.at[k],
+            send_sem=send_sem, recv_sem=rs_sems.at[k],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()   # my partial for block recv_idx arrived (HBM)
+
+        def start_load(s):
+            slot = lax.rem(s, 2)
+            sl = pl.ds(s * seg, seg)
+            pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
+                                  load_sems.at[slot, 0]).start()
+            pltpu.make_async_copy(recv_ref.at[k, sl], vb.at[slot],
+                                  load_sems.at[slot, 1]).start()
+
+        def wait_wb(slot, s_of_wb):
+            # descriptor only carries the byte count to decrement
+            pltpu.make_async_copy(
+                va.at[slot], acc_ref.at[recv_idx, pl.ds(s_of_wb * seg, seg)],
+                wb_sems.at[slot]).wait()
+
+        start_load(0)
+
+        def seg_step(s, c):
+            slot = lax.rem(s, 2)
+
+            @pl.when(s + 1 < nseg)
+            def _prefetch():
+                @pl.when(s >= 1)
+                def _drain_prev_wb():
+                    # slot 1-slot's writeback (segment s-1) must land
+                    # before its VMEM buffer is reloaded
+                    wait_wb(1 - slot, s - 1)
+                start_load(s + 1)
+
+            sl = pl.ds(s * seg, seg)
+            pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
+                                  load_sems.at[slot, 0]).wait()
+            pltpu.make_async_copy(recv_ref.at[k, sl], vb.at[slot],
+                                  load_sems.at[slot, 1]).wait()
+            cur = va[pl.ds(slot, 1), :]
+            part = vb[pl.ds(slot, 1), :]
+            va[pl.ds(slot, 1), :] = fold(cur, part)
+            pltpu.make_async_copy(va.at[slot], acc_ref.at[recv_idx, sl],
+                                  wb_sems.at[slot]).start()
+            return c
+
+        lax.fori_loop(0, nseg, seg_step, 0)
+        # drain outstanding writebacks before this row is sent next step
+        wait_wb(lax.rem(nseg - 1, 2), nseg - 1)
+        if nseg >= 2:
+            wait_wb(lax.rem(nseg - 2, 2), nseg - 2)
+        return carry
+
+    lax.fori_loop(0, n - 1, rs_step, 0)
+    return lax.rem(my + align + 1 + n, n)   # the completed block
+
+
+@functools.lru_cache(maxsize=64)
+def _build_all_reduce_seg(n: int, axis: str, blk: int, seg: int,
+                          dtype_str: str, interpret: bool,
+                          op: str = "sum"):
+    """Segmented ring all-reduce for large payloads: HBM-resident
+    (n, blk) accumulator, bounded VMEM window, same ring schedule as
+    the fused kernel.  The all-gather phase is pure HBM↔HBM remote DMA
+    and needs no window at all."""
+    assert blk % seg == 0, (blk, seg)
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    fold = _op_fn(jnp, op)
+    nseg = blk // seg
+
+    def kernel(x_ref, out_ref, acc_ref, recv_ref, va, vb,
+               local_sem, send_sem, load_sems, wb_sems, rs_sems, ag_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
+        cp.start()
+        cp.wait()
+
+        done = _seg_rs_phase(
+            lax, pl, pltpu, n=n, my=my, right=right, acc_ref=acc_ref,
+            recv_ref=recv_ref, send_sem=send_sem, rs_sems=rs_sems,
+            align=0, fold=fold, nseg=nseg, seg=seg,
+            va=va, vb=vb, load_sems=load_sems, wb_sems=wb_sems)
+        cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref.at[done],
+                                    local_sem)
+        cp2.start()
+        cp2.wait()
+
+        _ag_phase(lax, pl, pltpu, n=n, my=my, right=right,
+                  out_ref=out_ref, send_sem=send_sem, ag_sems=ag_sems)
+
+    def call(x):  # x: (n, blk) per device
+        kw = {}
+        cp = cparams(5)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.HBM((n, blk), jnp.dtype(dtype_str)),
+                            pltpu.HBM((n - 1, blk), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((2, 2)),
+                            pltpu.SemaphoreType.DMA((2,)),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
+def _build_reduce_scatter_seg(n: int, axis: str, blk: int, seg: int,
+                              dtype_str: str, interpret: bool,
+                              op: str = "sum"):
+    """Segmented ring reduce-scatter (owner-aligned, align=-1) — the
+    large-payload twin of ``_build_reduce_scatter``."""
+    assert blk % seg == 0, (blk, seg)
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    fold = _op_fn(jnp, op)
+    nseg = blk // seg
+
+    def kernel(x_ref, out_ref, acc_ref, recv_ref, va, vb,
+               local_sem, send_sem, load_sems, wb_sems, rs_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
+        cp.start()
+        cp.wait()
+
+        done = _seg_rs_phase(
+            lax, pl, pltpu, n=n, my=my, right=right, acc_ref=acc_ref,
+            recv_ref=recv_ref, send_sem=send_sem, rs_sems=rs_sems,
+            align=-1, fold=fold, nseg=nseg, seg=seg,
+            va=va, vb=vb, load_sems=load_sems, wb_sems=wb_sems)
+        cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref, local_sem)
+        cp2.start()
+        cp2.wait()
+
+    def call(x):  # x: (n, blk) per device -> (blk,) per device
+        kw = {}
+        cp = cparams(6)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((blk,), dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.HBM((n, blk), jnp.dtype(dtype_str)),
+                            pltpu.HBM((n - 1, blk), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((2, 2)),
+                            pltpu.SemaphoreType.DMA((2,)),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
+def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
+                           interpret: bool, op: str = "sum"):
+    """Bidirectional ring all-reduce: the (n, 2*half) payload is split
+    into a clockwise half (columns [:half], sent rightward) and a
+    counter-clockwise half (columns [half:], sent leftward), with
+    mirrored reduce-scatter + all-gather schedules running concurrently.
+    ICI links are duplex, so both directions carry a half-payload every
+    step — per-step wire time halves vs the unidirectional ring.
+
+    CW completes block (my+1)'s left half; CCW completes block (my-1)'s
+    right half; the mirrored all-gather phases then circulate both.
+    """
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    fold = _op_fn(jnp, op)
+    blk = 2 * half
+
+    def kernel(x_ref, out_ref, acc_ref, recv_cw, recv_ccw,
+               local_sem, send_cw_sem, send_ccw_sem,
+               rs_cw_sems, rs_ccw_sems, ag_cw_sems, ag_ccw_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        left = lax.rem(my - 1 + n, n)
+        cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
+        cp.start()
+        cp.wait()
+
+        h = half
+
+        def rs_step(k, carry):
+            s_cw = lax.rem(my - k + 2 * n, n)
+            r_cw = lax.rem(my - 1 - k + 2 * n, n)
+            s_ccw = lax.rem(my + k, n)
+            r_ccw = lax.rem(my + 1 + k, n)
+            d_cw = pltpu.make_async_remote_copy(
+                src_ref=acc_ref.at[s_cw, pl.ds(0, h)],
+                dst_ref=recv_cw.at[k],
+                send_sem=send_cw_sem, recv_sem=rs_cw_sems.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d_ccw = pltpu.make_async_remote_copy(
+                src_ref=acc_ref.at[s_ccw, pl.ds(h, h)],
+                dst_ref=recv_ccw.at[k],
+                send_sem=send_ccw_sem, recv_sem=rs_ccw_sems.at[k],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d_cw.start()
+            d_ccw.start()
+            d_cw.wait()
+            d_ccw.wait()
+            cur_cw = acc_ref[pl.ds(r_cw, 1), pl.ds(0, h)]
+            acc_ref[pl.ds(r_cw, 1), pl.ds(0, h)] = fold(
+                cur_cw, recv_cw[pl.ds(k, 1), :])
+            cur_ccw = acc_ref[pl.ds(r_ccw, 1), pl.ds(h, h)]
+            acc_ref[pl.ds(r_ccw, 1), pl.ds(h, h)] = fold(
+                cur_ccw, recv_ccw[pl.ds(k, 1), :])
+            return carry
+
+        lax.fori_loop(0, n - 1, rs_step, 0)
+        done_cw = lax.rem(my + 1, n)
+        done_ccw = lax.rem(my - 1 + n, n)
+        c1 = pltpu.make_async_copy(acc_ref.at[done_cw, pl.ds(0, h)],
+                                   out_ref.at[done_cw, pl.ds(0, h)],
+                                   local_sem)
+        c1.start()
+        c1.wait()
+        c2 = pltpu.make_async_copy(acc_ref.at[done_ccw, pl.ds(h, h)],
+                                   out_ref.at[done_ccw, pl.ds(h, h)],
+                                   local_sem)
+        c2.start()
+        c2.wait()
+
+        def ag_step(k, carry):
+            f_cw = lax.rem(my + 1 - k + n, n)
+            f_ccw = lax.rem(my - 1 + k + n, n)
+            d_cw = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[f_cw, pl.ds(0, h)],
+                dst_ref=out_ref.at[f_cw, pl.ds(0, h)],
+                send_sem=send_cw_sem, recv_sem=ag_cw_sems.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d_ccw = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[f_ccw, pl.ds(h, h)],
+                dst_ref=out_ref.at[f_ccw, pl.ds(h, h)],
+                send_sem=send_ccw_sem, recv_sem=ag_ccw_sems.at[k],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d_cw.start()
+            d_ccw.start()
+            d_cw.wait()
+            d_ccw.wait()
+            return carry
+
+        lax.fori_loop(0, n - 1, ag_step, 0)
+
+    def call(x):  # x: (n, 2*half) per device
+        kw = {}
+        cp = cparams(7)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.VMEM((n, blk), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((n - 1, half), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((n - 1, half), jnp.dtype(dtype_str)),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
+def _build_bcast(n: int, axis: str, nseg: int, seg: int, dtype_str: str,
+                 interpret: bool):
+    """Pipelined segmented ring broadcast — the "clamped conveyor": root
+    streams S segments rightward and every hop forwards segment s one
+    wave after receiving it, so all links are busy simultaneously and
+    total time ≈ (S + n - 2) segment-hops instead of (n-1) full-payload
+    hops — the explicit-DMA form of the reference's pipeline bcast
+    (``coll_base_bcast.c`` pipeline/chain algorithms).
+
+    The schedule is fully symmetric (SPMD-clean, no masked DMAs — a
+    masked send would desync the per-op DMA rendezvous the interpreter
+    emulates remote copies with): at wave j, the device at ring position
+    r = (my-root) mod n forwards slot ``clamp(j-r, 0, S-1)``.  Below the
+    clamp the payload is not-yet-valid filler that a valid write always
+    overwrites before the receiver forwards that slot (position r first
+    forwards slot s at wave s+r, having received the valid copy at wave
+    s+r-1); above the clamp it is a benign same-bytes re-send.  The last
+    device aims its writes at a sink row (``out[S]``) so the conveyor
+    never races root's source rows.
+    """
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    waves = nseg + n - 2
+
+    # root arrives as a runtime SMEM scalar, not a cache key: the kernel
+    # only uses it through rel = (my - root) mod n, so one compile
+    # serves every root (round-robin-root workloads stay cache-hot)
+    def kernel(root_ref, x_ref, out_ref, local_sem, send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        rel = lax.rem(my - root_ref[0] + n, n)
+        # everyone seeds out with its local buffer: root's rows are the
+        # payload, other devices' rows are pre-valid filler the conveyor
+        # overwrites in time
+        cp = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(0, nseg)],
+                                   local_sem)
+        cp.start()
+        cp.wait()
+
+        def wave(j, carry):
+            slot = lax.clamp(0, j - rel, nseg - 1)
+            # the ring's last device (rel n-1) writes into root's sink
+            # row: root's real rows are the source of truth
+            dst = lax.select(rel == n - 1, nseg, slot)
+            # ONE recv semaphore for all waves (semaphore memory is a
+            # small fixed chip resource — per-wave semaphores would
+            # scale with payload size): safe because each sender's
+            # wave-j+1 DMA starts only after its wave-j wait(), so
+            # signals arrive in wave order and every wave moves the
+            # same byte count; run-ahead just accumulates counts
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[slot], dst_ref=out_ref.at[dst],
+                send_sem=send_sem, recv_sem=recv_sem,
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait()
+            return carry
+
+        lax.fori_loop(0, waves, wave, 0)
+
+    def call(root, x):  # x: (nseg, seg) per device; returns root's rows
+        kw = {}
+        cp = cparams(8)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((nseg + 1, seg), dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+            interpret=interpret,
+            **kw,
+        )(root, x)
+        return out[:nseg]
 
     return call
 
@@ -319,9 +759,26 @@ def all_gather(x, mesh, axis: str, interpret: bool = True):
                              out_specs=P(), check_vma=False))(x)
 
 
-def reduce_scatter_sum(x, mesh, axis: str, interpret: bool = True):
+def _pad_value(op: str, dtype) -> float | int:
+    """Neutral element used to pad the flattened payload to n equal ring
+    blocks — must not perturb the fold, for any dtype (±inf is not a
+    valid neutral for integers: use the dtype's extrema there)."""
+    dtype = np.dtype(dtype)
+    if op == "sum":
+        return 0
+    if op == "prod":
+        return 1
+    lim = np.finfo(dtype) if dtype.kind == "f" else np.iinfo(dtype)
+    return lim.min if op == "max" else lim.max
+
+
+def reduce_scatter(x, mesh, axis: str, op: str = "sum",
+                   interpret: bool = True, variant: str = "fused",
+                   seg_elems: int | None = None):
     """(n, n, *S) sharded on the leading rank axis -> (n, *S) sharded:
-    rank i receives the sum of everyone's block i via the DMA ring."""
+    rank i receives the reduction of everyone's block i via the DMA
+    ring.  ``variant='seg'`` uses the HBM-resident segmented kernel
+    (window of ``seg_elems``) for payloads too large for VMEM."""
     jax, jnp, lax, pl, pltpu = _mods()
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -331,22 +788,47 @@ def reduce_scatter_sum(x, mesh, axis: str, interpret: bool = True):
     if n == 1:
         return x.reshape((1,) + payload_shape)
     blk = int(np.prod(payload_shape)) if payload_shape else 1
-    inner = _build_reduce_scatter(n, axis, blk, str(x.dtype), interpret)
+    if variant == "seg":
+        seg = min(seg_elems or 131072, blk)
+        blk_p = -(-blk // seg) * seg
+        inner = _build_reduce_scatter_seg(n, axis, blk_p, seg,
+                                          str(x.dtype), interpret, op)
+    else:
+        blk_p = blk
+        inner = _build_reduce_scatter(n, axis, blk, str(x.dtype),
+                                      interpret, op)
 
     def body(t):                       # t: (1, n, *S)
-        out = inner(t[0].reshape(n, blk))      # (blk,)
-        return out.reshape((1,) + payload_shape)
+        rows = t[0].reshape(n, blk)
+        if blk_p != blk:
+            rows = jnp.pad(rows, ((0, 0), (0, blk_p - blk)),
+                           constant_values=_pad_value(op, x.dtype))
+        out = inner(rows)              # (blk_p,)
+        return out[:blk].reshape((1,) + payload_shape)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
                              out_specs=P(axis), check_vma=False))(x)
 
 
-def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):
-    """(n, *S) sharded -> (*S) replicated sum via the fused ring kernel.
+def reduce_scatter_sum(x, mesh, axis: str, interpret: bool = True):
+    return reduce_scatter(x, mesh, axis, "sum", interpret)
 
-    The per-rank payload is flattened and zero-padded to n equal ring
-    blocks outside the kernel (XLA fuses the pad/reshape into the
-    surrounding program)."""
+
+def all_reduce(x, mesh, axis: str, op: str = "sum",
+               interpret: bool = True, variant: str = "fused",
+               seg_elems: int | None = None):
+    """(n, *S) sharded -> (*S) replicated reduction via a ring kernel.
+
+    The per-rank payload is flattened and neutrally-padded to n equal
+    ring blocks outside the kernel (XLA fuses the pad/reshape into the
+    surrounding program).  Variants:
+
+    * ``'fused'`` — whole accumulator in VMEM (lowest latency, small).
+    * ``'seg'``   — HBM accumulator + bounded VMEM window of
+      ``seg_elems`` (large payloads; `coll_base_allreduce.c:618` twin).
+    * ``'bidi'``  — both ICI directions carry half the payload each
+      step (duplex links; halves per-step wire time).
+    """
     jax, jnp, lax, pl, pltpu = _mods()
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -357,15 +839,62 @@ def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):
         return x.reshape(payload_shape)
     size = int(np.prod(payload_shape)) if payload_shape else 1
     blk = -(-size // n)                # ceil
+    if variant == "seg":
+        seg = min(seg_elems or 131072, blk)
+        blk = -(-blk // seg) * seg
+        inner = _build_all_reduce_seg(n, axis, blk, seg, str(x.dtype),
+                                      interpret, op)
+    elif variant == "bidi":
+        blk = blk + (blk % 2)          # even split across directions
+        inner = _build_all_reduce_bidi(n, axis, blk // 2, str(x.dtype),
+                                       interpret, op)
+    else:
+        inner = _build_all_reduce(n, axis, blk, str(x.dtype), interpret,
+                                  op)
     padded = blk * n
-    inner = _build_all_reduce(n, axis, blk, str(x.dtype), interpret)
 
     def body(t):                       # t: (1, *S)
         flat = t.reshape(-1)
         if padded != size:
-            flat = jnp.pad(flat, (0, padded - size))
+            flat = jnp.pad(flat, (0, padded - size),
+                           constant_values=_pad_value(op, x.dtype))
         out = inner(flat.reshape(n, blk))      # (n, blk) reduced
         return out.reshape(-1)[:size].reshape(payload_shape)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
                              out_specs=P(), check_vma=False))(x)
+
+
+def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):
+    return all_reduce(x, mesh, axis, "sum", interpret)
+
+
+def bcast(x, mesh, axis: str, root: int = 0, interpret: bool = True,
+          seg_elems: int = 65536):
+    """(n, *S) sharded -> (n, *S) with every row equal to root's row,
+    via the pipelined segmented ring (time ≈ (S + n - 2) segment-hops)."""
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+    payload_shape = tuple(x.shape[1:])
+    size = int(np.prod(payload_shape)) if payload_shape else 1
+    seg = min(seg_elems, size)
+    nseg = -(-size // seg)
+    padded = nseg * seg
+    inner = _build_bcast(n, axis, nseg, seg, str(x.dtype), interpret)
+    root_arr = jnp.asarray([int(root) % n], dtype=jnp.int32)
+
+    def body(r, t):                    # r: (1,) int32; t: (1, *S)
+        flat = t.reshape(-1)
+        if padded != size:
+            flat = jnp.pad(flat, (0, padded - size))
+        out = inner(r, flat.reshape(nseg, seg))   # (nseg, seg) = root's
+        return out.reshape(-1)[:size].reshape((1,) + payload_shape)
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(), P(axis)),
+                             out_specs=P(axis), check_vma=False))(
+                                 root_arr, x)

@@ -95,10 +95,16 @@ def test_component_allreduce_and_fallthrough(pallas_world):
         (8, 12)).astype(np.float32)
     out = np.asarray(w.allreduce_array(host))
     np.testing.assert_allclose(out, host.sum(0), rtol=1e-4, atol=1e-5)
-    # MAX is not a ring-sum shape: must fall through to coll/xla and
-    # still be correct
+    # MAX/MIN/PROD ride the parameterized ring since round 4
     mx = np.asarray(w.allreduce_array(host, op.MAX))
     np.testing.assert_allclose(mx, host.max(0), rtol=1e-6)
+    mn = np.asarray(w.allreduce_array(host, op.MIN))
+    np.testing.assert_allclose(mn, host.min(0), rtol=1e-6)
+    # integer payloads are not a ring shape (float-only kernels): must
+    # fall through to coll/xla and still be correct
+    ints = np.arange(8 * 6, dtype=np.int32).reshape(8, 6)
+    s = np.asarray(w.allreduce_array(ints, op.SUM))
+    np.testing.assert_array_equal(s, ints.sum(0))
 
 
 def test_component_allgather_and_permute(pallas_world):
@@ -128,6 +134,140 @@ def test_kernel_reduce_scatter_sum(mesh, payload):
     y = np.asarray(pc.reduce_scatter_sum(jax.device_put(x), mesh, "x"))
     want = x.sum(axis=0)         # (8, *payload): block i to rank i
     np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+
+# -- round-4 variants: parameterized ops, segmented, bidi, bcast --------
+
+@pytest.mark.parametrize("op,ref", [("max", np.max), ("min", np.min),
+                                    ("prod", np.prod)])
+def test_kernel_all_reduce_ops(mesh, op, ref):
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    rng = np.random.default_rng(6)
+    # keep prod well-conditioned
+    x = (1.0 + 0.05 * rng.standard_normal((8, 33))).astype(np.float32)
+    y = np.asarray(pc.all_reduce(jax.device_put(x), mesh, "x", op))
+    np.testing.assert_allclose(y, ref(x, axis=0), rtol=1e-4)
+
+
+@pytest.mark.parametrize("op,ref", [("sum", np.sum), ("max", np.max)])
+def test_kernel_all_reduce_segmented(mesh, op, ref):
+    """HBM-resident accumulator + bounded VMEM window: payload (1000
+    elems/rank) deliberately not a multiple of the 32-elem window, so
+    both the ring-block pad and the segment pad are exercised."""
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(7).standard_normal(
+        (8, 1000)).astype(np.float32)
+    y = np.asarray(pc.all_reduce(jax.device_put(x), mesh, "x", op,
+                                 variant="seg", seg_elems=32))
+    np.testing.assert_allclose(y, ref(x, axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_kernel_all_reduce_bidi(mesh):
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(8).standard_normal(
+        (8, 407)).astype(np.float32)   # odd size: exercises the even pad
+    y = np.asarray(pc.all_reduce(jax.device_put(x), mesh, "x", "sum",
+                                 variant="bidi"))
+    np.testing.assert_allclose(y, x.sum(0), rtol=1e-4, atol=1e-5)
+
+
+def test_kernel_reduce_scatter_segmented(mesh):
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(9).standard_normal(
+        (8, 8, 50)).astype(np.float32)
+    y = np.asarray(pc.reduce_scatter(jax.device_put(x), mesh, "x", "sum",
+                                     variant="seg", seg_elems=16))
+    np.testing.assert_allclose(y, x.sum(0), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_kernel_bcast(mesh, root):
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(10).standard_normal(
+        (8, 1000)).astype(np.float32)
+    y = np.asarray(pc.bcast(jax.device_put(x), mesh, "x", root=root,
+                            seg_elems=64))
+    np.testing.assert_allclose(
+        y, np.broadcast_to(x[root], (8, 1000)), rtol=1e-6)
+
+
+def test_kernel_bcast_single_segment(mesh):
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(11).standard_normal(
+        (8, 40)).astype(np.float32)
+    y = np.asarray(pc.bcast(jax.device_put(x), mesh, "x", root=1,
+                            seg_elems=4096))
+    np.testing.assert_allclose(
+        y, np.broadcast_to(x[1], (8, 40)), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_kernel_segmented_large_payload(mesh):
+    """The segmented kernel's reason to exist: a per-rank payload far
+    beyond any VMEM budget (64MB f32) through a 512KB window."""
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    n_el = 16 * 2**20
+    x = np.random.default_rng(12).standard_normal(
+        (8, n_el)).astype(np.float32)
+    y = np.asarray(pc.all_reduce(jax.device_put(x), mesh, "x", "sum",
+                                 variant="seg", seg_elems=131072))
+    np.testing.assert_allclose(y, x.sum(0), rtol=1e-3, atol=1e-3)
+
+
+def test_component_bcast_and_large_route(pallas_world):
+    w = pallas_world
+    host = np.random.default_rng(13).standard_normal(
+        (8, 300)).astype(np.float32)
+    b = np.asarray(w.bcast_array(host, root=2))
+    np.testing.assert_allclose(
+        b, np.broadcast_to(host[2], (8, 300)), rtol=1e-6)
+    assert w.c_coll["bcast_array"].__self__.__class__.__name__ \
+        == "PallasCollModule"
+    # shrink the vmem crossover so this payload routes to the segmented
+    # kernel through the component
+    mod = w.c_coll["allreduce_array"].__self__
+    old_vmem, old_seg = mod.vmem_max_bytes, mod.seg_bytes
+    try:
+        mod.vmem_max_bytes, mod.seg_bytes = 64, 128
+        out = np.asarray(w.allreduce_array(host))
+        np.testing.assert_allclose(out, host.sum(0), rtol=1e-4, atol=1e-5)
+    finally:
+        mod.vmem_max_bytes, mod.seg_bytes = old_vmem, old_seg
+
+
+def test_component_bidirectional_route(pallas_world):
+    w = pallas_world
+    mod = w.c_coll["allreduce_array"].__self__
+    old = mod.bidirectional
+    try:
+        mod.bidirectional = True
+        host = np.random.default_rng(14).standard_normal(
+            (8, 41)).astype(np.float32)
+        out = np.asarray(w.allreduce_array(host))
+        np.testing.assert_allclose(out, host.sum(0), rtol=1e-4, atol=1e-5)
+    finally:
+        mod.bidirectional = old
 
 
 def test_component_reduce_scatter(pallas_world):
