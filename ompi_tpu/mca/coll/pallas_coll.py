@@ -92,12 +92,14 @@ class PallasCollModule:
         return jax.device_put(
             np.asarray(x), NamedSharding(self.mesh, P(self.axis)))
 
-    def _supported(self, x) -> bool:
+    def _size_ok(self, x) -> bool:
         cap = self.max_bytes
         if self.interpret:
             cap = min(cap, _INTERPRET_MAX_BYTES)
-        return (x.dtype.kind == "f"
-                and x.nbytes // max(1, self.n) <= cap)
+        return x.nbytes // max(1, self.n) <= cap
+
+    def _supported(self, x) -> bool:
+        return x.dtype.kind == "f" and self._size_ok(x)
 
     def _route(self, x):
         """Pick the accumulator regime from the per-rank payload size:
@@ -151,7 +153,8 @@ class PallasCollModule:
 
     def bcast_array(self, comm, x, root: int = 0):
         x = self._place(comm, x)
-        if not self._supported(x):
+        # pure DMA, no arithmetic: any dtype qualifies — only size gates
+        if not self._size_ok(x):
             return self._delegate("bcast_array", comm, x, root)
         from ompi_tpu.ops import pallas_collectives as pc
 

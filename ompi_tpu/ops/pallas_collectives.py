@@ -759,6 +759,18 @@ def all_gather(x, mesh, axis: str, interpret: bool = True):
                              out_specs=P(), check_vma=False))(x)
 
 
+#: default VMEM window (elements) for the segmented kernels when the
+#: caller does not size it
+_DEFAULT_SEG_ELEMS = 131072
+
+
+def _seg_shape(blk: int, seg_elems: int | None) -> tuple[int, int]:
+    """(window, padded block): the segment window never exceeds the ring
+    block, and the block is rounded up to a whole number of segments."""
+    seg = min(seg_elems or _DEFAULT_SEG_ELEMS, blk)
+    return seg, -(-blk // seg) * seg
+
+
 def _pad_value(op: str, dtype) -> float | int:
     """Neutral element used to pad the flattened payload to n equal ring
     blocks — must not perturb the fold, for any dtype (±inf is not a
@@ -789,8 +801,7 @@ def reduce_scatter(x, mesh, axis: str, op: str = "sum",
         return x.reshape((1,) + payload_shape)
     blk = int(np.prod(payload_shape)) if payload_shape else 1
     if variant == "seg":
-        seg = min(seg_elems or 131072, blk)
-        blk_p = -(-blk // seg) * seg
+        seg, blk_p = _seg_shape(blk, seg_elems)
         inner = _build_reduce_scatter_seg(n, axis, blk_p, seg,
                                           str(x.dtype), interpret, op)
     else:
@@ -840,8 +851,7 @@ def all_reduce(x, mesh, axis: str, op: str = "sum",
     size = int(np.prod(payload_shape)) if payload_shape else 1
     blk = -(-size // n)                # ceil
     if variant == "seg":
-        seg = min(seg_elems or 131072, blk)
-        blk = -(-blk // seg) * seg
+        seg, blk = _seg_shape(blk, seg_elems)
         inner = _build_all_reduce_seg(n, axis, blk, seg, str(x.dtype),
                                       interpret, op)
     elif variant == "bidi":

@@ -1,0 +1,201 @@
+"""Fault-injection fuzz worker for the coordination-free ERA agreement.
+
+Launched under tpurun by ``tests/test_ft_fuzz.py``.  Every rank derives
+the SAME scenario plan from ``FUZZ_SEED`` (random kills with precise
+protocol-phase triggers, false-suspicion injection, a concurrent
+two-comm round), runs the rounds, and prints one ``FUZZ <key> <rank>
+<value>`` line per completed agreement — the test asserts the ERA
+uniformity property (all printed values for a key are equal) and
+liveness (every planned survivor printed).
+
+The precise kill triggers intercept ``agreement._p2p_send``:
+
+* ``prepare_partial k`` — die before sending prepare frame #(k+1): some
+  survivors hold the prepared value, others don't; the takeover root
+  must adopt-before-recompute via query replies.
+* ``commit_partial k`` — die before sending decision frame #(k+1).
+  k=0 is the nastiest ERA window (root decided locally, committed
+  nothing — between prepare-complete and commit); k=1 leaves exactly
+  one survivor holding the committed value, which the takeover root
+  must adopt via a 'decision' query reply.
+* ``delay`` — the watchdog alone (mid-protocol at a random moment).
+
+A watchdog thread always backstops every victim (a root-specific
+trigger never fires on a rank that never roots), so every planned
+victim really dies and the plan's alive-set bookkeeping stays true.
+Reference corners: ``coll_ftagree_earlyreturning.c:34-36`` (ERA keeps
+per-instance hash tables precisely for these takeover/late-query
+paths).
+"""
+import os
+import random
+import threading
+import time
+
+
+def build_plan(seed: int, n: int, rounds: int):
+    """Identical on every rank: per round, who dies (and how), who
+    falsely suspects whom, which rounds run two comms concurrently.
+    Importable by the pytest side to recompute expectations."""
+    N = n
+    rng = random.Random(seed)
+    if seed == 0:
+        # deterministic worst case: ROOT dies between prepare-complete
+        # and commit (commit_partial 0) while the TAKEOVER root dies
+        # before finishing its own prepare round (prepare_partial 1) —
+        # the cascading-takeover window ERA's early-return tables exist
+        # for; round 2 then agrees among the 3 survivors
+        flags = [rng.getrandbits(8) | 1 for _ in range(N)]
+        return [
+            dict(victims={}, suspect=None, concurrent=True, flags=flags,
+                 dead_after=frozenset()),
+            dict(victims={0: ("commit_partial", 0, 1.2),
+                          1: ("prepare_partial", 1, 1.4)},
+                 suspect=None, concurrent=False, flags=flags,
+                 dead_after=frozenset({0, 1})),
+            dict(victims={}, suspect=None, concurrent=False, flags=flags,
+                 dead_after=frozenset({0, 1})),
+        ]
+    plan = []
+    alive = set(range(N))
+    for rd in range(rounds):
+        flags = [rng.getrandbits(8) | 1 for _ in range(N)]
+        victims, suspect, concurrent = {}, None, False
+        style = rng.random()
+        if rd == 0:
+            concurrent = True         # everyone alive: two comms at once
+        elif style < 0.5 and len(alive) > 3:
+            k = min(rng.choice([1, 1, 2]), len(alive) - 2)
+            # bias toward low ranks: root/takeover-root deaths are the
+            # interesting corner (cascading takeover when both die)
+            cand = sorted(alive)
+            weights = [3 if r == cand[0] else 2 if r == cand[1] else 1
+                       for r in cand]
+            chosen = []
+            for _ in range(k):
+                pick = rng.choices([r for r in cand if r not in chosen],
+                                   [w for r, w in zip(cand, weights)
+                                    if r not in chosen])[0]
+                chosen.append(pick)
+            for v in chosen:
+                mode = rng.choice(["delay", "prepare_partial",
+                                   "commit_partial", "commit_partial"])
+                victims[v] = (mode, rng.choice([0, 1, 2]),
+                              0.6 + rng.random() * 0.9)
+            alive -= set(victims)
+        elif style < 0.75 and len(alive) > 3:
+            suspector, target = rng.sample(sorted(alive), 2)
+            suspect = (suspector, target)
+            alive -= {target}          # evicted after the round
+        plan.append(dict(victims=victims, suspect=suspect,
+                         concurrent=concurrent, flags=flags,
+                         dead_after=frozenset(range(N)) - frozenset(alive)))
+    return plan
+
+
+def main():
+    import ompi_tpu
+    from ompi_tpu.api.errhandler import ERRORS_RETURN
+    from ompi_tpu.api.errors import ProcFailedError
+    from ompi_tpu.ft import agreement, propagator
+    from ompi_tpu.ft import state as ft_state
+
+    plan = build_plan(int(os.environ["FUZZ_SEED"]),
+                      int(os.environ["FUZZ_N"]),
+                      int(os.environ["FUZZ_ROUNDS"]))
+    w = ompi_tpu.init()
+    w.set_errhandler(ERRORS_RETURN)
+    me = w.rank
+    d1 = w.dup()
+    d2 = w.dup()
+    d1.set_errhandler(ERRORS_RETURN)
+    d2.set_errhandler(ERRORS_RETURN)
+
+    # -- precise-kill interceptor on the agreement's CTL sends ----------
+    kill = {"mode": None, "arg": 0, "sent": {"prepare": 0, "decision": 0}}
+    orig_send = agreement._p2p_send
+
+    def fuzz_send(rte, dst_world, op, instance, payload=None, extra=None):
+        mode = kill["mode"]
+        if mode == "prepare_partial" and op == "prepare":
+            if kill["sent"]["prepare"] >= kill["arg"]:
+                os._exit(7)
+            kill["sent"]["prepare"] += 1
+        elif mode == "commit_partial" and op == "decision":
+            if kill["sent"]["decision"] >= kill["arg"]:
+                os._exit(7)
+            kill["sent"]["decision"] += 1
+        return orig_send(rte, dst_world, op, instance, payload,
+                         extra=extra)
+
+    agreement._p2p_send = fuzz_send
+
+    def agree_value(comm, flag):
+        """One agreement; a uniform ProcFailedError carries the agreed
+        flag (comm_agree.c group-fault sync), so it counts as the
+        value."""
+        try:
+            return comm.agree(flag)
+        except ProcFailedError as e:
+            return e.flag
+
+    def wait_all_failed(ranks, deadline):
+        for r in sorted(ranks):
+            while not ft_state.is_failed(r):
+                if time.monotonic() > deadline:
+                    print(f"FUZZTIMEOUT {me} waiting on failure of {r}",
+                          flush=True)
+                    os._exit(3)
+                time.sleep(0.02)
+
+    for rd, spec in enumerate(plan):
+        my_flag = spec["flags"][me]
+        if me in spec["victims"]:
+            mode, arg, delay = spec["victims"][me]
+            kill["mode"] = mode
+            kill["arg"] = arg
+            kill["sent"] = {"prepare": 0, "decision": 0}
+            threading.Timer(delay, lambda: os._exit(7)).start()
+        if spec["suspect"] and spec["suspect"][0] == me:
+            # false suspicion: announce a LIVE peer dead on the real
+            # propagation carriers (event bus + p2p flood) mid-agreement
+            propagator.report_failure(
+                w.rte, w.world_rank(spec["suspect"][1]),
+                origin="fuzz-false-suspicion")
+        if spec["concurrent"]:
+            results = {}
+
+            def run(key, comm, flag):
+                results[key] = agree_value(comm, flag)
+
+            t1 = threading.Thread(target=run, args=(f"{rd}a", d1, my_flag))
+            t2 = threading.Thread(target=run,
+                                  args=(f"{rd}b", d2, (my_flag ^ 0xFF) | 1))
+            t1.start()
+            t2.start()
+            t1.join(120)
+            t2.join(120)
+            for key, val in sorted(results.items()):
+                print(f"FUZZ {key} {me} {val}", flush=True)
+        else:
+            val = agree_value(w, my_flag)
+            print(f"FUZZ {rd} {me} {val}", flush=True)
+
+        if spec["suspect"] and spec["suspect"][1] == me:
+            print(f"EVICTED {me} round {rd}", flush=True)
+            os._exit(0)
+        if me in spec["victims"]:
+            time.sleep(10)   # trigger never fired: let the watchdog (or
+            os._exit(7)      # this) kill the victim before round+1
+        # everyone planned-dead through this round must be locally known
+        # dead before the next round starts (keeps root views convergent)
+        wait_all_failed(spec["dead_after"], time.monotonic() + 60)
+        if rd + 1 < len(plan):
+            w.ack_failed()
+
+    print(f"FUZZDONE {me}", flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
