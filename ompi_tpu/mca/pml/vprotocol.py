@@ -51,9 +51,17 @@ class PessimistPml:
         self._path = os.path.join(self._dir,
                                   f"events.{rte.my_world_rank}.log")
         self._fh = open(self._path, "a", buffering=1)
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()   # clock bump + event write nest
         self._seq = 0
         self._payloads = bool(_payload_var.value)
+        # per-channel event clocks (the reference's
+        # ``vprotocol_pessimist_event.h`` clock stamps): a channel is
+        # (peer world rank, cid, tag) — within one, MPI matching is
+        # non-overtaking, so the channel sequence number pins each recv
+        # to exactly one send even when several comms or tags carry
+        # concurrent traffic between the same pair
+        self._send_clk: dict[tuple, int] = {}
+        self._recv_clk: dict[tuple, int] = {}
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -77,13 +85,17 @@ class PessimistPml:
         grp = comm.remote_group if comm.is_inter else comm.group
         # WORLD ranks in the log: events.<world>.log files are keyed by
         # world rank, so replay's cross-log pairing must be too
-        rec = dict(cid=comm.cid, dst=int(grp.world_rank(dest)),
-                   tag=int(tag),
+        dst = int(grp.world_rank(dest))
+        chan = (dst, comm.cid, int(tag))
+        rec = dict(cid=comm.cid, dst=dst, tag=int(tag),
                    nbytes=int(arr.nbytes), sha=self._digest(arr))
         if self._payloads:
             rec["payload"] = np.ascontiguousarray(arr).view(np.uint8) \
                 .tobytes().hex()
-        self._event("send", **rec)
+        with self._lock:   # clock bump + write atomic: events must land
+            sc = self._send_clk[chan] = \
+                self._send_clk.get(chan, -1) + 1   # in sc order per chan
+            self._event("send", sc=sc, **rec)
 
     def send(self, comm, buf, dest, tag, **kw):
         self._log_send(comm, buf, dest, tag)
@@ -104,8 +116,12 @@ class PessimistPml:
 
     def _log_match_st(self, comm, st) -> None:
         grp = comm.remote_group if comm.is_inter else comm.group
-        self._event("recv", cid=comm.cid,
-                    src=int(grp.world_rank(st.source)), tag=int(st.tag))
+        src = int(grp.world_rank(st.source))
+        chan = (src, comm.cid, int(st.tag))
+        with self._lock:   # clock bump + write atomic (sc order)
+            sc = self._recv_clk[chan] = self._recv_clk.get(chan, -1) + 1
+            self._event("recv", cid=comm.cid, src=src, tag=int(st.tag),
+                        sc=sc)
 
     def irecv(self, comm, buf, source, tag):
         req = self._inner.irecv(comm, buf, source, tag)
@@ -166,12 +182,19 @@ class ReplayPml:
     Matching is ORDER-based per rank (the k-th recv of the re-execution
     consumes the k-th logged delivery): the piecewise-deterministic
     execution assumption pessimistic logging is built on.  All log ranks
-    are WORLD ranks.  Known limitation: payload pairing between a
-    (sender, receiver) pair is by global send order, which is exact for
-    traffic on one communicator (pml ordering is non-overtaking per
-    peer) but can interleave when two communicators carry concurrent
-    traffic between the same pair — the reference's pessimist uses full
-    event clocks there (``vprotocol_pessimist_eventlog``).
+    are WORLD ranks.
+
+    Payload pairing is by **channel event clock** — a channel is
+    (peer, cid, tag) and both sides stamp events with their channel
+    sequence number (``sc``), mirroring the reference's per-event clock
+    stamps (``vprotocol_pessimist_event.h``): the receiver's k-th
+    logged delivery on a channel pairs with the sender's k-th send on
+    it, which is exact even when several communicators or tags carry
+    concurrent, arbitrarily interleaved traffic between the same pair
+    (MPI matching is non-overtaking only WITHIN a channel).  Delivery
+    proofs for send suppression are per-channel for the same reason — a
+    global count could let another channel's deliveries suppress a send
+    that never arrived.
     """
 
     def __init__(self, inner, rte) -> None:
@@ -184,12 +207,14 @@ class ReplayPml:
         self._recvs = [e for e in events if e["kind"] == "recv"]
         self._si = 0
         self._ri = 0
-        # per-source queues of the sender's logged sends addressed to me
-        self._src_sends: dict[int, list] = {}
-        # delivery proof: how many of MY sends each dst's log shows
-        # matched (order-based count); sends beyond it are re-sent live
-        self._delivered: dict[int, int] = {}
-        self._sent_to: dict[int, int] = {}
+        # per-source, per-(cid,tag)-channel queues of the sender's
+        # logged sends addressed to me (channel-clock pairing)
+        self._src_sends: dict[int, dict[tuple, list]] = {}
+        # delivery proof per (dst, cid, tag) channel: how many of MY
+        # sends on it the dst's log shows matched; sends beyond that
+        # are re-sent live
+        self._delivered: dict[int, dict[tuple, int]] = {}
+        self._sent_to: dict[tuple, int] = {}
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -198,25 +223,31 @@ class ReplayPml:
         return self._si < len(self._sends) or self._ri < len(self._recvs)
 
     # -- log plumbing ----------------------------------------------------
-    def _sends_from(self, src: int) -> list:
-        q = self._src_sends.get(src)
-        if q is None:
-            q = [e for e in read_log(self._dir, src)
-                 if e["kind"] == "send" and int(e["dst"]) == self._rank]
-            self._src_sends[src] = q
-        return q
+    def _sends_from(self, src: int, cid: int, tag: int) -> list:
+        chans = self._src_sends.get(src)
+        if chans is None:
+            chans = {}
+            for e in read_log(self._dir, src):
+                if e["kind"] == "send" and int(e["dst"]) == self._rank:
+                    chans.setdefault(
+                        (int(e["cid"]), int(e["tag"])), []).append(e)
+            self._src_sends[src] = chans
+        return chans.get((int(cid), int(tag)), [])
 
-    def _delivered_count(self, dst: int) -> int:
-        got = self._delivered.get(dst)
-        if got is None:
+    def _delivered_count(self, dst: int, cid: int, tag: int) -> int:
+        chans = self._delivered.get(dst)
+        if chans is None:
+            chans = {}
             try:
-                got = sum(1 for e in read_log(self._dir, dst)
-                          if e["kind"] == "recv"
-                          and int(e["src"]) == self._rank)
+                for e in read_log(self._dir, dst):
+                    if (e["kind"] == "recv"
+                            and int(e["src"]) == self._rank):
+                        k = (int(e["cid"]), int(e["tag"]))
+                        chans[k] = chans.get(k, 0) + 1
             except OSError:
-                got = 0    # peer never logged: nothing provably delivered
-            self._delivered[dst] = got
-        return got
+                pass   # peer never logged: nothing provably delivered
+            self._delivered[dst] = chans
+        return chans.get((int(cid), int(tag)), 0)
 
     # -- send side -------------------------------------------------------
     def _replay_send(self, comm, buf, dest, tag) -> bool:
@@ -229,11 +260,12 @@ class ReplayPml:
         grp = comm.remote_group if comm.is_inter else comm.group
         dst_world = int(grp.world_rank(dest))
         if (int(e["dst"]) != dst_world or int(e["tag"]) != int(tag)
+                or int(e["cid"]) != int(comm.cid)
                 or int(e["nbytes"]) != int(arr.nbytes)):
             raise ReplayDivergence(
-                f"send #{self._si} diverged: logged "
-                f"(dst={e['dst']} tag={e['tag']} nbytes={e['nbytes']}) "
-                f"vs replayed (dst={dst_world} tag={tag} "
+                f"send #{self._si} diverged: logged (dst={e['dst']} "
+                f"cid={e['cid']} tag={e['tag']} nbytes={e['nbytes']}) "
+                f"vs replayed (dst={dst_world} cid={comm.cid} tag={tag} "
                 f"nbytes={arr.nbytes})")
         sha = hashlib.sha1(np.ascontiguousarray(arr)
                            .view(np.uint8)).hexdigest()[:16]
@@ -242,9 +274,10 @@ class ReplayPml:
                 f"send #{self._si} payload hash diverged "
                 f"(logged {e['sha']}, replayed {sha})")
         self._si += 1
-        seq = self._sent_to.get(dst_world, 0)
-        self._sent_to[dst_world] = seq + 1
-        if seq < self._delivered_count(dst_world):
+        chan = (dst_world, int(e["cid"]), int(tag))
+        seq = self._sent_to.get(chan, 0)
+        self._sent_to[chan] = seq + 1
+        if seq < self._delivered_count(dst_world, e["cid"], tag):
             return True            # provably delivered: suppress
         self._inner.send(comm, buf, dest, tag)   # in-flight at crash
         return True
@@ -278,14 +311,24 @@ class ReplayPml:
             raise ReplayDivergence(
                 f"recv #{self._ri} diverged: logged tag {e['tag']}, "
                 f"replayed tag {tag}")
+        if int(e["cid"]) != int(comm.cid):
+            raise ReplayDivergence(
+                f"recv #{self._ri} diverged: logged cid {e['cid']}, "
+                f"replayed on cid {comm.cid}")
         self._ri += 1
-        q = self._sends_from(src)
+        q = self._sends_from(src, e["cid"], e["tag"])
         if not q:
             raise ReplayDivergence(
                 f"recv #{self._ri - 1}: rank {src}'s log has no remaining "
-                f"send for me — was the job run with "
+                f"send for me on channel (cid={e['cid']} tag={e['tag']}) "
+                f"— was the job run with "
                 f"otpu_vprotocol_pessimist_log_payloads=1?")
         se = q.pop(0)
+        if "sc" in se and "sc" in e and int(se["sc"]) != int(e["sc"]):
+            raise ReplayDivergence(
+                f"recv #{self._ri - 1}: channel clock mismatch (sender "
+                f"sc={se['sc']}, delivery sc={e['sc']}) — logs are from "
+                f"different runs or corrupted")
         if "payload" not in se:
             raise ReplayDivergence(
                 f"sender {src} logged no payloads; replay requires "
