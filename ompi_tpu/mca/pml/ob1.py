@@ -219,12 +219,17 @@ class Ob1Pml:
         spc.record("bytes_sent", req.nbytes)
         rget_limit = self.component.rget_limit()
         if (rget_limit and not sync
-                and req.nbytes > max(ep.btl.eager_limit, rget_limit)):
+                and req.nbytes > max(ep.btl.eager_limit, rget_limit)
+                and (getattr(ep.btl, "rdma", False)
+                     or self.component.rget_emulate())):
             # RGET protocol (pml_ob1_sendreq.h:375-401): expose the packed
             # stream and let the RECEIVER pull it — one one-sided copy
-            # into the destination on rdma transports, request/stream
-            # emulation elsewhere; either way no eager head and no
-            # sender-driven FRAG storm
+            # into the destination on rdma transports (measured 2.4-3.7x
+            # the FRAG stream at 4-16MB on btl/sm).  Like the reference,
+            # RGET engages only where the btl has real one-sided get
+            # (mca_pml_ob1_rdma_btls): the request/stream pull emulation
+            # on non-rdma btls measures ~0.9x FRAG (an extra round-trip,
+            # no zero-copy win) and is gated behind rget_emulate
             from ompi_tpu.runtime import memchecker
 
             memchecker.protect_send(req, buf)
@@ -742,8 +747,16 @@ class Ob1Component(Component):
             "rget_limit", vtype=VarType.SIZE, default="512k",
             help="Messages above this (and above the btl eager limit) use "
                  "the receiver-pull RGET protocol "
-                 "(pml_ob1_sendreq.h:375-401); 0 disables RGET — measured "
-                 "~1.7x the RNDV stream's bandwidth at 4MB over btl/sm")
+                 "(pml_ob1_sendreq.h:375-401) on rdma-capable btls; 0 "
+                 "disables RGET — measured 3.7x (4MB) / 2.4x (16MB) the "
+                 "RNDV FRAG stream's bandwidth over btl/sm "
+                 "(BENCH_SWEEP.md rget rows)")
+        self._rget_emu_var = self.register_var(
+            "rget_emulate", vtype=VarType.BOOL, default=False,
+            help="Allow RGET's request/stream pull emulation on btls "
+                 "without one-sided get (btl/tcp): measured ~0.9x the "
+                 "FRAG stream (extra round-trip, no zero-copy win), so "
+                 "off by default — the crossover is the btl rdma flag")
         self._stripe_var = self.register_var(
             "stripe", vtype=VarType.BOOL, default=True,
             help="Stripe large RNDV/pull streams across every btl that "
@@ -755,6 +768,10 @@ class Ob1Component(Component):
     def rget_limit(self) -> int:
         var = getattr(self, "_rget_var", None)
         return int(var.value) if var is not None else 512 << 10
+
+    def rget_emulate(self) -> bool:
+        var = getattr(self, "_rget_emu_var", None)
+        return bool(var.value) if var is not None else False
 
     def stripe_enabled(self) -> bool:
         var = getattr(self, "_stripe_var", None)

@@ -66,12 +66,41 @@ def test_rget_large_message_sm(tmp_path):
 
 def test_rget_large_message_tcp_emulated(tmp_path):
     # two fake nodes: sm declines cross-node, tcp carries the message and
-    # RGET runs in pull-emulation mode
+    # RGET runs in pull-emulation mode (opt-in since round 4: emulation
+    # measured slower than the FRAG stream, so it is gated by default)
     script = tmp_path / "rget_tcp.py"
     script.write_text(textwrap.dedent(_LARGE_MSG))
-    r = _tpurun(2, script, extra=("--fake-nodes", "2"))
+    r = _tpurun(2, script, extra=("--fake-nodes", "2",
+                                  "--mca", "pml_ob1_rget_emulate", "1"))
     assert r.returncode == 0, r.stdout + r.stderr
     assert "SENDER OK" in r.stdout and "RECEIVER OK" in r.stdout
+
+
+def test_rget_not_engaged_on_non_rdma_btl_by_default(tmp_path):
+    """Like the reference (RGET requires btl_get), the pull emulation on
+    non-rdma btls is opt-in: a large tcp message with default vars must
+    ride the FRAG stream (measured faster there), not RGET."""
+    script = tmp_path / "norget.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.runtime import spc
+
+        w = ompi_tpu.init()
+        n = (3 << 20) // 8
+        if w.rank == 0:
+            w.send(np.arange(n, dtype=np.float64), dest=1, tag=3)
+            assert spc.read("rget_msgs") == 0, \\
+                "RGET emulation engaged on a non-rdma btl by default"
+            print("GATED OK", flush=True)
+        else:
+            r = np.empty(n, np.float64)
+            w.recv(r, source=0, tag=3)
+            assert r[-1] == n - 1
+        ompi_tpu.finalize()
+    """))
+    r = _tpurun(2, script, extra=("--fake-nodes", "2"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "GATED OK" in r.stdout
 
 
 def test_rget_disabled_falls_back_to_rndv(tmp_path):
