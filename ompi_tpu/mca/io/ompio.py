@@ -8,12 +8,26 @@ sub-frameworks, collapsed into three layers:
 - **fbtl** (``ompi/mca/fbtl/posix``): individual strided read/write — the
   file view (disp, etype, filetype) is walked through the datatype engine's
   segment map and each elementary run becomes one ``pread``/``pwrite``.
-- **fcoll** (``ompi/mca/fcoll/vulcan``): collective two-phase buffering —
-  ranks exchange their access extents, the file domain is partitioned into
-  stripes owned by aggregator ranks (one per node by default, the
-  ``common/ompio`` aggregator-selection role), data moves rank→aggregator
-  over pml p2p, and each aggregator issues one large sequential I/O per
-  stripe (read-modify-write when a write stripe has holes).
+- **fcoll** (``ompi/mca/fcoll/``): collective two-phase buffering —
+  ranks exchange their access extents, the file domain is partitioned
+  among aggregator ranks (one per node by default, the ``common/ompio``
+  aggregator-selection role), data moves rank→aggregator over pml p2p,
+  and each aggregator issues one large sequential I/O per domain
+  (read-modify-write when a write domain has holes).  TWO partitioning
+  strategies, selected per access pattern like the reference's four
+  fcoll components:
+
+  * **static** (``fcoll/vulcan``): even ADDRESS-span stripes — right
+    when the job writes a dense region;
+  * **dynamic** (``fcoll/dynamic_gen2``): the union of every rank's
+    accessed extents is negotiated at runtime and split into
+    equal-ACCESSED-BYTE shares, so ragged/clustered patterns (dense
+    islands separated by huge holes) still balance real I/O across
+    aggregators instead of handing one aggregator all the bytes.
+
+  ``auto`` picks dynamic when the accessed-byte density of the spanned
+  region is low (ragged), static when dense; force with the
+  ``io_ompio_fcoll`` var.
 
 Shared file pointers (``ompi/mca/sharedfp/``) ride the coordination
 service's atomic ``fetch_add`` counter — the TPU-native replacement for the
@@ -145,35 +159,111 @@ class OmpioModule:
         return _coalesce_runs(
             view_extents(file.disp, file.filetype, start, nbytes))
 
+    # -- fcoll file-domain partitioning ----------------------------------
+    def _file_domains(self, comm, runs):
+        """Negotiate the aggregator file domains for this collective op.
+
+        Returns ``(aggs, edges)`` — ``edges`` has ``len(aggs)+1``
+        ascending file offsets; aggregator i owns ``[edges[i],
+        edges[i+1])`` — or ``None`` when no rank accesses anything.
+        One allgatherv carries every rank's coalesced extents (the
+        runtime negotiation of ``fcoll/dynamic_gen2``); the strategy is
+        picked from the pattern's accessed-byte density unless forced.
+        """
+        alg = (self._c.fcoll_var.value or "auto").strip().lower()
+        if alg not in ("auto", "static", "dynamic"):
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"io_ompio_fcoll={alg!r}: expected "
+                           "'auto', 'static' or 'dynamic'")
+        aggs = self._aggregators(comm)
+        k = len(aggs)
+        if alg == "static":
+            # forced static needs only the global bounds: exchange two
+            # ints per rank, not the full extent lists
+            lo = runs[0][0] if runs else np.iinfo(np.int64).max
+            hi = runs[-1][0] + runs[-1][1] if runs else -1
+            bounds = np.asarray(comm.allgather(
+                np.array([lo, hi], np.int64))).reshape(comm.size, 2)
+            gmin = int(bounds[:, 0].min())
+            gmax = int(bounds[:, 1].max())
+            if gmax <= gmin:
+                return None
+            self.last_fcoll_alg = "static"
+            stripe = -(-(gmax - gmin) // k)
+            edges = [min(gmin + i * stripe, gmax) for i in range(k)]
+            edges.append(gmax)
+            return aggs, edges
+        flat = np.array([v for r in runs for v in r], np.int64)
+        gathered = comm.allgatherv(flat)
+        intervals = []
+        for arr in gathered:
+            a = np.asarray(arr, np.int64).reshape(-1, 2)
+            intervals.extend((int(o), int(o) + int(ln)) for o, ln in a)
+        if not intervals:
+            return None
+        intervals.sort()
+        merged = []                     # interval union across ranks
+        for s, e in intervals:
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        gmin, gmax = merged[0][0], merged[-1][1]
+        total = sum(e - s for s, e in merged)
+        if alg == "auto":
+            # dense region -> address stripes; ragged (the spanned
+            # region is mostly holes) -> balance the actual bytes
+            alg = "static" if total * 2 >= (gmax - gmin) else "dynamic"
+        self.last_fcoll_alg = alg
+        if alg == "static" or k == 1 or total == 0:
+            stripe = -(-(gmax - gmin) // k)
+            edges = [min(gmin + i * stripe, gmax) for i in range(k)]
+        else:
+            share = total / k
+            edges, acc, nxt = [gmin], 0, 1
+            for s, e in merged:
+                while nxt < k and acc + (e - s) >= nxt * share:
+                    edges.append(s + int(nxt * share - acc))
+                    nxt += 1
+                acc += e - s
+            while len(edges) < k:       # fewer cut points than shares
+                edges.append(gmax)
+        edges.append(gmax)
+        return aggs, edges
+
+    @staticmethod
+    def _route(edges, off: int, ln: int):
+        """Split ``[off, off+ln)`` at the domain edges: yields
+        ``(aggregator_index, piece_offset, piece_length)``."""
+        import bisect
+
+        pos, end = off, off + ln
+        while pos < end:
+            ai = min(max(bisect.bisect_right(edges, pos) - 1, 0),
+                     len(edges) - 2)
+            take = min(end, max(edges[ai + 1], pos + 1)) - pos
+            yield ai, pos, take
+            pos += take
+
     def write_at_all(self, file, offset: int, data: bytes) -> int:
         comm = file.comm
         if comm is None or comm.size == 1:
             return self.write_at(file, offset, data)
         tag = coll_tag(comm)
         runs = self._my_extents(file, offset, len(data))
-        # phase 0: agree on the file domain
-        lo = runs[0][0] if runs else np.iinfo(np.int64).max
-        hi = runs[-1][0] + runs[-1][1] if runs else -1
-        bounds = np.asarray(comm.allgather(
-            np.array([lo, hi], np.int64))).reshape(comm.size, 2)
-        gmin = int(bounds[:, 0].min())
-        gmax = int(bounds[:, 1].max())
-        if gmax <= gmin:
+        # phase 0: negotiate the aggregator file domains
+        domains = self._file_domains(comm, runs)
+        if domains is None:
             return 0
-        aggs = self._aggregators(comm)
-        stripe = -(-(gmax - gmin) // len(aggs))     # ceil
+        aggs, edges = domains
         # phase 1: route my pieces to the owning aggregators
         pieces_for: dict[int, list] = {a: [] for a in aggs}
         pos = 0
         for off, ln in runs:
-            sent = 0
-            while sent < ln:
-                ai = min((off + sent - gmin) // stripe, len(aggs) - 1)
-                a_end = gmin + (ai + 1) * stripe
-                take = min(ln - sent, a_end - (off + sent))
+            for ai, poff, take in self._route(edges, off, ln):
+                rel = poff - off
                 pieces_for[aggs[ai]].append(
-                    (off + sent, data[pos + sent:pos + sent + take]))
-                sent += take
+                    (poff, data[pos + rel:pos + rel + take]))
             pos += ln
         reqs = []
         for a in aggs:
@@ -216,26 +306,15 @@ class OmpioModule:
             return self.read_at(file, offset, nbytes)
         tag = coll_tag(comm)
         runs = self._my_extents(file, offset, nbytes)
-        lo = runs[0][0] if runs else np.iinfo(np.int64).max
-        hi = runs[-1][0] + runs[-1][1] if runs else -1
-        bounds = np.asarray(comm.allgather(
-            np.array([lo, hi], np.int64))).reshape(comm.size, 2)
-        gmin = int(bounds[:, 0].min())
-        gmax = int(bounds[:, 1].max())
-        if gmax <= gmin:
+        domains = self._file_domains(comm, runs)
+        if domains is None:
             return b""
-        aggs = self._aggregators(comm)
-        stripe = -(-(gmax - gmin) // len(aggs))
+        aggs, edges = domains
         # phase 1: send my wanted runs to the owning aggregators
         want_from: dict[int, list] = {a: [] for a in aggs}
         for off, ln in runs:
-            taken = 0
-            while taken < ln:
-                ai = min((off + taken - gmin) // stripe, len(aggs) - 1)
-                a_end = gmin + (ai + 1) * stripe
-                take = min(ln - taken, a_end - (off + taken))
-                want_from[aggs[ai]].append((off + taken, take))
-                taken += take
+            for ai, poff, take in self._route(edges, off, ln):
+                want_from[aggs[ai]].append((poff, take))
         reqs = []
         for a in aggs:
             if a != comm.rank:
@@ -298,6 +377,13 @@ class OmpioComponent(Component):
             "num_aggregators", vtype=VarType.INT, default=0,
             help="Aggregator count for two-phase collective I/O "
                  "(0 = one per node)")
+        self.fcoll_var = self.register_var(
+            "fcoll", vtype=VarType.STRING, default="auto",
+            help="Collective-buffering file-domain strategy: 'static' "
+                 "(even address stripes, fcoll/vulcan), 'dynamic' "
+                 "(equal accessed-byte shares negotiated from the "
+                 "ranks' extents, fcoll/dynamic_gen2), 'auto' (dynamic "
+                 "when the spanned region is mostly holes)")
 
     def file_query(self, file):
         return self._prio.value, OmpioModule(self, file)

@@ -267,3 +267,93 @@ def test_mp_two_aggregator_fcoll(tmp_path):
                 extra=("--mca", "io_ompio_num_aggregators", "2"))
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("agg2 OK") == 4
+
+
+def test_mp_fcoll_dynamic_ragged_pattern(tmp_path):
+    """fcoll/dynamic_gen2 analog: a ragged pattern — two dense data
+    islands separated by a huge hole.  Static address stripes would give
+    one aggregator nearly all bytes (the hole splits the span, not the
+    data); the dynamic strategy negotiates equal accessed-byte shares
+    from the ranks' extents.  Runs the SAME pattern under both forced
+    strategies plus auto (which must pick dynamic here), and all three
+    files must agree byte-for-byte."""
+    for alg in ("dynamic", "static", "auto"):
+        path = tmp_path / f"rag_{alg}.dat"
+        script = tmp_path / f"rag_{alg}.py"
+        script.write_text(textwrap.dedent(f"""
+            import numpy as np, ompi_tpu
+            from ompi_tpu.api.file import File
+            from ompi_tpu.datatype import core
+            w = ompi_tpu.init()
+            r = w.rank
+            f = File.open(w, {str(path)!r}, "c+")
+            # ONE collective call spans two 1KB data islands 1MB apart
+            # (vector view: 2 blocks of 256B, 1MB stride): the spanned
+            # region is ~0.2% data -> the auto heuristic must go dynamic
+            ft = core.vector(2, 256, 1 << 20, core.BYTE)
+            f.set_view(r * 256, core.BYTE, ft)
+            data = np.concatenate([
+                np.full(256, 10 * (r + 1), np.uint8),
+                np.full(256, 10 * (r + 1) + 5, np.uint8)])
+            f.write_at_all(0, data)
+            mod = f.io_module
+            assert mod.last_fcoll_alg == {("dynamic" if alg == "auto"
+                                           else alg)!r}, \\
+                (mod.last_fcoll_alg, {alg!r})
+            f.set_view(0, core.BYTE, core.BYTE)
+            back = np.zeros(1024, np.uint8)
+            f.read_at_all(0, back)
+            expect = np.repeat(np.arange(1, 5, dtype=np.uint8) * 10, 256)
+            assert np.array_equal(back, expect), back[::256]
+            back2 = np.zeros(1024, np.uint8)
+            f.read_at_all(1 << 20, back2)
+            assert np.array_equal(
+                back2, np.repeat(np.arange(1, 5, dtype=np.uint8) * 10 + 5,
+                                 256)), back2[::256]
+            f.close()
+            print(f"ragged {alg} OK rank {{r}}")
+        """))
+        r = _tpurun(4, [sys.executable, str(script)],
+                    extra=("--mca", "io_ompio_num_aggregators", "2",
+                           "--mca", "io_ompio_fcoll", alg))
+        assert r.returncode == 0, (alg, r.stdout + r.stderr)
+        assert r.stdout.count(f"ragged {alg} OK") == 4, (alg, r.stdout)
+    ref = (tmp_path / "rag_dynamic.dat").read_bytes()
+    assert (tmp_path / "rag_static.dat").read_bytes() == ref
+    assert (tmp_path / "rag_auto.dat").read_bytes() == ref
+
+
+def test_fcoll_domain_partitioning_unit():
+    """The dynamic partition balances ACCESSED bytes: two islands of
+    equal size with a huge hole between them -> with 2 aggregators the
+    cut lands in the hole, one island per aggregator (static would hand
+    both islands to aggregator 0 when the hole dominates the right
+    half... or split island A)."""
+    from ompi_tpu.mca.io.ompio import OmpioModule
+
+    class FakeComm:
+        size = 2
+        rank = 0
+
+        def allgatherv(self, flat):
+            import numpy as np
+            # rank 0: island A [0, 1000); rank 1: island B [10**6, 10**6+1000)
+            return [np.array([0, 1000], np.int64),
+                    np.array([1 << 20, 1000], np.int64)]
+
+    class FakeComponent:
+        class fcoll_var:
+            value = "dynamic"
+
+        class num_aggs_var:
+            value = 2
+
+    mod = OmpioModule.__new__(OmpioModule)
+    mod._c = FakeComponent
+    aggs, edges = mod._file_domains(FakeComm(), [[0, 1000]])
+    assert len(edges) == 3
+    # the cut must land between the islands, giving each agg ~1000 bytes
+    assert 1000 <= edges[1] <= (1 << 20), edges
+    # routing splits a run crossing the cut
+    pieces = list(OmpioModule._route(edges, 900, 200))
+    assert sum(t for _, _, t in pieces) == 200
