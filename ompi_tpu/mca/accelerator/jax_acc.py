@@ -6,17 +6,136 @@ HBM (→ XLA collective path, DEVICE convertor flag) or host memory (→ host
 pack/unpack).  Registration of device memory is implicit in jax.Array
 ownership; ``register``/``deregister`` keep an interval-tree bookkeeping of
 exposed host regions for the RMA path (rcache equivalent).
+
+The **staging pool** is the ``rcache/grdma`` reuse analog
+(``opal/mca/rcache/grdma/rcache_grdma.c``): grdma exists so repeated
+transfers reuse pinned registrations instead of re-pinning per call;
+here, repeated host-path collectives reuse warmed staging buffers
+(LRU keyed on (shape, dtype)) instead of re-allocating.  A fresh
+``np.empty`` is lazily mapped and re-faults its pages on every call —
+measured ~6x the warmed-checkout cost (36µs vs 6µs per 1MB buffer,
+``bench.py staging_micro_row``).  On the 1-core host harness that tax
+is <1% of a 25ms collective (end-to-end within noise); it matters
+where transfers are fast relative to allocation, which is exactly the
+regime grdma targets.
 """
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import Any, Optional
 
 import numpy as np
 
 from ompi_tpu.base.containers import IntervalTree
 from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType, registry
 
 _rcache = IntervalTree()
+
+# module-level vars (the vprotocol pattern: this framework's component
+# is consumed by direct import, not framework selection)
+_pool_var = registry.register(
+    "accelerator", "jax", "staging_pool", vtype=VarType.BOOL, default=True,
+    help="Reuse host staging buffers across collective calls "
+         "(rcache/grdma-style LRU); 0 allocates fresh per call")
+_pool_bytes_var = registry.register(
+    "accelerator", "jax", "staging_pool_bytes", vtype=VarType.SIZE,
+    default="64m",
+    help="Total bytes of idle staging buffers kept for reuse before "
+         "LRU eviction")
+
+
+class _StagingPool:
+    """LRU pool of reusable host staging buffers (grdma-style reuse).
+
+    ``acquire`` returns a warmed buffer when one of the exact
+    (shape, dtype) is cached (contents undefined, like ``np.empty``);
+    ``release`` returns it for reuse, evicting least-recently-used
+    entries beyond ``max_bytes``.  Unless explicitly overridden
+    (tests), enablement and capacity follow the MCA vars.
+    """
+
+    def __init__(self, max_bytes: Optional[int] = None,
+                 enabled: Optional[bool] = None) -> None:
+        self._lock = threading.Lock()
+        self._free: OrderedDict[tuple, list] = OrderedDict()
+        self._bytes = 0
+        self._max_bytes = max_bytes
+        self._enabled = enabled
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def enabled(self) -> bool:
+        if self._enabled is not None:
+            return self._enabled
+        return bool(_pool_var.value)
+
+    @enabled.setter
+    def enabled(self, v) -> None:
+        self._enabled = bool(v) if v is not None else None
+
+    @property
+    def max_bytes(self) -> int:
+        if self._max_bytes is not None:
+            return self._max_bytes
+        return int(_pool_bytes_var.value)
+
+    @max_bytes.setter
+    def max_bytes(self, v) -> None:
+        self._max_bytes = int(v) if v is not None else None
+
+    @staticmethod
+    def _key(shape, dtype) -> tuple:
+        if isinstance(shape, (int, np.integer)):
+            shape = (int(shape),)
+        return tuple(int(s) for s in shape), np.dtype(dtype).str
+
+    def acquire(self, shape, dtype) -> np.ndarray:
+        key = self._key(shape, dtype)
+        if self.enabled:
+            with self._lock:
+                lst = self._free.get(key)
+                if lst:
+                    self._free.move_to_end(key)
+                    buf = lst.pop()
+                    self._bytes -= buf.nbytes
+                    self.hits += 1
+                    return buf
+                self.misses += 1
+        return np.empty(key[0], np.dtype(dtype))
+
+    def release(self, buf: np.ndarray) -> None:
+        if not self.enabled or buf.base is not None:
+            return   # never pool views: the base owns the memory
+        key = self._key(buf.shape, buf.dtype)
+        with self._lock:
+            self._free.setdefault(key, []).append(buf)
+            self._free.move_to_end(key)
+            self._bytes += buf.nbytes
+            while self._bytes > self.max_bytes and self._free:
+                _, lst = self._free.popitem(last=False)   # LRU key out
+                self._bytes -= sum(b.nbytes for b in lst)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._free.clear()
+            self._bytes = 0
+            self.hits = self.misses = 0
+
+
+staging = _StagingPool()
+
+
+def staging_acquire(shape, dtype) -> np.ndarray:
+    """Checkout a host staging buffer (contents undefined)."""
+    return staging.acquire(shape, dtype)
+
+
+def staging_release(buf: np.ndarray) -> None:
+    """Return a buffer checked out with :func:`staging_acquire`."""
+    staging.release(buf)
 
 
 def is_device_array(x: Any) -> bool:

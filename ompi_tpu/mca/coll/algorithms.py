@@ -174,23 +174,32 @@ def allreduce_ring(comm, sendbuf, op=op_mod.SUM):
     right = (rank + 1) % size
     left = (rank - 1) % size
 
-    # reduce-scatter phase: at step k send block (rank - k), recv (rank-k-1)
-    for k in range(size - 1):
-        soff, scnt = blocks[(rank - k) % size]
-        roff, rcnt = blocks[(rank - k - 1) % size]
-        inbuf = np.empty(rcnt, acc.dtype)
-        comm.sendrecv(acc[soff:soff + scnt], dest=right, recvbuf=inbuf,
-                      source=left, sendtag=tag, recvtag=tag)
-        op(inbuf, acc[roff:roff + rcnt])
+    # ONE pooled staging buffer serves every step (grdma-style reuse:
+    # repeated 4MB allreduces re-fault fresh np.empty pages per call
+    # otherwise); block sizes differ by <=1 element, so slice to fit
+    from ompi_tpu.mca.accelerator import jax_acc
 
-    # allgather phase: circulate the completed blocks
-    for k in range(size - 1):
-        soff, scnt = blocks[(rank + 1 - k) % size]
-        roff, rcnt = blocks[(rank - k) % size]
-        inbuf = np.empty(rcnt, acc.dtype)
-        comm.sendrecv(acc[soff:soff + scnt], dest=right, recvbuf=inbuf,
-                      source=left, sendtag=tag, recvtag=tag)
-        acc[roff:roff + rcnt] = inbuf
+    tmp = jax_acc.staging_acquire(max(c for _, c in blocks), acc.dtype)
+    try:
+        # reduce-scatter phase: step k sends block (rank-k), recvs (rank-k-1)
+        for k in range(size - 1):
+            soff, scnt = blocks[(rank - k) % size]
+            roff, rcnt = blocks[(rank - k - 1) % size]
+            inbuf = tmp[:rcnt]
+            comm.sendrecv(acc[soff:soff + scnt], dest=right, recvbuf=inbuf,
+                          source=left, sendtag=tag, recvtag=tag)
+            op(inbuf, acc[roff:roff + rcnt])
+
+        # allgather phase: circulate the completed blocks
+        for k in range(size - 1):
+            soff, scnt = blocks[(rank + 1 - k) % size]
+            roff, rcnt = blocks[(rank - k) % size]
+            inbuf = tmp[:rcnt]
+            comm.sendrecv(acc[soff:soff + scnt], dest=right, recvbuf=inbuf,
+                          source=left, sendtag=tag, recvtag=tag)
+            acc[roff:roff + rcnt] = inbuf
+    finally:
+        jax_acc.staging_release(tmp)
     return acc.reshape(np.asarray(sendbuf).shape)
 
 

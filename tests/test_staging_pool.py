@@ -1,0 +1,100 @@
+"""Staging-buffer reuse pool (the rcache/grdma analog in
+``mca/accelerator/jax_acc.py``): unit semantics + reuse across repeated
+host-path ring allreduces."""
+import threading
+import traceback
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.mca.accelerator.jax_acc import _StagingPool, staging
+
+
+class TestPoolUnit:
+    def test_hit_miss_and_reuse(self):
+        p = _StagingPool(max_bytes=1 << 20)
+        a = p.acquire(100, np.float32)
+        assert p.misses == 1 and p.hits == 0
+        p.release(a)
+        b = p.acquire(100, np.float32)
+        assert b is a                   # warmed buffer reused
+        assert p.hits == 1
+        # different shape or dtype is a different key
+        c = p.acquire(101, np.float32)
+        d = p.acquire(100, np.float64)
+        assert c is not a and d is not a
+        assert p.misses == 3
+
+    def test_views_never_pooled(self):
+        p = _StagingPool()
+        a = p.acquire(10, np.float32)
+        p.release(a[:5])                # view: base owns the memory
+        assert p.acquire(5, np.float32) is not None
+        assert p.hits == 0
+
+    def test_lru_eviction_bound(self):
+        p = _StagingPool(max_bytes=1000)
+        bufs = [p.acquire(100, np.uint8) for _ in range(20)]
+        for b in bufs:
+            p.release(b)
+        assert p._bytes <= 1000
+
+    def test_disabled_passthrough(self):
+        p = _StagingPool()
+        p.enabled = False
+        a = p.acquire(7, np.int32)
+        p.release(a)
+        b = p.acquire(7, np.int32)
+        assert b is not a and p.hits == 0
+
+
+@pytest.fixture(scope="module")
+def world():
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    if w.size != 8:
+        pytest.skip("needs 8 virtual devices")
+    yield w
+    rt.reset_for_testing()
+
+
+def _spmd(comm, fn, timeout=60):
+    results = [None] * comm.size
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = fn(comm.as_rank(i), i)
+        except Exception:
+            errors.append((i, traceback.format_exc()))
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(comm.size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not errors, errors[0][1]
+    return results
+
+
+def test_ring_allreduce_reuses_staging(world):
+    from ompi_tpu.mca.coll import algorithms as algs
+
+    staging.clear()
+    x = np.arange(64 * world.size, dtype=np.float64)
+
+    def body(me, i):
+        return algs.allreduce_ring(me, x + i)
+
+    want0 = sum(x + i for i in range(world.size))
+    for _ in range(3):
+        results = _spmd(world, body)
+        for r in results:
+            np.testing.assert_allclose(r, want0)
+    # after the first sweep warmed the pool, later sweeps must hit
+    assert staging.hits > 0, (staging.hits, staging.misses)
+    assert staging.misses <= world.size, (staging.hits, staging.misses)
