@@ -85,13 +85,33 @@ class DeviceBench:
             np.ones((self.world.size, nelem), np.float32))
 
     def raw_fn(self, coll: str):
+        """Raw-XLA twin of each framework path, pinned to the IDENTICAL
+        algorithm/program shape (a different shape makes the ratio
+        meaningless as a dispatch-overhead guard — an earlier bcast
+        baseline gathered n blocks to deliver one and made the
+        framework look 1.5x 'faster')."""
         import jax
+        import jax.numpy as jnp
 
         P, sm = self._P, self._sm
+        n = self.ndev
+
+        def bcast_body(t):   # the same binomial ppermute tree as
+            me = jax.lax.axis_index("x")     # xla.py bcast_array
+            rel = me % n
+            cur = t
+            k = 1
+            while k < n:
+                perm = [(i, i + k) for i in range(min(k, n - k))]
+                recvd = jax.lax.ppermute(cur, "x", perm)
+                newly = (rel >= k) & (rel < 2 * k)
+                cur = jnp.where(newly, recvd, cur)
+                k *= 2
+            return cur
 
         bodies = {
             "allreduce": lambda t: jax.lax.psum(t[0], "x"),
-            "bcast": lambda t: jax.lax.all_gather(t[0], "x")[0][None],
+            "bcast": bcast_body,
             "allgather": lambda t: jax.lax.all_gather(t[0], "x"),
         }
         out_specs = {"allreduce": P(), "bcast": P("x"), "allgather": P()}
@@ -446,6 +466,9 @@ def host_staging_points() -> list:
 
 MULTIDEV_SIZES = (8, 4096, 262144, 4 << 20)
 MULTIDEV_SPOT = 262144
+#: acceptable fw-vs-raw ratio band for the 8-virtual-device table once
+#: raw baselines are pinned to identical program shapes
+MULTIDEV_BAND = (0.8, 1.25)
 
 
 def multidev_child() -> None:
@@ -471,10 +494,26 @@ def multidev_child() -> None:
     except Exception as exc:
         # one failing row must not cost the whole 8-device table
         print(f"multidev persistent failed: {exc}", file=sys.stderr)
+    # regression-guard contract: with raw baselines pinned to identical
+    # program shapes, every ratio must sit in a band around 1.0 —
+    # below = dispatch/selection regression, above = the baselines
+    # diverged again and the table stopped guarding anything.
+    # (Tiny payloads are latency-noise-bound: band-checked only at
+    # >=4KB.)  tests/test_bench_table.py fails CI on out-of-band rows.
+    for r in rows:
+        if r.get("nbytes", 0) >= 4096:
+            r["in_band"] = bool(
+                MULTIDEV_BAND[0] <= r["ratio"] <= MULTIDEV_BAND[1])
+    bad = [r for r in rows if r.get("in_band") is False]
+    if bad:
+        print("multidev rows OUT OF BAND: "
+              + ", ".join(f"{r['coll']}/{r['nbytes']}={r['ratio']}"
+                          for r in bad), file=sys.stderr)
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "BENCH_SWEEP_8DEV.json"), "w") as f:
         json.dump({"ndev": b.ndev, "grade": "correctness",
-                   "results": rows}, f, indent=1)
+                   "band": list(MULTIDEV_BAND), "results": rows},
+                  f, indent=1)
     import ompi_tpu
 
     ompi_tpu.finalize()

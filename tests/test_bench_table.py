@@ -1,0 +1,30 @@
+"""The committed 8-virtual-device ratio table must stay a trustworthy
+regression guard: raw baselines are pinned to the framework's exact
+program shapes (bench.py DeviceBench.raw_fn), so every ratio at >=4KB
+must sit inside MULTIDEV_BAND — below is a dispatch/selection
+regression, above means the baselines diverged again (round 3's bcast
+row 'beat' raw by 86% because the baseline gathered n blocks to
+deliver one)."""
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_committed_8dev_table_in_band():
+    with open(os.path.join(REPO, "BENCH_SWEEP_8DEV.json")) as f:
+        table = json.load(f)
+    rows = table["results"]
+    assert rows, "8-device table is empty"
+    lo, hi = table["band"]   # written by bench.py multidev_child
+    checked = 0
+    for r in rows:
+        if r.get("nbytes", 0) < 4096:
+            continue   # latency-noise-bound tiny payloads
+        assert lo <= r["ratio"] <= hi, (
+            f"{r['coll']}/{r['nbytes']}: ratio {r['ratio']} outside "
+            f"[{lo}, {hi}] — dispatch regression (low) or baseline "
+            f"shape divergence (high)")
+        assert r.get("in_band") is True, r
+        checked += 1
+    assert checked >= 5, f"only {checked} band-checked rows"
