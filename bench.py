@@ -553,9 +553,13 @@ def emit_metric(value: float, ratio: float, note: str = None) -> None:
     print(json.dumps(out))
 
 
+_probe_ok = False
+
+
 def backend_available(timeout: float = 180.0):
     """Probe the accelerator backend in a SUBPROCESS with a hard timeout;
-    returns (ok, detail).
+    returns (ok, detail).  A positive result is cached for the process
+    (pod_smoke -> main() must not pay the probe twice).
 
     The axon boot hook can make ``import jax`` / ``jax.devices()`` block
     indefinitely when the TPU tunnel is down; probing out-of-process is
@@ -564,6 +568,9 @@ def backend_available(timeout: float = 180.0):
     is surfaced, not mislabeled as a tunnel timeout."""
     import subprocess
 
+    global _probe_ok
+    if _probe_ok:
+        return True, ""
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -573,6 +580,7 @@ def backend_available(timeout: float = 180.0):
     if proc.returncode:
         return False, ("backend probe failed (rc="
                        f"{proc.returncode}): {proc.stderr[-400:]}")
+    _probe_ok = True
     return True, ""
 
 
@@ -710,6 +718,170 @@ def unreachable_fallback(detail: str, fast: bool) -> None:
         f"framework TPU path did not run.  {state}."))
 
 
+def _pallas_first_run(devs, mesh, interp: bool) -> dict:
+    """coll/pallas validation: every ring-kernel variant executes on
+    THIS mesh (compiled on real TPU, interpreter elsewhere) and matches
+    numpy."""
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    n = len(devs)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 256)).astype(np.float32)
+    x2 = rng.standard_normal((n, n, 16)).astype(np.float32)
+    put = jax.device_put
+    checks = {}
+
+    def chk(name, got, want, tol=1e-4):
+        checks[name] = bool(np.allclose(np.asarray(got), want, atol=tol,
+                                        rtol=tol))
+
+    chk("allreduce_fused",
+        pc.all_reduce(put(x), mesh, "x", "sum", interpret=interp),
+        x.sum(0))
+    chk("allreduce_seg",
+        pc.all_reduce(put(x), mesh, "x", "sum", interpret=interp,
+                      variant="seg", seg_elems=64), x.sum(0))
+    chk("allreduce_bidi",
+        pc.all_reduce(put(x), mesh, "x", "sum", interpret=interp,
+                      variant="bidi"), x.sum(0))
+    chk("allreduce_max",
+        pc.all_reduce(put(x), mesh, "x", "max", interpret=interp),
+        x.max(0), tol=1e-6)
+    chk("reduce_scatter",
+        pc.reduce_scatter(put(x2), mesh, "x", "sum", interpret=interp),
+        x2.sum(0))
+    chk("allgather",
+        pc.all_gather(put(x), mesh, "x", interpret=interp), x, tol=1e-6)
+    chk("bcast",
+        pc.bcast(put(x), mesh, "x", root=1, interpret=interp),
+        np.broadcast_to(x[1], x.shape), tol=1e-6)
+    return checks
+
+
+def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
+    """Tuned-ladder re-derivation scaffold: per (size, variant), the
+    compiler-scheduled coll/xla path vs the explicit coll/pallas ring —
+    the measurement the device ladder's crossovers are derived from on
+    a real pod.  Both the fused and segmented variants are probed (the
+    fused/seg crossover is itself a ladder input).  Timings use the
+    shared interleaved ``_timed_pair`` protocol (drift hits both sides
+    of a pair equally); interpreter-mode runs are dryrun-grade.
+    """
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    rows = []
+    for nbytes in sizes:
+        x = b.make(nbytes)
+        variants = ["fused"] if nbytes < (64 << 10) else ["fused", "seg"]
+        for variant in variants:
+            def pallas_fn(t, variant=variant):
+                return pc.all_reduce(t, b.mesh, "x", "sum",
+                                     interpret=interp, variant=variant)
+
+            pair = b._timed_pair(f"ladder_{variant}", b.fw_fn("allreduce"),
+                                 pallas_fn, x, x, nbytes, iters=6)
+            rows.append({"coll": "allreduce", "variant": variant,
+                         "nbytes": nbytes,
+                         "xla_us": pair["fw_lat_us"],
+                         "pallas_us": pair["raw_lat_us"],
+                         "winner": "pallas"
+                         if pair["raw_lat_us"] < pair["fw_lat_us"]
+                         else "xla"})
+    return rows
+
+
+def pod_smoke(dry_run: bool = False) -> int:
+    """One-command pod readiness (SURVEY §6 measurement protocol): the
+    first hour of real multi-chip access runs THIS to produce the full
+    round's evidence set instead of ad-hoc commands.
+
+    Phases: (1) capability probe, (2) coll/pallas first-run validation
+    of every ring-kernel variant, (3) the canonical full sweep +
+    persistent row via main() (real hardware) or a mini-sweep (dry
+    run), (4) tuned-ladder re-derivation probe -> LADDER_PROBE.json.
+    ``--dry-run`` forces the 8-virtual-CPU mesh + interpreter kernels
+    so CI can validate the script itself.
+    """
+    here = os.path.dirname(os.path.abspath(__file__))
+    report = {"dry_run": dry_run, "phases": {}}
+    if dry_run:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        ok, detail = backend_available()
+        if not ok:
+            report["phases"]["probe"] = {"ok": False, "detail": detail}
+            _atomic_write(os.path.join(here, "POD_SMOKE.json"),
+                          json.dumps(report, indent=1))
+            print(f"pod-smoke: backend unreachable: {detail}",
+                  file=sys.stderr)
+            return 1
+    import jax
+
+    if dry_run:
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    platform = getattr(devs[0], "platform", "?")
+    report["phases"]["probe"] = {"ok": True, "ndev": len(devs),
+                                 "platform": platform}
+    print(f"pod-smoke: {len(devs)} {platform} device(s)")
+
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devs), ("x",))
+    interp = dry_run or platform != "tpu"
+    checks = _pallas_first_run(devs, mesh, interp)
+    report["phases"]["pallas_first_run"] = {
+        "interpret": interp, **checks}
+    print("pod-smoke: pallas kernels "
+          + ("ALL OK" if all(checks.values()) else f"FAILED: {checks}"))
+
+    b = DeviceBench()
+    if dry_run or platform != "tpu":
+        rows = [b.point("allreduce", nb, iters=6)
+                for nb in MULTIDEV_SIZES]
+        try:
+            rows.append(b.persistent_point(MULTIDEV_SPOT, iters=10))
+        except Exception as exc:   # one row must not cost the report
+            print(f"pod-smoke persistent failed: {exc}", file=sys.stderr)
+        report["phases"]["sweep"] = {"grade": "dryrun", "rows": rows}
+    ladder = _ladder_probe(b, interp, sizes=(4096, 262144, 4 << 20))
+    grade = "dryrun" if interp else "device"
+    _atomic_write(os.path.join(here, "LADDER_PROBE.json"),
+                  json.dumps({"grade": grade, "rows": ladder}, indent=1))
+    report["phases"]["ladder_probe"] = {"grade": grade,
+                                        "rows": len(ladder)}
+    ok_all = all(checks.values())
+    if not dry_run and platform == "tpu":
+        # the canonical sweep + driver metric line (init is idempotent;
+        # main() finalizes).  The report records what actually happened
+        # and is written AFTER, so a failed sweep can't leave a report
+        # claiming device-grade evidence that was never produced.
+        try:
+            main()
+            report["phases"]["sweep"] = {"grade": "device", "ok": True,
+                                         "via": "main() full sweep"}
+        except Exception as exc:
+            report["phases"]["sweep"] = {"grade": "device", "ok": False,
+                                         "error": str(exc)}
+            ok_all = False
+    _atomic_write(os.path.join(here, "POD_SMOKE.json"),
+                  json.dumps(report, indent=1, default=str))
+    if dry_run or platform != "tpu":
+        import ompi_tpu
+
+        ompi_tpu.finalize()    # main() finalizes on the device path
+    print(f"pod-smoke: {'READY' if ok_all else 'NOT READY'} "
+          f"(report: POD_SMOKE.json, ladder: LADDER_PROBE.json)")
+    return 0 if ok_all else 2
+
+
 def main() -> None:
     fast = os.environ.get("OTPU_BENCH_FAST", "") not in ("", "0")
     ok, detail = backend_available()
@@ -784,5 +956,7 @@ if __name__ == "__main__":
     elif "--multidev" in sys.argv:
         for row in multidev_sweep():
             print(row)
+    elif "--pod-smoke" in sys.argv:
+        sys.exit(pod_smoke(dry_run="--dry-run" in sys.argv))
     else:
         main()

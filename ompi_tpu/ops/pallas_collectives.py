@@ -723,40 +723,59 @@ def _build_bcast(n: int, axis: str, nseg: int, seg: int, dtype_str: str,
 
 
 # -- public entry points (shard_map wrappers) ----------------------------
+#
+# Each wrapper resolves to a CACHED jitted program (lru keyed on mesh /
+# shape / dtype / op / variant): building jax.jit around a fresh closure
+# per call would retrace and recompile every time, turning each
+# collective into compile time (jax.sharding.Mesh is hashable and
+# equality-stable, so it can key the cache directly).
+
+@functools.lru_cache(maxsize=256)
+def _jit_right_permute(mesh, axis: str, payload_shape, dtype_str: str,
+                       interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    fn = _build_right_permute(n, axis, (1,) + payload_shape, dtype_str,
+                              interpret)
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False))
+
 
 def right_permute(x, mesh, axis: str, interpret: bool = True):
     """Rotate the leading (rank) axis by +1 via neighbor remote DMA —
     the PP activation-handoff primitive (``lax.ppermute`` twin)."""
+    if mesh.shape[axis] == 1:
+        return x
+    return _jit_right_permute(mesh, axis, tuple(x.shape[1:]),
+                              str(x.dtype), interpret)(x)
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_all_gather(mesh, axis: str, blk_shape, dtype_str: str,
+                    interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
-    if n == 1:
-        return x
-    shard_shape = (1,) + tuple(x.shape[1:])
-    fn = _build_right_permute(n, axis, shard_shape, str(x.dtype), interpret)
-    return jax.jit(shard_map(fn, mesh=mesh, in_specs=P(axis),
-                             out_specs=P(axis), check_vma=False))(x)
-
-
-def all_gather(x, mesh, axis: str, interpret: bool = True):
-    """(n, *S) sharded -> (n, *S) replicated via the DMA ring."""
-    jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    n = mesh.shape[axis]
-    if n == 1:
-        return x
-    blk_shape = tuple(x.shape[1:])
-    inner = _build_all_gather(n, axis, blk_shape, str(x.dtype), interpret)
+    inner = _build_all_gather(n, axis, blk_shape, dtype_str, interpret)
 
     def body(t):                       # t: (1, *S)
         return inner(t[0])             # (n, *S)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
-                             out_specs=P(), check_vma=False))(x)
+                             out_specs=P(), check_vma=False))
+
+
+def all_gather(x, mesh, axis: str, interpret: bool = True):
+    """(n, *S) sharded -> (n, *S) replicated via the DMA ring."""
+    if mesh.shape[axis] == 1:
+        return x
+    return _jit_all_gather(mesh, axis, tuple(x.shape[1:]), str(x.dtype),
+                           interpret)(x)
 
 
 #: default VMEM window (elements) for the segmented kernels when the
@@ -784,6 +803,37 @@ def _pad_value(op: str, dtype) -> float | int:
     return lim.min if op == "max" else lim.max
 
 
+@functools.lru_cache(maxsize=256)
+def _jit_reduce_scatter(mesh, axis: str, payload_shape, dtype_str: str,
+                        op: str, interpret: bool, variant: str,
+                        seg_elems):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    blk = int(np.prod(payload_shape)) if payload_shape else 1
+    if variant == "seg":
+        seg, blk_p = _seg_shape(blk, seg_elems)
+        inner = _build_reduce_scatter_seg(n, axis, blk_p, seg,
+                                          dtype_str, interpret, op)
+    else:
+        blk_p = blk
+        inner = _build_reduce_scatter(n, axis, blk, dtype_str,
+                                      interpret, op)
+
+    def body(t):                       # t: (1, n, *S)
+        rows = t[0].reshape(n, blk)
+        if blk_p != blk:
+            rows = jnp.pad(rows, ((0, 0), (0, blk_p - blk)),
+                           constant_values=_pad_value(op, dtype_str))
+        out = inner(rows)              # (blk_p,)
+        return out[:blk].reshape((1,) + payload_shape)
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False))
+
+
 def reduce_scatter(x, mesh, axis: str, op: str = "sum",
                    interpret: bool = True, variant: str = "fused",
                    seg_elems: int | None = None):
@@ -791,38 +841,50 @@ def reduce_scatter(x, mesh, axis: str, op: str = "sum",
     rank i receives the reduction of everyone's block i via the DMA
     ring.  ``variant='seg'`` uses the HBM-resident segmented kernel
     (window of ``seg_elems``) for payloads too large for VMEM."""
+    payload_shape = tuple(x.shape[2:])
+    if mesh.shape[axis] == 1:
+        return x.reshape((1,) + payload_shape)
+    return _jit_reduce_scatter(mesh, axis, payload_shape, str(x.dtype),
+                               op, interpret, variant, seg_elems)(x)
+
+
+def reduce_scatter_sum(x, mesh, axis: str, interpret: bool = True):
+    return reduce_scatter(x, mesh, axis, "sum", interpret)
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_all_reduce(mesh, axis: str, payload_shape, dtype_str: str,
+                    op: str, interpret: bool, variant: str, seg_elems):
     jax, jnp, lax, pl, pltpu = _mods()
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
-    payload_shape = tuple(x.shape[2:])
-    if n == 1:
-        return x.reshape((1,) + payload_shape)
-    blk = int(np.prod(payload_shape)) if payload_shape else 1
+    size = int(np.prod(payload_shape)) if payload_shape else 1
+    blk = -(-size // n)                # ceil
     if variant == "seg":
-        seg, blk_p = _seg_shape(blk, seg_elems)
-        inner = _build_reduce_scatter_seg(n, axis, blk_p, seg,
-                                          str(x.dtype), interpret, op)
-    else:
-        blk_p = blk
-        inner = _build_reduce_scatter(n, axis, blk, str(x.dtype),
+        seg, blk = _seg_shape(blk, seg_elems)
+        inner = _build_all_reduce_seg(n, axis, blk, seg, dtype_str,
                                       interpret, op)
+    elif variant == "bidi":
+        blk = blk + (blk % 2)          # even split across directions
+        inner = _build_all_reduce_bidi(n, axis, blk // 2, dtype_str,
+                                       interpret, op)
+    else:
+        inner = _build_all_reduce(n, axis, blk, dtype_str, interpret,
+                                  op)
+    padded = blk * n
 
-    def body(t):                       # t: (1, n, *S)
-        rows = t[0].reshape(n, blk)
-        if blk_p != blk:
-            rows = jnp.pad(rows, ((0, 0), (0, blk_p - blk)),
-                           constant_values=_pad_value(op, x.dtype))
-        out = inner(rows)              # (blk_p,)
-        return out[:blk].reshape((1,) + payload_shape)
+    def body(t):                       # t: (1, *S)
+        flat = t.reshape(-1)
+        if padded != size:
+            flat = jnp.pad(flat, (0, padded - size),
+                           constant_values=_pad_value(op, dtype_str))
+        out = inner(flat.reshape(n, blk))      # (n, blk) reduced
+        return out.reshape(-1)[:size].reshape(payload_shape)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
-                             out_specs=P(axis), check_vma=False))(x)
-
-
-def reduce_scatter_sum(x, mesh, axis: str, interpret: bool = True):
-    return reduce_scatter(x, mesh, axis, "sum", interpret)
+                             out_specs=P(), check_vma=False))
 
 
 def all_reduce(x, mesh, axis: str, op: str = "sum",
@@ -840,63 +902,30 @@ def all_reduce(x, mesh, axis: str, op: str = "sum",
     * ``'bidi'``  — both ICI directions carry half the payload each
       step (duplex links; halves per-step wire time).
     """
-    jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    n = mesh.shape[axis]
     payload_shape = tuple(x.shape[1:])
-    if n == 1:
+    if mesh.shape[axis] == 1:
         return x.reshape(payload_shape)
-    size = int(np.prod(payload_shape)) if payload_shape else 1
-    blk = -(-size // n)                # ceil
-    if variant == "seg":
-        seg, blk = _seg_shape(blk, seg_elems)
-        inner = _build_all_reduce_seg(n, axis, blk, seg, str(x.dtype),
-                                      interpret, op)
-    elif variant == "bidi":
-        blk = blk + (blk % 2)          # even split across directions
-        inner = _build_all_reduce_bidi(n, axis, blk // 2, str(x.dtype),
-                                       interpret, op)
-    else:
-        inner = _build_all_reduce(n, axis, blk, str(x.dtype), interpret,
-                                  op)
-    padded = blk * n
-
-    def body(t):                       # t: (1, *S)
-        flat = t.reshape(-1)
-        if padded != size:
-            flat = jnp.pad(flat, (0, padded - size),
-                           constant_values=_pad_value(op, x.dtype))
-        out = inner(flat.reshape(n, blk))      # (n, blk) reduced
-        return out.reshape(-1)[:size].reshape(payload_shape)
-
-    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
-                             out_specs=P(), check_vma=False))(x)
+    return _jit_all_reduce(mesh, axis, payload_shape, str(x.dtype), op,
+                           interpret, variant, seg_elems)(x)
 
 
 def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):
     return all_reduce(x, mesh, axis, "sum", interpret)
 
 
-def bcast(x, mesh, axis: str, root: int = 0, interpret: bool = True,
-          seg_elems: int = 65536):
-    """(n, *S) sharded -> (n, *S) with every row equal to root's row,
-    via the pipelined segmented ring (time ≈ (S + n - 2) segment-hops)."""
+@functools.lru_cache(maxsize=256)
+def _jit_bcast(mesh, axis: str, payload_shape, dtype_str: str,
+               interpret: bool, seg_elems: int):
     jax, jnp, lax, pl, pltpu = _mods()
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
-    if n == 1:
-        return x
-    payload_shape = tuple(x.shape[1:])
     size = int(np.prod(payload_shape)) if payload_shape else 1
     seg = min(seg_elems, size)
     nseg = -(-size // seg)
     padded = nseg * seg
-    inner = _build_bcast(n, axis, nseg, seg, str(x.dtype), interpret)
-    root_arr = jnp.asarray([int(root) % n], dtype=jnp.int32)
+    inner = _build_bcast(n, axis, nseg, seg, dtype_str, interpret)
 
     def body(r, t):                    # r: (1,) int32; t: (1, *S)
         flat = t.reshape(-1)
@@ -906,5 +935,19 @@ def bcast(x, mesh, axis: str, root: int = 0, interpret: bool = True,
         return out.reshape(-1)[:size].reshape((1,) + payload_shape)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(), P(axis)),
-                             out_specs=P(axis), check_vma=False))(
-                                 root_arr, x)
+                             out_specs=P(axis), check_vma=False))
+
+
+def bcast(x, mesh, axis: str, root: int = 0, interpret: bool = True,
+          seg_elems: int = 65536):
+    """(n, *S) sharded -> (n, *S) with every row equal to root's row,
+    via the pipelined segmented ring (time ≈ (S + n - 2) segment-hops).
+    ``root`` is a runtime operand — every root shares one compile."""
+    jax, jnp, lax, pl, pltpu = _mods()
+
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+    fn = _jit_bcast(mesh, axis, tuple(x.shape[1:]), str(x.dtype),
+                    interpret, int(seg_elems))
+    return fn(jnp.asarray([int(root) % n], dtype=jnp.int32), x)
