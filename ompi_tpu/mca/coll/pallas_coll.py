@@ -151,6 +151,19 @@ class PallasCollModule:
                                  interpret=self.interpret, variant=variant,
                                  seg_elems=seg_elems)
 
+    def alltoall_array(self, comm, x):
+        x = self._place(comm, x)
+        # pure DMA, no arithmetic: any dtype qualifies — only size and
+        # the (n, n, *S) layout gate (a malformed shape must surface as
+        # coll/xla's MpiError, not an out-of-bounds remote DMA)
+        if (not self._size_ok(x) or x.ndim < 2
+                or x.shape[0] != self.n or x.shape[1] != self.n):
+            return self._delegate("alltoall_array", comm, x)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        return pc.all_to_all(x, self.mesh, self.axis,
+                             interpret=self.interpret)
+
     def bcast_array(self, comm, x, root: int = 0):
         x = self._place(comm, x)
         # pure DMA, no arithmetic: any dtype qualifies — only size gates

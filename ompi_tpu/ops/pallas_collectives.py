@@ -38,6 +38,15 @@ are duplex, so both directions carry traffic every step and the bisection
 time halves (the reference gets the same effect from its two-proc-group
 rdb/segmented hybrids; here it is one kernel).
 
+**Torus schedules** (``all_reduce_torus``) ride sub-rings of a
+linearized (n0, n1) mesh — reduce-scatter along one torus dimension,
+all-reduce along the other on 1/n0-sized blocks, all-gather back — so
+every link of BOTH dimensions carries traffic and per-phase step count
+follows the axis lengths, not their product (coll/han's hierarchical
+composition, expressed as explicit DMA).  The **explicit all-to-all**
+(pairwise exchange over direct per-peer DMAs, ``coll_base_alltoall.c``)
+is the SP/MoE dispatch primitive.
+
 Reduction is parameterized (sum/max/min/prod) — one op argument, the
 same way ``ompi_op``'s function table parameterizes the reference's ring
 (``coll_base_allreduce.c:341`` takes any ``ompi_op_t``).
@@ -88,6 +97,28 @@ def _ring_kernels(n: int, axis: str, interpret: bool):
     return jax, jnp, lax, pl, pltpu, compiler_params
 
 
+def _ring_fn(lax, axis: str, sub):
+    """(ring position, position->logical-device-id map) for this device.
+
+    ``sub=None``: the ring IS the whole 1-D mesh (identity map).
+    ``sub=(n0, n1, j)``: the mesh linearizes a (n0, n1) torus row-major
+    and the ring rides axis j — position p maps to device p*n1+i1
+    (column ring pinned at my i1) or i0*n1+p (row ring pinned at my
+    i0).  Index arithmetic on scalar LOGICAL ids keeps every kernel
+    interpreter-runnable (the Pallas interpreter has no multi-axis DMA
+    mesh support) and lowers identically on hardware, where ICI routes
+    non-neighbor ids."""
+    my = lax.axis_index(axis)
+    if sub is None:
+        return my, (lambda p: p)
+    n0, n1, j = sub
+    i0 = my // n1
+    i1 = lax.rem(my, n1)
+    if j == 0:
+        return i0, (lambda p: p * n1 + i1)
+    return i1, (lambda p: i0 * n1 + p)
+
+
 @functools.lru_cache(maxsize=64)
 def _build_right_permute(n: int, axis: str, shape, dtype_str: str,
                          interpret: bool):
@@ -125,15 +156,15 @@ def _build_right_permute(n: int, axis: str, shape, dtype_str: str,
 
 @functools.lru_cache(maxsize=64)
 def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
-                      interpret: bool):
+                      interpret: bool, sub=None):
     """Ring all-gather: n-1 steps, each forwarding the freshest block to
     the right neighbor (``jax docs distributed`` canonical schedule; the
     reference's ``coll_base_allgather.c`` ring)."""
     jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
 
     def kernel(x_ref, out_ref, local_sem, send_sem, recv_sems):
-        my = lax.axis_index(axis)
-        right = lax.rem(my + 1, n)
+        my, dev = _ring_fn(lax, axis, sub)
+        right = dev(lax.rem(my + 1, n))
         cp = pltpu.make_async_copy(x_ref, out_ref.at[my], local_sem)
         cp.start()
         cp.wait()
@@ -203,7 +234,7 @@ def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
 
 @functools.lru_cache(maxsize=64)
 def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
-                      interpret: bool, op: str = "sum"):
+                      interpret: bool, op: str = "sum", sub=None):
     """Ring all-reduce: n-1 reduce-scatter steps with the fold fused
     into the ring loop, then n-1 all-gather steps — one kernel, the
     explicit-DMA form of ``coll_base_allreduce.c:341``.
@@ -219,8 +250,8 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref,
                local_sem, send_sem, rs_sems, ag_sems):
-        my = lax.axis_index(axis)
-        right = lax.rem(my + 1, n)
+        my, dev = _ring_fn(lax, axis, sub)
+        right = dev(lax.rem(my + 1, n))
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
@@ -262,7 +293,8 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
 
 @functools.lru_cache(maxsize=64)
 def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
-                          interpret: bool, op: str = "sum"):
+                          interpret: bool, op: str = "sum",
+                          sub=None):
     """Ring reduce-scatter: n-1 steps, fold fused into the ring;
     device i ends owning fully-reduced block i (the first half of
     ``coll_base_allreduce.c:341``'s ring, block-owner aligned)."""
@@ -271,8 +303,8 @@ def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref,
                local_sem, send_sem, rs_sems):
-        my = lax.axis_index(axis)
-        right = lax.rem(my + 1, n)
+        my, dev = _ring_fn(lax, axis, sub)
+        right = dev(lax.rem(my + 1, n))
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
@@ -640,6 +672,58 @@ def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
 
 
 @functools.lru_cache(maxsize=64)
+def _build_all_to_all(n: int, axis: str, blk_shape, dtype_str: str,
+                      interpret: bool):
+    """Explicit all-to-all: n-1 steps, at step k every device DMAs its
+    block for the device k hops right DIRECTLY to that device (ICI
+    routes non-neighbor transfers), landing in the sender's slot —
+    the SP/MoE dispatch primitive (``lax.all_to_all`` twin;
+    ``coll_base_alltoall.c`` pairwise-exchange algorithm, where step k
+    pairs (i, i+k)).  Fully symmetric: one DMA per device per step.
+    """
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+
+    def kernel(x_ref, out_ref, local_sem, send_sem, recv_sems):
+        my = lax.axis_index(axis)
+        cp = pltpu.make_async_copy(x_ref.at[my], out_ref.at[my],
+                                   local_sem)
+        cp.start()
+        cp.wait()
+
+        def step(k, carry):
+            peer = lax.rem(my + k, n)     # send my block for `peer`
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[peer], dst_ref=out_ref.at[my],
+                send_sem=send_sem, recv_sem=recv_sems.at[k - 1],
+                device_id=peer,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait()   # send done + block from (my-k) landed
+            return carry
+
+        lax.fori_loop(1, n, step, 0)
+
+    def call(x):  # x: (n, *blk) per device -> (n, *blk) transposed
+        kw = {}
+        cp = cparams(9)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n,) + blk_shape, dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
 def _build_bcast(n: int, axis: str, nseg: int, seg: int, dtype_str: str,
                  interpret: bool):
     """Pipelined segmented ring broadcast — the "clamped conveyor": root
@@ -911,6 +995,109 @@ def all_reduce(x, mesh, axis: str, op: str = "sum",
 
 def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):
     return all_reduce(x, mesh, axis, "sum", interpret)
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_all_to_all(mesh, axis: str, blk_shape, dtype_str: str,
+                    interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    inner = _build_all_to_all(n, axis, blk_shape, dtype_str, interpret)
+
+    def body(t):                       # t: (1, n, *S)
+        return inner(t[0])[None]       # (1, n, *S): row = my received
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False))
+
+
+def all_to_all(x, mesh, axis: str, interpret: bool = True):
+    """(n, n, *S) sharded on the leading rank axis: rank i's block j
+    moves to rank j's slot i (``x[i, j] -> out[j, i]``, the coll/xla
+    ``alltoall_array`` convention) via direct per-peer remote DMA."""
+    n = mesh.shape[axis]
+    if x.ndim < 2 or x.shape[0] != n or x.shape[1] != n:
+        # the kernel indexes n blocks per rank: anything else would be
+        # an out-of-bounds remote DMA, not a reshape-able layout
+        raise ValueError(
+            f"all_to_all needs a ({n}, {n}, *S) array on this mesh, "
+            f"got {tuple(x.shape)}")
+    if n == 1:
+        return x
+    return _jit_all_to_all(mesh, axis, tuple(x.shape[2:]), str(x.dtype),
+                           interpret)(x)
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_all_reduce_torus(mesh, axes, payload_shape, dtype_str: str,
+                          op: str, interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    a0, a1 = axes
+    n0, n1 = mesh.shape[a0], mesh.shape[a1]
+    size = int(np.prod(payload_shape)) if payload_shape else 1
+    blk0 = -(-size // n0)
+    blk1 = -(-blk0 // n1)
+    # the kernels run over a FLATTENED 1-D mesh with sub-ring index
+    # arithmetic ((i0, i1) <-> i0*n1+i1): scalar LOGICAL device ids
+    # stay interpreter-runnable and lower identically on hardware
+    flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
+    rs0 = _build_reduce_scatter(n0, "_t", blk0, dtype_str, interpret,
+                                op, sub=(n0, n1, 0))
+    ar1 = _build_all_reduce(n1, "_t", blk1, dtype_str, interpret, op,
+                            sub=(n0, n1, 1))
+    ag0 = _build_all_gather(n0, "_t", (blk0,), dtype_str, interpret,
+                            sub=(n0, n1, 0))
+    pad = _pad_value(op, dtype_str)
+
+    def body(t):                       # t: (1, *S)
+        flat = t.reshape(-1)
+        if blk0 * n0 != size:
+            flat = jnp.pad(flat, (0, blk0 * n0 - size),
+                           constant_values=pad)
+        part = rs0(flat.reshape(n0, blk0))         # (blk0,) over a0
+        if blk1 * n1 != blk0:
+            part = jnp.pad(part, (0, blk1 * n1 - blk0),
+                           constant_values=pad)
+        red = ar1(part.reshape(n1, blk1)).reshape(-1)[:blk0]  # over a1
+        full = ag0(red)                            # (n0, blk0) over a0
+        return full.reshape(-1)[:size].reshape(payload_shape)
+
+    return jax.jit(shard_map(body, mesh=flat_mesh, in_specs=P("_t"),
+                             out_specs=P(), check_vma=False))
+
+
+def all_reduce_torus(x, mesh, axes=("x", "y"), op: str = "sum",
+                     interpret: bool = True):
+    """(n0, n1, *S) sharded over both torus axes -> (*S) replicated
+    reduction: reduce-scatter rings along ``axes[0]``, all-reduce rings
+    along ``axes[1]`` on the scattered blocks, all-gather rings along
+    ``axes[0]`` back.  Per-step wire time scales with the axis lengths
+    (n0 + n1 ring steps on 1/n0-sized blocks) rather than one n0*n1
+    ring, and every link of BOTH torus dimensions carries traffic — the
+    2D schedule the reference reaches for with coll/han's hierarchical
+    composition (``coll_han``), expressed as three explicit-DMA phases.
+    """
+    axes = tuple(axes)
+    payload_shape = tuple(x.shape[2:])
+    n0, n1 = mesh.shape[axes[0]], mesh.shape[axes[1]]
+    if n0 == 1 or n1 == 1:
+        # a degenerate torus axis is a plain 1-D ring (a single pod
+        # row/column): the zero-sized (n-1, blk) recv scratch of an
+        # n=1 sub-ring cannot build
+        from jax.sharding import Mesh
+
+        flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
+        return all_reduce(x.reshape((n0 * n1,) + payload_shape),
+                          flat_mesh, "_t", op, interpret)
+    fn = _jit_all_reduce_torus(mesh, axes, payload_shape,
+                               str(x.dtype), op, interpret)
+    return fn(x.reshape((n0 * n1,) + payload_shape))
 
 
 @functools.lru_cache(maxsize=256)

@@ -79,11 +79,12 @@ def pallas_world():
 def test_component_owns_slots_when_raised(pallas_world):
     w = pallas_world
     for slot in ("allreduce_array", "allgather_array",
-                 "reduce_scatter_array", "ppermute_array"):
+                 "reduce_scatter_array", "ppermute_array",
+                 "alltoall_array", "bcast_array"):
         assert w.c_coll[slot].__self__.__class__.__name__ \
             == "PallasCollModule", slot
     # slots pallas does not implement stay with xla
-    assert w.c_coll["alltoall_array"].__self__.__class__.__name__ \
+    assert w.c_coll["scan_array"].__self__.__class__.__name__ \
         == "XlaCollModule"
 
 
@@ -190,6 +191,48 @@ def test_kernel_reduce_scatter_segmented(mesh):
     y = np.asarray(pc.reduce_scatter(jax.device_put(x), mesh, "x", "sum",
                                      variant="seg", seg_elems=16))
     np.testing.assert_allclose(y, x.sum(0), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape,op,ref", [
+    ((4, 2), "sum", np.sum), ((2, 4), "sum", np.sum),
+    ((4, 2), "max", np.max)])
+def test_kernel_all_reduce_torus(mesh, shape, op, ref):
+    """2D-torus composition: reduce-scatter rings along axis 0,
+    all-reduce rings along axis 1 on the scattered blocks, all-gather
+    back — sub-rings of a flattened mesh via index arithmetic."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    n0, n1 = shape
+    mesh2d = Mesh(np.array(jax.devices()).reshape(n0, n1), ("x", "y"))
+    x = np.random.default_rng(17).standard_normal(
+        (n0, n1, 1000)).astype(np.float32)
+    y = np.asarray(pc.all_reduce_torus(jax.device_put(x), mesh2d,
+                                       ("x", "y"), op))
+    np.testing.assert_allclose(y, ref(x, axis=(0, 1)), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_kernel_all_to_all(mesh):
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(15).standard_normal(
+        (8, 8, 5)).astype(np.float32)
+    y = np.asarray(pc.all_to_all(jax.device_put(x), mesh, "x"))
+    # x[i, j] -> out[j, i] (the coll/xla alltoall_array convention)
+    np.testing.assert_allclose(y, x.swapaxes(0, 1), rtol=1e-6)
+
+
+def test_component_alltoall(pallas_world):
+    w = pallas_world
+    host = np.random.default_rng(16).standard_normal(
+        (8, 8, 3)).astype(np.float32)
+    out = np.asarray(w.alltoall_array(host))
+    np.testing.assert_allclose(out, host.swapaxes(0, 1), rtol=1e-6)
 
 
 @pytest.mark.parametrize("root", [0, 3, 7])
