@@ -770,6 +770,7 @@ def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
     of a pair equally); interpreter-mode runs are dryrun-grade.
     """
     from ompi_tpu.ops import pallas_collectives as pc
+    from ompi_tpu.ops import pallas_overlap as po
 
     rows = []
     for nbytes in sizes:
@@ -789,6 +790,38 @@ def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
                          "winner": "pallas"
                          if pair["raw_lat_us"] < pair["fw_lat_us"]
                          else "xla"})
+
+    # fused collective matmul vs XLA's matmul-then-psum: the overlap row
+    # the explicit transport exists for (ops/pallas_overlap.py)
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = b.ndev
+    M = K = 256
+    N = 128
+    key_a = jnp.ones((n, M, K // n), jnp.float32)
+    key_b = jnp.ones((n, K // n, N), jnp.float32)
+
+    def fused(args):
+        return po.matmul_allreduce(args[0], args[1], b.mesh, "x",
+                                   interpret=interp)
+
+    unfused = jax.jit(shard_map(
+        lambda a, bb: jax.lax.psum(a[0] @ bb[0], "x"),
+        mesh=b.mesh, in_specs=(P("x"), P("x")), out_specs=P(),
+        check_vma=False))
+
+    pair = b._timed_pair(
+        "ladder_matmul", fused, lambda args: unfused(*args),
+        (key_a, key_b), (key_a, key_b), M * K * 4, iters=6)
+    rows.append({"coll": "matmul_allreduce", "variant": "overlap",
+                 "nbytes": M * K * 4,
+                 "xla_us": pair["raw_lat_us"],
+                 "pallas_us": pair["fw_lat_us"],
+                 "winner": "pallas"
+                 if pair["fw_lat_us"] < pair["raw_lat_us"] else "xla"})
     return rows
 
 

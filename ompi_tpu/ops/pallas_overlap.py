@@ -163,6 +163,11 @@ def matmul_allreduce(a, b, mesh, axis: str, interpret: bool = True):
             f"{int(b.shape[1])}")
     if n == 1:
         return a[0] @ b[0]
+    dtype = np.result_type(a.dtype, b.dtype)
+    if a.dtype != dtype or b.dtype != dtype:
+        # promote OUTSIDE the kernel: mixed-dtype refs would mismatch
+        # the VMEM scratch and fail tracing
+        a = a.astype(dtype)
+        b = b.astype(dtype)
     return _jit_matmul_allreduce(mesh, axis, m, k_loc, n_out,
-                                 str(np.result_type(a.dtype, b.dtype)),
-                                 interpret)(a, b)
+                                 str(dtype), interpret)(a, b)
