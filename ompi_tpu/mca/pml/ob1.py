@@ -754,9 +754,10 @@ class Ob1Component(Component):
         self._rget_emu_var = self.register_var(
             "rget_emulate", vtype=VarType.BOOL, default=False,
             help="Allow RGET's request/stream pull emulation on btls "
-                 "without one-sided get (btl/tcp): measured ~0.9x the "
-                 "FRAG stream (extra round-trip, no zero-copy win), so "
-                 "off by default — the crossover is the btl rdma flag")
+                 "without one-sided get (btl/tcp): measured ~0.9-1.1x "
+                 "the FRAG stream across runs (extra round-trip, no "
+                 "zero-copy win — parity within noise), so off by "
+                 "default — the crossover is the btl rdma flag")
         self._stripe_var = self.register_var(
             "stripe", vtype=VarType.BOOL, default=True,
             help="Stripe large RNDV/pull streams across every btl that "

@@ -56,6 +56,18 @@ def build_plan(seed: int, n: int, rounds: int):
             dict(victims={}, suspect=None, concurrent=False, flags=flags,
                  dead_after=frozenset({0, 1})),
         ]
+    if seed == 1:
+        # designed: the ROOT dies while TWO agreement instances are in
+        # flight on different comms (the concurrent round) — both
+        # instances must converge uniformly through the takeover
+        flags = [rng.getrandbits(8) | 1 for _ in range(N)]
+        return [
+            dict(victims={0: ("delay", 0, 0.25)}, suspect=None,
+                 concurrent=True, flags=flags,
+                 dead_after=frozenset({0})),
+            dict(victims={}, suspect=None, concurrent=False,
+                 flags=flags, dead_after=frozenset({0})),
+        ]
     plan = []
     alive = set(range(N))
     for rd in range(rounds):

@@ -11,10 +11,12 @@ Every round asserts ERA's uniformity property: all survivors that
 return a value return the SAME value — the property
 ``coll_ftagree_earlyreturning.c`` carries 3,371 lines of machinery for.
 
-Seed 0 is a designed worst case (root dies between prepare-complete
-and commit AND the takeover root dies mid-prepare — cascading
-takeover); the rest are randomized.  6 seeds x 3-4 rounds (+ a doubled
-concurrent round each) = 27 scenarios.
+Seeds 0 and 1 are designed worst cases (0: root dies between
+prepare-complete and commit AND the takeover root dies mid-prepare —
+cascading takeover; 1: the root dies while two agreement instances are
+concurrently in flight on different comms); the rest are randomized.
+7 seeds x 2-4 rounds (+ a doubled concurrent round each) = 30
+scenarios.
 """
 import os
 import re
@@ -29,7 +31,7 @@ WORKER = Path(__file__).resolve().parent / "fuzz_agree_worker.py"
 
 N = 5
 ROUNDS = 4
-SEEDS = [0, 11, 23, 37, 58, 71]
+SEEDS = [0, 1, 11, 23, 37, 58, 71]
 
 
 def _plan_for(seed):
