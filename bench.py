@@ -757,6 +757,23 @@ def _pallas_first_run(devs, mesh, interp: bool) -> dict:
     chk("bcast",
         pc.bcast(put(x), mesh, "x", root=1, interpret=interp),
         np.broadcast_to(x[1], x.shape), tol=1e-6)
+
+    # the fused compute+communicate kernels are part of the evidence
+    # set too (pallas_overlap: new collective_ids, real RDMA semantics
+    # on hardware)
+    from ompi_tpu.ops import pallas_overlap as po
+
+    m, k_loc, n_out = 2 * n, 16, 8
+    a = rng.standard_normal((n, m, k_loc)).astype(np.float32)
+    bb = rng.standard_normal((n, k_loc, n_out)).astype(np.float32)
+    want = sum(a[i] @ bb[i] for i in range(n))
+    chk("matmul_allreduce",
+        po.matmul_allreduce(put(a), put(bb), mesh, "x",
+                            interpret=interp), want, tol=1e-3)
+    chk("matmul_reduce_scatter",
+        po.matmul_reduce_scatter(put(a), put(bb), mesh, "x",
+                                 interpret=interp),
+        want.reshape(n, m // n, n_out), tol=1e-3)
     return checks
 
 

@@ -35,15 +35,24 @@ from ompi_tpu.ops.pallas_collectives import _ag_phase, _mods, _ring_kernels
 
 
 @functools.lru_cache(maxsize=64)
-def _build_matmul_allreduce(n: int, axis: str, m_blk: int, k_loc: int,
-                            n_out: int, dtype_str: str, interpret: bool):
-    """Fused ring kernel: per device A (n*m_blk, k_loc) @ B (k_loc,
-    n_out), partial products reduced across the ring with just-in-time
-    block compute overlapping each step's DMA."""
+def _build_fused_matmul(n: int, axis: str, m_blk: int, k_loc: int,
+                        n_out: int, dtype_str: str, interpret: bool,
+                        align: int, with_ag: bool, cid: int):
+    """ONE fused matmul+ring builder for both output layouts.
+
+    ``align=0, with_ag=True``: the all-reduce form — after the fused
+    reduce-scatter, block (my+1) is complete and an all-gather ring
+    replicates the full product (out: (n, m_blk, n_out)).
+    ``align=-1, with_ag=False``: the owner-aligned reduce-scatter form —
+    block ``my`` completes locally and IS the output (out: (m_blk,
+    n_out)), the Megatron-style row-parallel GEMM.  Same VMEM staging,
+    just-in-time block compute, and DMA/semaphore discipline either way
+    (a fix to one schedule is a fix to both).
+    """
     jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
 
     def kernel(a_ref, b_ref, out_ref, a_vmem, b_vmem, acc_ref, recv_ref,
-               local_sem, send_sem, rs_sems, ag_sems):
+               local_sem, send_sem, rs_sems, *maybe_ag_sems):
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
         # operands land in VMEM first: compute dereferences need VMEM
@@ -62,12 +71,13 @@ def _build_matmul_allreduce(n: int, axis: str, m_blk: int, k_loc: int,
                            preferred_element_type=jnp.float32
                            ).astype(acc_ref.dtype)
 
-        # block my is needed first (sent at step 0)
-        acc_ref[pl.ds(my, 1)] = partial(my)[None]
+        # the block sent at step 0 is needed first
+        first = lax.rem(my + align + n, n)
+        acc_ref[pl.ds(first, 1)] = partial(first)[None]
 
         def rs_step(k, carry):
-            send_idx = lax.rem(my - k + 2 * n, n)
-            recv_idx = lax.rem(my - 1 - k + 2 * n, n)
+            send_idx = lax.rem(my + align - k + 2 * n, n)
+            recv_idx = lax.rem(my + align - 1 - k + 2 * n, n)
             rdma = pltpu.make_async_remote_copy(
                 src_ref=acc_ref.at[send_idx], dst_ref=recv_ref.at[k],
                 send_sem=send_sem, recv_sem=rs_sems.at[k],
@@ -82,43 +92,99 @@ def _build_matmul_allreduce(n: int, axis: str, m_blk: int, k_loc: int,
             return carry
 
         lax.fori_loop(0, n - 1, rs_step, 0)
+        done = lax.rem(my + align + 1 + n, n)
+        if with_ag:
+            cp = pltpu.make_async_copy(acc_ref.at[done],
+                                       out_ref.at[done], local_sem)
+            cp.start()
+            cp.wait()
+            _ag_phase(lax, pl, pltpu, n=n, my=my, right=right,
+                      out_ref=out_ref, send_sem=send_sem,
+                      ag_sems=maybe_ag_sems[0])
+        else:
+            cp = pltpu.make_async_copy(acc_ref.at[done], out_ref,
+                                       local_sem)
+            cp.start()
+            cp.wait()
 
-        # block (my+1) is fully reduced; circulate it (the shared
-        # ag-ring discipline)
-        done = lax.rem(my + 1, n)
-        cp = pltpu.make_async_copy(acc_ref.at[done], out_ref.at[done],
-                                   local_sem)
-        cp.start()
-        cp.wait()
-
-        _ag_phase(lax, pl, pltpu, n=n, my=my, right=right,
-                  out_ref=out_ref, send_sem=send_sem, ag_sems=ag_sems)
+    out_shape = (n, m_blk, n_out) if with_ag else (m_blk, n_out)
+    scratch = [pltpu.VMEM((n * m_blk, k_loc), jnp.dtype(dtype_str)),
+               pltpu.VMEM((k_loc, n_out), jnp.dtype(dtype_str)),
+               pltpu.VMEM((n, m_blk, n_out), jnp.dtype(dtype_str)),
+               pltpu.VMEM((n - 1, m_blk, n_out), jnp.dtype(dtype_str)),
+               pltpu.SemaphoreType.DMA(()),
+               pltpu.SemaphoreType.DMA(()),
+               pltpu.SemaphoreType.DMA((n - 1,))]
+    if with_ag:
+        scratch.append(pltpu.SemaphoreType.DMA((n - 1,)))
 
     def call(a, b):   # a: (n*m_blk, k_loc), b: (k_loc, n_out)
         kw = {}
-        cp = cparams(10)
+        cp = cparams(cid)
         if cp is not None:
             kw["compiler_params"] = cp
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((n, m_blk, n_out), dtype_str),
+            out_shape=jax.ShapeDtypeStruct(out_shape, dtype_str),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY),
                       pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[
-                pltpu.VMEM((n * m_blk, k_loc), jnp.dtype(dtype_str)),
-                pltpu.VMEM((k_loc, n_out), jnp.dtype(dtype_str)),
-                pltpu.VMEM((n, m_blk, n_out), jnp.dtype(dtype_str)),
-                pltpu.VMEM((n - 1, m_blk, n_out), jnp.dtype(dtype_str)),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA((n - 1,)),
-                pltpu.SemaphoreType.DMA((n - 1,))],
+            scratch_shapes=scratch,
             interpret=interpret,
             **kw,
         )(a, b)
 
     return call
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_matmul_reduce_scatter(mesh, axis: str, m: int, k_loc: int,
+                               n_out: int, dtype_str: str,
+                               interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    m_blk = -(-m // n)
+    m_pad = m_blk * n
+    inner = _build_fused_matmul(n, axis, m_blk, k_loc, n_out,
+                                dtype_str, interpret, align=-1,
+                                with_ag=False, cid=11)
+
+    def body(a, b):   # a: (1, m, k_loc), b: (1, k_loc, n_out)
+        a2 = a[0]
+        if m_pad != m:
+            a2 = jnp.pad(a2, ((0, m_pad - m), (0, 0)))
+        return inner(a2, b[0])[None]     # (1, m_blk, n_out)
+
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(P(axis), P(axis)),
+                             out_specs=P(axis), check_vma=False))
+
+
+def matmul_reduce_scatter(a, b, mesh, axis: str,
+                          interpret: bool = True):
+    """Row-parallel fused GEMM: device i returns row-block i of
+    Σ_j A_j @ B_j (global shape (n, M/n-padded, N) sharded on the mesh
+    axis) — the reduce-scatter half of :func:`matmul_allreduce`, the
+    Megatron-style TP output projection.  M is padded to a multiple of
+    n; callers slice the tail block if M % n != 0."""
+    n = mesh.shape[axis]
+    m, k_loc = int(a.shape[1]), int(a.shape[2])
+    n_out = int(b.shape[2])
+    if int(b.shape[1]) != k_loc:
+        raise ValueError(
+            f"contraction mismatch: a has K/n={k_loc}, b has "
+            f"{int(b.shape[1])}")
+    dtype = np.result_type(a.dtype, b.dtype)
+    if a.dtype != dtype or b.dtype != dtype:
+        a = a.astype(dtype)
+        b = b.astype(dtype)
+    if n == 1:
+        return (a[0] @ b[0])[None]
+    return _jit_matmul_reduce_scatter(mesh, axis, m, k_loc, n_out,
+                                      str(dtype), interpret)(a, b)
 
 
 @functools.lru_cache(maxsize=256)
@@ -131,8 +197,9 @@ def _jit_matmul_allreduce(mesh, axis: str, m: int, k_loc: int,
     n = mesh.shape[axis]
     m_blk = -(-m // n)
     m_pad = m_blk * n
-    inner = _build_matmul_allreduce(n, axis, m_blk, k_loc, n_out,
-                                    dtype_str, interpret)
+    inner = _build_fused_matmul(n, axis, m_blk, k_loc, n_out,
+                                dtype_str, interpret, align=0,
+                                with_ag=True, cid=10)
 
     def body(a, b):   # a: (1, m, k_loc), b: (1, k_loc, n_out)
         a2 = a[0]

@@ -234,6 +234,29 @@ def test_kernel_fused_matmul_allreduce(mesh, m):
     np.testing.assert_allclose(y, want, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.parametrize("m", [32, 30])
+def test_kernel_fused_matmul_reduce_scatter(mesh, m):
+    """Row-parallel TP form: device i keeps row-block i of the reduced
+    product (owner-aligned ring, no all-gather phase).  m=30 exercises
+    the pad branch (callers slice the tail block)."""
+    import jax
+
+    from ompi_tpu.ops import pallas_overlap as po
+
+    rng = np.random.default_rng(19)
+    n, K, N = 8, 64, 16
+    m_blk = -(-m // n)
+    a = rng.standard_normal((n, m, K // n)).astype(np.float32)
+    b = rng.standard_normal((n, K // n, N)).astype(np.float32)
+    y = np.asarray(po.matmul_reduce_scatter(
+        jax.device_put(a), jax.device_put(b), mesh, "x"))
+    full = sum(a[i] @ b[i] for i in range(n))
+    padded = np.zeros((n * m_blk, N), np.float32)
+    padded[:m] = full
+    np.testing.assert_allclose(y, padded.reshape(n, m_blk, N),
+                               rtol=1e-3, atol=1e-3)
+
+
 def test_kernel_fused_matmul_contraction_mismatch(mesh):
     import jax
 
