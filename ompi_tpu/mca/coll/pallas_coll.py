@@ -46,7 +46,8 @@ _INTERPRET_MAX_BYTES = 16 << 20
 class PallasCollModule:
     def __init__(self, comm, devices, axis_name: str, interpret: bool,
                  max_bytes: int, vmem_max_bytes: int,
-                 seg_bytes: int, bidirectional: bool) -> None:
+                 seg_bytes: int, bidirectional: bool,
+                 min_bytes: int = 0) -> None:
         import jax
         from jax.sharding import Mesh
 
@@ -56,6 +57,7 @@ class PallasCollModule:
         self.n = len(self.devices)
         self.interpret = interpret
         self.max_bytes = max_bytes
+        self.min_bytes = min_bytes
         self.vmem_max_bytes = vmem_max_bytes
         self.seg_bytes = seg_bytes
         self.bidirectional = bidirectional
@@ -96,7 +98,8 @@ class PallasCollModule:
         cap = self.max_bytes
         if self.interpret:
             cap = min(cap, _INTERPRET_MAX_BYTES)
-        return x.nbytes // max(1, self.n) <= cap
+        per_rank = x.nbytes // max(1, self.n)
+        return self.min_bytes <= per_rank <= cap
 
     def _supported(self, x) -> bool:
         return x.dtype.kind == "f" and self._size_ok(x)
@@ -204,6 +207,13 @@ class PallasCollComponent(Component):
             "interpret", vtype=VarType.STRING, default="auto",
             help="Run kernels in Pallas interpreter mode: auto = only off "
                  "real TPU devices, 0/1 to force")
+        self._min = self.register_var(
+            "min_bytes", vtype=VarType.SIZE, default="0",
+            help="Smallest per-rank payload routed to the DMA ring; "
+                 "smaller calls fall through to coll/xla (latency-bound "
+                 "small collectives are usually better "
+                 "compiler-scheduled — derive the crossover from "
+                 "LADDER_PROBE.json on real hardware)")
         self._max = self.register_var(
             "max_bytes", vtype=VarType.SIZE, default="1g",
             help="Largest per-rank payload routed to the DMA ring; "
@@ -252,7 +262,8 @@ class PallasCollComponent(Component):
             self._interpret_mode(devices), int(self._max.value),
             vmem_max_bytes=int(self._vmem_max.value),
             seg_bytes=int(self._seg.value),
-            bidirectional=bool(self._bidi.value))
+            bidirectional=bool(self._bidi.value),
+            min_bytes=int(self._min.value))
 
 
 COMPONENT = PallasCollComponent()

@@ -34,6 +34,25 @@ import numpy as np
 from ompi_tpu.ops.pallas_collectives import _ag_phase, _mods, _ring_kernels
 
 
+def _prep_operands(a, b, mesh, axis):
+    """Shared wrapper preamble: validate the contraction, promote mixed
+    dtypes OUTSIDE the kernel (mismatched refs vs VMEM scratch fail
+    tracing), and extract the static shapes.  Returns
+    (a, b, n, m, k_loc, n_out, dtype)."""
+    n = mesh.shape[axis]
+    m, k_loc = int(a.shape[1]), int(a.shape[2])
+    n_out = int(b.shape[2])
+    if int(b.shape[1]) != k_loc:
+        raise ValueError(
+            f"contraction mismatch: a has K/n={k_loc}, b has "
+            f"{int(b.shape[1])}")
+    dtype = np.result_type(a.dtype, b.dtype)
+    if a.dtype != dtype or b.dtype != dtype:
+        a = a.astype(dtype)
+        b = b.astype(dtype)
+    return a, b, n, m, k_loc, n_out, dtype
+
+
 @functools.lru_cache(maxsize=64)
 def _build_fused_matmul(n: int, axis: str, m_blk: int, k_loc: int,
                         n_out: int, dtype_str: str, interpret: bool,
@@ -170,17 +189,7 @@ def matmul_reduce_scatter(a, b, mesh, axis: str,
     axis) — the reduce-scatter half of :func:`matmul_allreduce`, the
     Megatron-style TP output projection.  M is padded to a multiple of
     n; callers slice the tail block if M % n != 0."""
-    n = mesh.shape[axis]
-    m, k_loc = int(a.shape[1]), int(a.shape[2])
-    n_out = int(b.shape[2])
-    if int(b.shape[1]) != k_loc:
-        raise ValueError(
-            f"contraction mismatch: a has K/n={k_loc}, b has "
-            f"{int(b.shape[1])}")
-    dtype = np.result_type(a.dtype, b.dtype)
-    if a.dtype != dtype or b.dtype != dtype:
-        a = a.astype(dtype)
-        b = b.astype(dtype)
+    a, b, n, m, k_loc, n_out, dtype = _prep_operands(a, b, mesh, axis)
     if n == 1:
         return (a[0] @ b[0])[None]
     return _jit_matmul_reduce_scatter(mesh, axis, m, k_loc, n_out,
@@ -221,20 +230,8 @@ def matmul_allreduce(a, b, mesh, axis: str, interpret: bool = True):
     replicated (M, N) product Σ_i A_i @ B_i, computed by the fused
     just-in-time-block ring (compute overlaps each step's DMA).
     """
-    n = mesh.shape[axis]
-    m, k_loc = int(a.shape[1]), int(a.shape[2])
-    n_out = int(b.shape[2])
-    if int(b.shape[1]) != k_loc:
-        raise ValueError(
-            f"contraction mismatch: a has K/n={k_loc}, b has "
-            f"{int(b.shape[1])}")
+    a, b, n, m, k_loc, n_out, dtype = _prep_operands(a, b, mesh, axis)
     if n == 1:
         return a[0] @ b[0]
-    dtype = np.result_type(a.dtype, b.dtype)
-    if a.dtype != dtype or b.dtype != dtype:
-        # promote OUTSIDE the kernel: mixed-dtype refs would mismatch
-        # the VMEM scratch and fail tracing
-        a = a.astype(dtype)
-        b = b.astype(dtype)
     return _jit_matmul_allreduce(mesh, axis, m, k_loc, n_out,
                                  str(dtype), interpret)(a, b)

@@ -353,6 +353,35 @@ def test_component_bcast_and_large_route(pallas_world):
         mod.vmem_max_bytes, mod.seg_bytes = old_vmem, old_seg
 
 
+def test_component_min_bytes_crossover(pallas_world):
+    """Below min_bytes the call falls through to coll/xla (the ladder
+    crossover knob for latency-bound small payloads).  Delegation is
+    spied directly — both paths are numerically identical, so allclose
+    alone cannot detect a broken gate."""
+    w = pallas_world
+    mod = w.c_coll["allreduce_array"].__self__
+    old = mod.min_bytes
+    delegated = []
+    orig = mod._delegate
+    mod._delegate = lambda *a, **k: (delegated.append(a[0]),
+                                     orig(*a, **k))[1]
+    try:
+        mod.min_bytes = 1 << 20
+        host = np.random.default_rng(20).standard_normal(
+            (8, 16)).astype(np.float32)    # 64B/rank << 1MB -> delegate
+        out = np.asarray(w.allreduce_array(host))
+        np.testing.assert_allclose(out, host.sum(0), rtol=1e-5,
+                                   atol=1e-6)
+        assert delegated == ["allreduce_array"], delegated
+        mod.min_bytes = 0
+        delegated.clear()
+        np.asarray(w.allreduce_array(host))
+        assert delegated == [], delegated    # gate open: pallas serves
+    finally:
+        mod.min_bytes = old
+        mod._delegate = orig
+
+
 def test_component_bidirectional_route(pallas_world):
     w = pallas_world
     mod = w.c_coll["allreduce_array"].__self__
