@@ -109,6 +109,9 @@ class _StagingPool:
     def release(self, buf: np.ndarray) -> None:
         if not self.enabled or buf.base is not None:
             return   # never pool views: the base owns the memory
+        if buf.nbytes > self.max_bytes:
+            return   # could never be retained — and pushing it through
+                     # the LRU would flush every warm buffer first
         key = self._key(buf.shape, buf.dtype)
         with self._lock:
             self._free.setdefault(key, []).append(buf)

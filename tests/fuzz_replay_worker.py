@@ -1,0 +1,121 @@
+"""Randomized traffic worker for the vprotocol replay fuzz.
+
+Both ranks derive the SAME op plan from ``VPF_SEED`` (a piecewise-
+deterministic exchange program: per round, single- or dual-comm sends
+with seed-chosen comms/tags, each side consuming channels in plan-chosen
+order) plus a kill spec for rank 1 (after its sends, or between its two
+recvs of a dual round — the in-flight-message windows).  The pytest
+side replays the crashed job from the pessimist logs and checks the
+final states against :func:`simulate`.
+"""
+import os
+import random
+
+import numpy as np
+
+VEC = 4
+
+
+def build_plan(seed: int, rounds: int):
+    """(ops, kill_round, kill_pos) — identical on every rank."""
+    rng = random.Random(seed)
+    ops = []
+    for _ in range(rounds):
+        ops.append(dict(
+            comm=rng.choice(["w", "d"]),
+            tag=rng.choice([5, 9]),
+            dual=rng.random() < 0.5,     # one message per comm, both comms
+            swap=rng.random() < 0.5,     # receiver consumes comms swapped
+        ))
+    kill_round = rng.randrange(1, rounds - 1)
+    kill_pos = rng.choice(["after_send", "mid_recv"])
+    if kill_pos == "mid_recv":
+        ops[kill_round]["dual"] = True   # the window needs two recvs
+    return ops, kill_round, kill_pos
+
+
+def payloads(state, rd):
+    """The two wire payloads a rank emits in round rd (B unused when
+    the round is single-comm)."""
+    return 0.5 * state + float(rd), 0.25 * state - float(rd)
+
+
+def fold(state, p_a, p_b, rd):
+    """Receiver's asymmetric state update (a swapped A/B corrupts it)."""
+    return 0.45 * state + 0.3 * p_a - 0.15 * p_b + float(rd)
+
+
+def simulate(seed: int, rounds: int, niter: int):
+    """Failure-free reference recurrence for ``niter`` rounds."""
+    ops, _, _ = build_plan(seed, rounds)
+    states = [np.full(VEC, 1.0), np.full(VEC, 2.0)]
+    for rd in range(niter):
+        spec = ops[rd]
+        prev = [s.copy() for s in states]
+        for r in (0, 1):
+            p_a, p_b = payloads(prev[1 - r], rd)
+            if not spec["dual"]:
+                p_b = np.zeros(VEC)
+            states[r] = fold(prev[r], p_a, p_b, rd)
+    return states
+
+
+def main():
+    import ompi_tpu
+
+    seed = int(os.environ["VPF_SEED"])
+    rounds = int(os.environ["VPF_ROUNDS"])
+    niter = int(os.environ["VPF_NITER"])
+    die = os.environ.get("VPF_DIE", "") == "1"
+    ops, kill_round, kill_pos = build_plan(seed, rounds)
+
+    w = ompi_tpu.init()
+    d = w.dup()
+    comms = {"w": w, "d": d}
+    r = w.rank
+    peer = 1 - r
+    state = np.full(VEC, float(r + 1))
+    for rd in range(niter):
+        spec = ops[rd]
+        p_a, p_b = payloads(state, rd)
+        if spec["dual"]:
+            # emission order differs per rank; the receiver's plan-chosen
+            # consumption order can invert it -> cross-channel interleave
+            first, second = (("w", p_a), ("d", p_b)) if r == 0 \
+                else (("d", p_b), ("w", p_a))
+            q1 = comms[first[0]].isend(first[1], dest=peer, tag=spec["tag"])
+            q2 = comms[second[0]].isend(second[1], dest=peer,
+                                        tag=spec["tag"])
+            if (die and r == 1 and rd == kill_round
+                    and kill_pos == "after_send"):
+                os._exit(9)         # both of peer's messages in flight
+            order = ["w", "d"] if not spec["swap"] else ["d", "w"]
+            bufs = {}
+            got_one = False
+            for c in order:
+                bufs[c] = np.empty(VEC)
+                comms[c].recv(bufs[c], source=peer, tag=spec["tag"])
+                if (die and r == 1 and rd == kill_round
+                        and kill_pos == "mid_recv" and not got_one):
+                    os._exit(9)     # second channel's message in flight
+                got_one = True
+            q1.wait()
+            q2.wait()
+            state = fold(state, bufs["w"], bufs["d"], rd)
+        else:
+            c = comms[spec["comm"]]
+            q = c.isend(p_a, dest=peer, tag=spec["tag"])
+            if (die and r == 1 and rd == kill_round
+                    and kill_pos == "after_send"):
+                os._exit(9)         # peer's message for me in flight
+            inb = np.empty(VEC)
+            c.recv(inb, source=peer, tag=spec["tag"])
+            q.wait()
+            state = fold(state, inb, np.zeros(VEC), rd)
+    np.save(os.environ["VPF_OUT"] + f".{r}.npy", state)
+    print(f"VPF DONE {r}", flush=True)
+    ompi_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()
