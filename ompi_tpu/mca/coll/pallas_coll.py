@@ -113,7 +113,8 @@ class PallasCollModule:
         per_rank = x.nbytes // max(1, self.n)
         if per_rank > self.vmem_max_bytes:
             seg_elems = max(1, self.seg_bytes // x.dtype.itemsize)
-            return "seg", seg_elems
+            return (("seg_bidi" if self.bidirectional else "seg"),
+                    seg_elems)
         if self.bidirectional:
             return "bidi", None
         return "fused", None
@@ -148,8 +149,10 @@ class PallasCollModule:
         from ompi_tpu.ops import pallas_collectives as pc
 
         variant, seg_elems = self._route(x)
-        if variant == "bidi":   # no bidi reduce-scatter kernel (yet)
+        if variant == "bidi":       # no bidi reduce-scatter kernel (yet)
             variant, seg_elems = "fused", None
+        elif variant == "seg_bidi":  # ...so large payloads keep the
+            variant = "seg"          # segmented HBM bound unidirectional
         return pc.reduce_scatter(x, self.mesh, self.axis, ring_op,
                                  interpret=self.interpret, variant=variant,
                                  seg_elems=seg_elems)

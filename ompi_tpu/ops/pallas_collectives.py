@@ -360,17 +360,75 @@ def _ag_phase(lax, pl, pltpu, *, n, my, right, out_ref, send_sem,
     lax.fori_loop(0, n - 1, ag_step, 0)
 
 
+def _seg_fold_row(lax, pl, pltpu, *, acc_ref, recv_ref, k, recv_idx,
+                  col_off: int, nseg: int, seg: int, va, vb, load_sems,
+                  wb_sems, fold):
+    """Fold one received HBM row into one accumulator row through the
+    2-slot double-buffered VMEM window: while segment s reduces,
+    segment s+1's loads are already in flight, and writebacks drain one
+    segment behind.  Fully drained on return, so the window is
+    immediately reusable (the bidi kernel folds both directions through
+    one window).  ``col_off`` addresses a column sub-range of the
+    accumulator row (the bidi kernel's per-direction halves)."""
+
+    def start_load(s):
+        slot = lax.rem(s, 2)
+        sl = pl.ds(col_off + s * seg, seg)
+        rl = pl.ds(s * seg, seg)
+        pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
+                              load_sems.at[slot, 0]).start()
+        pltpu.make_async_copy(recv_ref.at[k, rl], vb.at[slot],
+                              load_sems.at[slot, 1]).start()
+
+    def wait_wb(slot, s_of_wb):
+        # descriptor only carries the byte count to decrement
+        pltpu.make_async_copy(
+            va.at[slot],
+            acc_ref.at[recv_idx, pl.ds(col_off + s_of_wb * seg, seg)],
+            wb_sems.at[slot]).wait()
+
+    start_load(0)
+
+    def seg_step(s, c):
+        slot = lax.rem(s, 2)
+
+        @pl.when(s + 1 < nseg)
+        def _prefetch():
+            @pl.when(s >= 1)
+            def _drain_prev_wb():
+                # slot 1-slot's writeback (segment s-1) must land
+                # before its VMEM buffer is reloaded
+                wait_wb(1 - slot, s - 1)
+            start_load(s + 1)
+
+        sl = pl.ds(col_off + s * seg, seg)
+        rl = pl.ds(s * seg, seg)
+        pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
+                              load_sems.at[slot, 0]).wait()
+        pltpu.make_async_copy(recv_ref.at[k, rl], vb.at[slot],
+                              load_sems.at[slot, 1]).wait()
+        cur = va[pl.ds(slot, 1), :]
+        part = vb[pl.ds(slot, 1), :]
+        va[pl.ds(slot, 1), :] = fold(cur, part)
+        pltpu.make_async_copy(va.at[slot], acc_ref.at[recv_idx, sl],
+                              wb_sems.at[slot]).start()
+        return c
+
+    lax.fori_loop(0, nseg, seg_step, 0)
+    # drain outstanding writebacks before this row is sent next step
+    wait_wb(lax.rem(nseg - 1, 2), nseg - 1)
+    if nseg >= 2:
+        wait_wb(lax.rem(nseg - 2, 2), nseg - 2)
+
+
 def _seg_rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
                   send_sem, rs_sems, align: int, fold, nseg: int, seg: int,
                   va, vb, load_sems, wb_sems):
-    """Segmented twin of ``_rs_phase``: acc/recv live in HBM; only a
-    2-slot double-buffered VMEM window (``va``/``vb``, each (2, seg))
-    streams through on-chip memory for the fold.  While segment s
-    reduces, segment s+1's loads are already in flight, and writebacks
-    drain one segment behind — the bounded-buffer pipeline of the
-    reference's segmented ring (``coll_base_allreduce.c:618``), which
-    exists precisely so payload size is bounded by main memory, not the
-    staging buffer."""
+    """Segmented twin of ``_rs_phase``: acc/recv live in HBM; the fold
+    streams through the bounded VMEM window (``_seg_fold_row``) — the
+    bounded-buffer pipeline of the reference's segmented ring
+    (``coll_base_allreduce.c:618``), which exists precisely so payload
+    size is bounded by main memory, not the staging buffer."""
 
     def rs_step(k, carry):
         send_idx = lax.rem(my + align - k + 2 * n, n)
@@ -382,52 +440,10 @@ def _seg_rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
             device_id_type=pltpu.DeviceIdType.LOGICAL)
         rdma.start()
         rdma.wait()   # my partial for block recv_idx arrived (HBM)
-
-        def start_load(s):
-            slot = lax.rem(s, 2)
-            sl = pl.ds(s * seg, seg)
-            pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
-                                  load_sems.at[slot, 0]).start()
-            pltpu.make_async_copy(recv_ref.at[k, sl], vb.at[slot],
-                                  load_sems.at[slot, 1]).start()
-
-        def wait_wb(slot, s_of_wb):
-            # descriptor only carries the byte count to decrement
-            pltpu.make_async_copy(
-                va.at[slot], acc_ref.at[recv_idx, pl.ds(s_of_wb * seg, seg)],
-                wb_sems.at[slot]).wait()
-
-        start_load(0)
-
-        def seg_step(s, c):
-            slot = lax.rem(s, 2)
-
-            @pl.when(s + 1 < nseg)
-            def _prefetch():
-                @pl.when(s >= 1)
-                def _drain_prev_wb():
-                    # slot 1-slot's writeback (segment s-1) must land
-                    # before its VMEM buffer is reloaded
-                    wait_wb(1 - slot, s - 1)
-                start_load(s + 1)
-
-            sl = pl.ds(s * seg, seg)
-            pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
-                                  load_sems.at[slot, 0]).wait()
-            pltpu.make_async_copy(recv_ref.at[k, sl], vb.at[slot],
-                                  load_sems.at[slot, 1]).wait()
-            cur = va[pl.ds(slot, 1), :]
-            part = vb[pl.ds(slot, 1), :]
-            va[pl.ds(slot, 1), :] = fold(cur, part)
-            pltpu.make_async_copy(va.at[slot], acc_ref.at[recv_idx, sl],
-                                  wb_sems.at[slot]).start()
-            return c
-
-        lax.fori_loop(0, nseg, seg_step, 0)
-        # drain outstanding writebacks before this row is sent next step
-        wait_wb(lax.rem(nseg - 1, 2), nseg - 1)
-        if nseg >= 2:
-            wait_wb(lax.rem(nseg - 2, 2), nseg - 2)
+        _seg_fold_row(lax, pl, pltpu, acc_ref=acc_ref, recv_ref=recv_ref,
+                      k=k, recv_idx=recv_idx, col_off=0, nseg=nseg,
+                      seg=seg, va=va, vb=vb, load_sems=load_sems,
+                      wb_sems=wb_sems, fold=fold)
         return carry
 
     lax.fori_loop(0, n - 1, rs_step, 0)
@@ -549,6 +565,155 @@ def _build_reduce_scatter_seg(n: int, axis: str, blk: int, seg: int,
     return call
 
 
+def _bidi_done_and_ag(lax, pl, pltpu, *, n, my, right, left, half,
+                      acc_ref, out_ref, local_sem, send_cw_sem,
+                      send_ccw_sem, ag_cw_sems, ag_ccw_sems):
+    """Shared tail of the bidirectional all-reduce kernels: copy each
+    direction's completed half-block out, then run the mirrored
+    all-gather rings (both duplex directions busy every step)."""
+    h = half
+    done_cw = lax.rem(my + 1, n)
+    done_ccw = lax.rem(my - 1 + n, n)
+    c1 = pltpu.make_async_copy(acc_ref.at[done_cw, pl.ds(0, h)],
+                               out_ref.at[done_cw, pl.ds(0, h)],
+                               local_sem)
+    c1.start()
+    c1.wait()
+    c2 = pltpu.make_async_copy(acc_ref.at[done_ccw, pl.ds(h, h)],
+                               out_ref.at[done_ccw, pl.ds(h, h)],
+                               local_sem)
+    c2.start()
+    c2.wait()
+
+    def ag_step(k, carry):
+        f_cw = lax.rem(my + 1 - k + n, n)
+        f_ccw = lax.rem(my - 1 + k + n, n)
+        d_cw = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[f_cw, pl.ds(0, h)],
+            dst_ref=out_ref.at[f_cw, pl.ds(0, h)],
+            send_sem=send_cw_sem, recv_sem=ag_cw_sems.at[k],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        d_ccw = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[f_ccw, pl.ds(h, h)],
+            dst_ref=out_ref.at[f_ccw, pl.ds(h, h)],
+            send_sem=send_ccw_sem, recv_sem=ag_ccw_sems.at[k],
+            device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        d_cw.start()
+        d_ccw.start()
+        d_cw.wait()
+        d_ccw.wait()
+        return carry
+
+    lax.fori_loop(0, n - 1, ag_step, 0)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_all_reduce_seg_bidi(n: int, axis: str, half: int, seg: int,
+                               dtype_str: str, interpret: bool,
+                               op: str = "sum"):
+    """Segmented AND bidirectional ring all-reduce — the large-payload
+    champion: the (n, 2*half) payload is HBM-resident, columns [:half]
+    ride the clockwise ring and [half:] the counter-clockwise ring
+    concurrently (both duplex ICI directions carry a half-payload every
+    step), and each direction's fold streams through ONE shared
+    double-buffered VMEM window (``_seg_fold_row`` drains fully between
+    directions, so the window is reused — folds are VPU-sequential
+    anyway; it is the DMAs that overlap).
+    """
+    assert half % seg == 0, (half, seg)
+    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    fold = _op_fn(jnp, op)
+    nseg = half // seg
+    blk = 2 * half
+
+    def kernel(x_ref, out_ref, acc_ref, recv_cw, recv_ccw, va, vb,
+               local_sem, send_cw_sem, send_ccw_sem, load_sems, wb_sems,
+               rs_cw_sems, rs_ccw_sems, ag_cw_sems, ag_ccw_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        left = lax.rem(my - 1 + n, n)
+        cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
+        cp.start()
+        cp.wait()
+
+        h = half
+
+        def rs_step(k, carry):
+            s_cw = lax.rem(my - k + 2 * n, n)
+            r_cw = lax.rem(my - 1 - k + 2 * n, n)
+            s_ccw = lax.rem(my + k, n)
+            r_ccw = lax.rem(my + 1 + k, n)
+            d_cw = pltpu.make_async_remote_copy(
+                src_ref=acc_ref.at[s_cw, pl.ds(0, h)],
+                dst_ref=recv_cw.at[k],
+                send_sem=send_cw_sem, recv_sem=rs_cw_sems.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d_ccw = pltpu.make_async_remote_copy(
+                src_ref=acc_ref.at[s_ccw, pl.ds(h, h)],
+                dst_ref=recv_ccw.at[k],
+                send_sem=send_ccw_sem, recv_sem=rs_ccw_sems.at[k],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            d_cw.start()
+            d_ccw.start()          # both directions' DMAs in flight
+            d_cw.wait()
+            _seg_fold_row(lax, pl, pltpu, acc_ref=acc_ref,
+                          recv_ref=recv_cw, k=k, recv_idx=r_cw,
+                          col_off=0, nseg=nseg, seg=seg, va=va, vb=vb,
+                          load_sems=load_sems, wb_sems=wb_sems,
+                          fold=fold)
+            d_ccw.wait()
+            _seg_fold_row(lax, pl, pltpu, acc_ref=acc_ref,
+                          recv_ref=recv_ccw, k=k, recv_idx=r_ccw,
+                          col_off=h, nseg=nseg, seg=seg, va=va, vb=vb,
+                          load_sems=load_sems, wb_sems=wb_sems,
+                          fold=fold)
+            return carry
+
+        lax.fori_loop(0, n - 1, rs_step, 0)
+        _bidi_done_and_ag(lax, pl, pltpu, n=n, my=my, right=right,
+                          left=left, half=half, acc_ref=acc_ref,
+                          out_ref=out_ref, local_sem=local_sem,
+                          send_cw_sem=send_cw_sem,
+                          send_ccw_sem=send_ccw_sem,
+                          ag_cw_sems=ag_cw_sems, ag_ccw_sems=ag_ccw_sems)
+
+    def call(x):  # x: (n, 2*half) per device
+        kw = {}
+        cp = cparams(12)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.HBM((n, blk), jnp.dtype(dtype_str)),
+                            pltpu.HBM((n - 1, half),
+                                      jnp.dtype(dtype_str)),
+                            pltpu.HBM((n - 1, half),
+                                      jnp.dtype(dtype_str)),
+                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((2, 2)),
+                            pltpu.SemaphoreType.DMA((2,)),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,)),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
 @functools.lru_cache(maxsize=64)
 def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
                            interpret: bool, op: str = "sum"):
@@ -608,41 +773,12 @@ def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
             return carry
 
         lax.fori_loop(0, n - 1, rs_step, 0)
-        done_cw = lax.rem(my + 1, n)
-        done_ccw = lax.rem(my - 1 + n, n)
-        c1 = pltpu.make_async_copy(acc_ref.at[done_cw, pl.ds(0, h)],
-                                   out_ref.at[done_cw, pl.ds(0, h)],
-                                   local_sem)
-        c1.start()
-        c1.wait()
-        c2 = pltpu.make_async_copy(acc_ref.at[done_ccw, pl.ds(h, h)],
-                                   out_ref.at[done_ccw, pl.ds(h, h)],
-                                   local_sem)
-        c2.start()
-        c2.wait()
-
-        def ag_step(k, carry):
-            f_cw = lax.rem(my + 1 - k + n, n)
-            f_ccw = lax.rem(my - 1 + k + n, n)
-            d_cw = pltpu.make_async_remote_copy(
-                src_ref=out_ref.at[f_cw, pl.ds(0, h)],
-                dst_ref=out_ref.at[f_cw, pl.ds(0, h)],
-                send_sem=send_cw_sem, recv_sem=ag_cw_sems.at[k],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            d_ccw = pltpu.make_async_remote_copy(
-                src_ref=out_ref.at[f_ccw, pl.ds(h, h)],
-                dst_ref=out_ref.at[f_ccw, pl.ds(h, h)],
-                send_sem=send_ccw_sem, recv_sem=ag_ccw_sems.at[k],
-                device_id=left,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            d_cw.start()
-            d_ccw.start()
-            d_cw.wait()
-            d_ccw.wait()
-            return carry
-
-        lax.fori_loop(0, n - 1, ag_step, 0)
+        _bidi_done_and_ag(lax, pl, pltpu, n=n, my=my, right=right,
+                          left=left, half=half, acc_ref=acc_ref,
+                          out_ref=out_ref, local_sem=local_sem,
+                          send_cw_sem=send_cw_sem,
+                          send_ccw_sem=send_ccw_sem,
+                          ag_cw_sems=ag_cw_sems, ag_ccw_sems=ag_ccw_sems)
 
     def call(x):  # x: (n, 2*half) per device
         kw = {}
@@ -950,6 +1086,12 @@ def _jit_all_reduce(mesh, axis: str, payload_shape, dtype_str: str,
         seg, blk = _seg_shape(blk, seg_elems)
         inner = _build_all_reduce_seg(n, axis, blk, seg, dtype_str,
                                       interpret, op)
+    elif variant == "seg_bidi":
+        half = -(-blk // 2)
+        seg, half = _seg_shape(half, seg_elems)
+        blk = 2 * half
+        inner = _build_all_reduce_seg_bidi(n, axis, half, seg,
+                                           dtype_str, interpret, op)
     elif variant == "bidi":
         blk = blk + (blk % 2)          # even split across directions
         inner = _build_all_reduce_bidi(n, axis, blk // 2, dtype_str,
@@ -980,11 +1122,14 @@ def all_reduce(x, mesh, axis: str, op: str = "sum",
     ring blocks outside the kernel (XLA fuses the pad/reshape into the
     surrounding program).  Variants:
 
-    * ``'fused'`` — whole accumulator in VMEM (lowest latency, small).
-    * ``'seg'``   — HBM accumulator + bounded VMEM window of
+    * ``'fused'``    — whole accumulator in VMEM (lowest latency, small).
+    * ``'seg'``      — HBM accumulator + bounded VMEM window of
       ``seg_elems`` (large payloads; `coll_base_allreduce.c:618` twin).
-    * ``'bidi'``  — both ICI directions carry half the payload each
-      step (duplex links; halves per-step wire time).
+    * ``'bidi'``     — both ICI directions carry half the payload each
+      step (duplex links; halves per-step wire time).  VMEM-bounded.
+    * ``'seg_bidi'`` — both at once: HBM-resident halves ride both
+      directions concurrently, folds stream through the shared window
+      (the large-payload duplex champion).
     """
     payload_shape = tuple(x.shape[1:])
     if mesh.shape[axis] == 1:

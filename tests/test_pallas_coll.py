@@ -169,6 +169,43 @@ def test_kernel_all_reduce_segmented(mesh, op, ref):
     np.testing.assert_allclose(y, ref(x, axis=0), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("op,ref", [("sum", np.sum), ("max", np.max)])
+def test_kernel_all_reduce_seg_bidi(mesh, op, ref):
+    """Segmented AND bidirectional: HBM-resident halves ride both ring
+    directions concurrently, folds stream through one shared VMEM
+    window.  Odd payload exercises both the half-split and segment
+    pads."""
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = np.random.default_rng(21).standard_normal(
+        (8, 999)).astype(np.float32)
+    y = np.asarray(pc.all_reduce(jax.device_put(x), mesh, "x", op,
+                                 variant="seg_bidi", seg_elems=32))
+    np.testing.assert_allclose(y, ref(x, axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_component_seg_bidi_route(pallas_world):
+    """bidirectional + above the VMEM crossover routes to seg_bidi."""
+    w = pallas_world
+    mod = w.c_coll["allreduce_array"].__self__
+    old_vmem, old_seg, old_bidi = (mod.vmem_max_bytes, mod.seg_bytes,
+                                   mod.bidirectional)
+    try:
+        mod.vmem_max_bytes, mod.seg_bytes = 64, 128
+        mod.bidirectional = True
+        host = np.random.default_rng(22).standard_normal(
+            (8, 300)).astype(np.float32)
+        assert mod._route(np.asarray(host))[0] == "seg_bidi"
+        out = np.asarray(w.allreduce_array(host))
+        np.testing.assert_allclose(out, host.sum(0), rtol=1e-4,
+                                   atol=1e-5)
+    finally:
+        mod.vmem_max_bytes, mod.seg_bytes = old_vmem, old_seg
+        mod.bidirectional = old_bidi
+
+
 def test_kernel_all_reduce_bidi(mesh):
     import jax
 
