@@ -746,6 +746,9 @@ def _pallas_first_run(devs, mesh, interp: bool) -> dict:
     chk("allreduce_bidi",
         pc.all_reduce(put(x), mesh, "x", "sum", interpret=interp,
                       variant="bidi"), x.sum(0))
+    chk("allreduce_seg_bidi",
+        pc.all_reduce(put(x), mesh, "x", "sum", interpret=interp,
+                      variant="seg_bidi", seg_elems=32), x.sum(0))
     chk("allreduce_max",
         pc.all_reduce(put(x), mesh, "x", "max", interpret=interp),
         x.max(0), tol=1e-6)
