@@ -170,6 +170,59 @@ class PallasCollModule:
         return pc.all_to_all(x, self.mesh, self.axis,
                              interpret=self.interpret)
 
+    def persistent_coll(self, comm, coll: str, template, *args):
+        """MPI_*_init analog bound to the CACHED pallas jitted program:
+        when this component owns the slot, the persistent handle
+        dispatches the explicit-DMA ring, not the coll/xla program.
+        Shapes/ops the ring does not serve bind through the fallback
+        provider (same per-call delegation discipline as the one-shot
+        slots)."""
+        from ompi_tpu.mca.coll.xla import PersistentColl
+
+        template = self._place(comm, template)
+        op = args[0] if args else op_mod.SUM
+        ring_op = _RING_OPS.get(getattr(op, "name", "SUM"))
+        supported = (coll in ("allreduce", "reduce_scatter")
+                     and ring_op is not None
+                     and self._supported(template)) or \
+                    (coll == "bcast" and self._size_ok(template))
+        if not supported:
+            return self._delegate("persistent_coll", comm, coll,
+                                  template, *args)
+        # bind through the PUBLIC wrappers: they own the n==1 fast
+        # path, padding, and the lru-cached jitted program (so repeated
+        # start() is a cache hit, not a retrace)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        if coll == "allreduce":
+            variant, seg_elems = self._route(template)
+
+            def fn(x, v=variant, s=seg_elems):
+                return pc.all_reduce(x, self.mesh, self.axis, ring_op,
+                                     interpret=self.interpret,
+                                     variant=v, seg_elems=s)
+        elif coll == "reduce_scatter":
+            variant, seg_elems = self._route(template)
+            if variant == "bidi":       # same remaps as the one-shot slot
+                variant, seg_elems = "fused", None
+            elif variant == "seg_bidi":
+                variant = "seg"
+
+            def fn(x, v=variant, s=seg_elems):
+                return pc.reduce_scatter(x, self.mesh, self.axis,
+                                         ring_op,
+                                         interpret=self.interpret,
+                                         variant=v, seg_elems=s)
+        else:   # bcast: root baked into the handle, one shared program
+            root = int(args[0]) % self.n if args else 0
+            seg_elems = max(1, self.seg_bytes // template.dtype.itemsize)
+
+            def fn(x, r=root, s=seg_elems):
+                return pc.bcast(x, self.mesh, self.axis, root=r,
+                                interpret=self.interpret, seg_elems=s)
+        fn(template)    # build + cache + validate now, not at start()
+        return PersistentColl(fn, coll, int(template.nbytes))
+
     def bcast_array(self, comm, x, root: int = 0):
         x = self._place(comm, x)
         # pure DMA, no arithmetic: any dtype qualifies — only size gates

@@ -390,6 +390,33 @@ def test_component_bcast_and_large_route(pallas_world):
         mod.vmem_max_bytes, mod.seg_bytes = old_vmem, old_seg
 
 
+def test_component_persistent_binds_pallas(pallas_world):
+    """MPI_Allreduce_init analog: with coll/pallas raised, the
+    persistent handle dispatches the explicit-DMA ring program, and
+    unsupported shapes bind through the coll/xla fallback."""
+    from ompi_tpu.api import op
+
+    w = pallas_world
+    assert w.c_coll["persistent_coll"].__self__.__class__.__name__ \
+        == "PallasCollModule"
+    host = np.random.default_rng(23).standard_normal(
+        (8, 24)).astype(np.float32)
+    h = w.allreduce_array_init(host)
+    for _ in range(2):
+        out = np.asarray(h(host))
+        np.testing.assert_allclose(out, host.sum(0), rtol=1e-4,
+                                   atol=1e-5)
+    # bcast binds too (runtime-root program)
+    hb = w.c_coll["persistent_coll"](w, "bcast", host, 3)
+    b = np.asarray(hb(host))
+    np.testing.assert_allclose(b, np.broadcast_to(host[3], host.shape),
+                               rtol=1e-6)
+    # an int payload is not a ring shape: binds through coll/xla
+    ints = np.arange(8 * 4, dtype=np.int32).reshape(8, 4)
+    hi = w.c_coll["persistent_coll"](w, "allreduce", ints, op.SUM)
+    np.testing.assert_array_equal(np.asarray(hi(ints)), ints.sum(0))
+
+
 def test_component_min_bytes_crossover(pallas_world):
     """Below min_bytes the call falls through to coll/xla (the ladder
     crossover knob for latency-bound small payloads).  Delegation is
