@@ -811,6 +811,39 @@ def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
                          if pair["raw_lat_us"] < pair["fw_lat_us"]
                          else "xla"})
 
+    # bcast + alltoall crossovers: the other slots coll/pallas can own
+    for coll in ("bcast", "alltoall"):
+        nbytes = 262144
+        if coll == "bcast":
+            x = b.make(nbytes)
+
+            def pallas_coll_fn(t):
+                return pc.bcast(t, b.mesh, "x", root=0,
+                                interpret=interp)
+        else:
+            nelem = max(b.ndev, nbytes // 4 // b.ndev * b.ndev)
+            x = b.xla_mod.make_world_array(np.ones(
+                (b.world.size, b.ndev, nelem // b.ndev), np.float32))
+
+            def pallas_coll_fn(t):
+                return pc.all_to_all(t, b.mesh, "x", interpret=interp)
+
+        try:
+            pair = b._timed_pair(f"ladder_{coll}", b.fw_fn(coll)
+                                 if coll == "bcast"
+                                 else (lambda t: b.world
+                                       .alltoall_array(t)),
+                                 pallas_coll_fn, x, x, nbytes, iters=6)
+            rows.append({"coll": coll, "variant": "ring",
+                         "nbytes": nbytes,
+                         "xla_us": pair["fw_lat_us"],
+                         "pallas_us": pair["raw_lat_us"],
+                         "winner": "pallas"
+                         if pair["raw_lat_us"] < pair["fw_lat_us"]
+                         else "xla"})
+        except Exception as exc:
+            print(f"ladder {coll} failed: {exc}", file=sys.stderr)
+
     # fused collective matmul vs XLA's matmul-then-psum: the overlap row
     # the explicit transport exists for (ops/pallas_overlap.py)
     import jax
