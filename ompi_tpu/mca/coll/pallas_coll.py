@@ -170,6 +170,29 @@ class PallasCollModule:
         return pc.all_to_all(x, self.mesh, self.axis,
                              interpret=self.interpret)
 
+    def alltoallv_array(self, comm, x, counts):
+        """True ragged alltoallv: per-pair explicit chunked DMAs sized
+        by the runtime counts table (``ops.pallas_collectives.
+        all_to_all_v``) instead of coll/xla's padded all_to_all +
+        host-side slicing — wire bytes follow the raggedness, the MoE/
+        EP dispatch contract (``coll_base_alltoall.c`` pairwise)."""
+        x = self._place(comm, x)
+        if (not self._size_ok(x) or x.ndim != 4
+                or x.shape[0] != self.n or x.shape[1] != self.n
+                or x.shape[3] % 128 != 0):
+            return self._delegate("alltoallv_array", comm, x, counts)
+        import numpy as np
+
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        full = pc.all_to_all_v(x, np.asarray(counts, np.int32),
+                               self.mesh, self.axis,
+                               interpret=self.interpret)
+        # same return contract as coll/xla's alltoallv_array: sliced
+        # zero-copy views, out[i][j] = what rank i received from j
+        return [[full[i, j, :int(counts[j][i])] for j in range(self.n)]
+                for i in range(self.n)]
+
     def persistent_coll(self, comm, coll: str, template, *args):
         """MPI_*_init analog bound to the CACHED pallas jitted program:
         when this component owns the slot, the persistent handle

@@ -94,7 +94,25 @@ def _ring_kernels(n: int, axis: str, interpret: bool):
         return pltpu.CompilerParams(has_side_effects=True,
                                     collective_id=collective_id)
 
-    return jax, jnp, lax, pl, pltpu, compiler_params
+    def barrier(*peers):
+        """Kernel-entry barrier with every DMA peer: signal each peer's
+        barrier semaphore (allocated per collective_id), wait until all
+        of them have signalled ours.  On hardware no remote DMA may
+        depart before the receiver's kernel is live — its recv
+        semaphores and scratch only exist then (Mosaic refuses a
+        collective_id kernel without this).  The interpreter emulates
+        remote copies as per-op rendezvous, so it needs no barrier and
+        does not model one."""
+        if interpret:
+            return
+        bsem = pltpu.get_barrier_semaphore()
+        for p in peers:
+            pltpu.semaphore_signal(
+                bsem, 1, device_id=p,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(bsem, len(peers))
+
+    return jax, jnp, lax, pl, pltpu, compiler_params, barrier
 
 
 def _ring_fn(lax, axis: str, sub):
@@ -122,11 +140,12 @@ def _ring_fn(lax, axis: str, sub):
 @functools.lru_cache(maxsize=64)
 def _build_right_permute(n: int, axis: str, shape, dtype_str: str,
                          interpret: bool):
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
 
     def kernel(x_ref, out_ref, send_sem, recv_sem):
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
+        barrier(right, lax.rem(my - 1 + n, n))
         rdma = pltpu.make_async_remote_copy(
             src_ref=x_ref, dst_ref=out_ref,
             send_sem=send_sem, recv_sem=recv_sem,
@@ -160,11 +179,12 @@ def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
     """Ring all-gather: n-1 steps, each forwarding the freshest block to
     the right neighbor (``jax docs distributed`` canonical schedule; the
     reference's ``coll_base_allgather.c`` ring)."""
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
 
     def kernel(x_ref, out_ref, local_sem, send_sem, recv_sems):
         my, dev = _ring_fn(lax, axis, sub)
         right = dev(lax.rem(my + 1, n))
+        barrier(right, dev(lax.rem(my - 1 + n, n)))
         cp = pltpu.make_async_copy(x_ref, out_ref.at[my], local_sem)
         cp.start()
         cp.wait()
@@ -211,7 +231,13 @@ def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
     align=0 for the all-reduce schedule (owner my+1), align=-1 for
     owner-aligned reduce-scatter (owner my).  ONE copy of the DMA /
     semaphore / accumulate discipline, shared by both kernels.
-    ``fold`` is the elementwise reduction."""
+    ``fold`` is the elementwise reduction.
+
+    Refs are block-leading 3-D — acc (n, rows, 128), recv (n-1, rows,
+    128) — so every slice rides the UNTILED leading dim: Mosaic tiles
+    the trailing (rows, 128) pair and rejects row-slices of a tiled
+    dim ("slice must be aligned to tiling (8)"), which a flat (n, blk)
+    layout would need."""
 
     def rs_step(k, carry):
         send_idx = lax.rem(my + align - k + 2 * n, n)
@@ -223,9 +249,7 @@ def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
             device_id_type=pltpu.DeviceIdType.LOGICAL)
         rdma.start()
         rdma.wait()   # my partial for block recv_idx arrived
-        part = recv_ref[pl.ds(k, 1), :]
-        cur = acc_ref[pl.ds(recv_idx, 1), :]
-        acc_ref[pl.ds(recv_idx, 1), :] = fold(cur, part)
+        acc_ref[recv_idx] = fold(acc_ref[recv_idx], recv_ref[k])
         return carry
 
     lax.fori_loop(0, n - 1, rs_step, 0)
@@ -233,25 +257,28 @@ def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
+def _build_all_reduce(n: int, axis: str, rows: int, dtype_str: str,
                       interpret: bool, op: str = "sum", sub=None):
     """Ring all-reduce: n-1 reduce-scatter steps with the fold fused
     into the ring loop, then n-1 all-gather steps — one kernel, the
     explicit-DMA form of ``coll_base_allreduce.c:341``.
 
-    Per-device payload is pre-shaped to (n, blk).  Distinct recv slots
-    per step (scratch (n-1, blk)) make the schedule self-synchronizing:
-    no slot is ever reused, so the send/recv semaphore pair is the only
-    ordering needed (the capacity/backpressure dance of a 2-slot scheme
-    is deliberately traded for VMEM).
+    Per-device payload is pre-shaped to (n, rows, 128) — lane-major
+    block-leading layout so all slicing rides the untiled leading dim
+    (see ``_rs_phase``).  Distinct recv slots per step (scratch
+    (n-1, rows, 128)) make the schedule self-synchronizing: no slot is
+    ever reused, so the send/recv semaphore pair is the only ordering
+    needed (the capacity/backpressure dance of a 2-slot scheme is
+    deliberately traded for VMEM).
     """
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     fold = _op_fn(jnp, op)
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref,
                local_sem, send_sem, rs_sems, ag_sems):
         my, dev = _ring_fn(lax, axis, sub)
         right = dev(lax.rem(my + 1, n))
+        barrier(right, dev(lax.rem(my - 1 + n, n)))
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
@@ -268,18 +295,20 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
         _ag_phase(lax, pl, pltpu, n=n, my=my, right=right,
                   out_ref=out_ref, send_sem=send_sem, ag_sems=ag_sems)
 
-    def call(x):  # x: (n, blk) per device
+    def call(x):  # x: (n, rows, 128) per device
         kw = {}
         cp = cparams(3)
         if cp is not None:
             kw["compiler_params"] = cp
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            out_shape=jax.ShapeDtypeStruct((n, rows, 128), dtype_str),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.VMEM((n, blk), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((n - 1, blk), jnp.dtype(dtype_str)),
+            scratch_shapes=[pltpu.VMEM((n, rows, 128),
+                                       jnp.dtype(dtype_str)),
+                            pltpu.VMEM((n - 1, rows, 128),
+                                       jnp.dtype(dtype_str)),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA((n - 1,)),
@@ -292,19 +321,21 @@ def _build_all_reduce(n: int, axis: str, blk: int, dtype_str: str,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
+def _build_reduce_scatter(n: int, axis: str, rows: int, dtype_str: str,
                           interpret: bool, op: str = "sum",
                           sub=None):
     """Ring reduce-scatter: n-1 steps, fold fused into the ring;
     device i ends owning fully-reduced block i (the first half of
-    ``coll_base_allreduce.c:341``'s ring, block-owner aligned)."""
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    ``coll_base_allreduce.c:341``'s ring, block-owner aligned).
+    Blocks are (rows, 128) — see ``_rs_phase`` on the layout."""
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     fold = _op_fn(jnp, op)
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref,
                local_sem, send_sem, rs_sems):
         my, dev = _ring_fn(lax, axis, sub)
         right = dev(lax.rem(my + 1, n))
+        barrier(right, dev(lax.rem(my - 1 + n, n)))
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
@@ -318,18 +349,20 @@ def _build_reduce_scatter(n: int, axis: str, blk: int, dtype_str: str,
         cp2.start()
         cp2.wait()
 
-    def call(x):  # x: (n, blk) per device -> (blk,) per device
+    def call(x):  # x: (n, rows, 128) per device -> (rows, 128)
         kw = {}
         cp = cparams(4)
         if cp is not None:
             kw["compiler_params"] = cp
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((blk,), dtype_str),
+            out_shape=jax.ShapeDtypeStruct((rows, 128), dtype_str),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.VMEM((n, blk), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((n - 1, blk), jnp.dtype(dtype_str)),
+            scratch_shapes=[pltpu.VMEM((n, rows, 128),
+                                       jnp.dtype(dtype_str)),
+                            pltpu.VMEM((n - 1, rows, 128),
+                                       jnp.dtype(dtype_str)),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA((n - 1,))],
@@ -360,32 +393,30 @@ def _ag_phase(lax, pl, pltpu, *, n, my, right, out_ref, send_sem,
     lax.fori_loop(0, n - 1, ag_step, 0)
 
 
-def _seg_fold_row(lax, pl, pltpu, *, acc_ref, recv_ref, k, recv_idx,
-                  col_off: int, nseg: int, seg: int, va, vb, load_sems,
-                  wb_sems, fold):
+def _seg_fold_row(lax, pl, pltpu, *, acc_row, recv_row, nseg: int, va,
+                  vb, load_sems, wb_sems, fold):
     """Fold one received HBM row into one accumulator row through the
     2-slot double-buffered VMEM window: while segment s reduces,
     segment s+1's loads are already in flight, and writebacks drain one
     segment behind.  Fully drained on return, so the window is
     immediately reusable (the bidi kernel folds both directions through
-    one window).  ``col_off`` addresses a column sub-range of the
-    accumulator row (the bidi kernel's per-direction halves)."""
+    one window).
+
+    ``acc_row(s)`` / ``recv_row(s)`` hand back the (S, 128) ref of
+    segment s — the caller owns the block/direction addressing, always
+    through untiled leading dims (see ``_rs_phase`` on why)."""
 
     def start_load(s):
         slot = lax.rem(s, 2)
-        sl = pl.ds(col_off + s * seg, seg)
-        rl = pl.ds(s * seg, seg)
-        pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
+        pltpu.make_async_copy(acc_row(s), va.at[slot],
                               load_sems.at[slot, 0]).start()
-        pltpu.make_async_copy(recv_ref.at[k, rl], vb.at[slot],
+        pltpu.make_async_copy(recv_row(s), vb.at[slot],
                               load_sems.at[slot, 1]).start()
 
     def wait_wb(slot, s_of_wb):
         # descriptor only carries the byte count to decrement
-        pltpu.make_async_copy(
-            va.at[slot],
-            acc_ref.at[recv_idx, pl.ds(col_off + s_of_wb * seg, seg)],
-            wb_sems.at[slot]).wait()
+        pltpu.make_async_copy(va.at[slot], acc_row(s_of_wb),
+                              wb_sems.at[slot]).wait()
 
     start_load(0)
 
@@ -401,16 +432,12 @@ def _seg_fold_row(lax, pl, pltpu, *, acc_ref, recv_ref, k, recv_idx,
                 wait_wb(1 - slot, s - 1)
             start_load(s + 1)
 
-        sl = pl.ds(col_off + s * seg, seg)
-        rl = pl.ds(s * seg, seg)
-        pltpu.make_async_copy(acc_ref.at[recv_idx, sl], va.at[slot],
+        pltpu.make_async_copy(acc_row(s), va.at[slot],
                               load_sems.at[slot, 0]).wait()
-        pltpu.make_async_copy(recv_ref.at[k, rl], vb.at[slot],
+        pltpu.make_async_copy(recv_row(s), vb.at[slot],
                               load_sems.at[slot, 1]).wait()
-        cur = va[pl.ds(slot, 1), :]
-        part = vb[pl.ds(slot, 1), :]
-        va[pl.ds(slot, 1), :] = fold(cur, part)
-        pltpu.make_async_copy(va.at[slot], acc_ref.at[recv_idx, sl],
+        va[slot] = fold(va[slot], vb[slot])
+        pltpu.make_async_copy(va.at[slot], acc_row(s),
                               wb_sems.at[slot]).start()
         return c
 
@@ -422,11 +449,12 @@ def _seg_fold_row(lax, pl, pltpu, *, acc_ref, recv_ref, k, recv_idx,
 
 
 def _seg_rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
-                  send_sem, rs_sems, align: int, fold, nseg: int, seg: int,
+                  send_sem, rs_sems, align: int, fold, nseg: int,
                   va, vb, load_sems, wb_sems):
-    """Segmented twin of ``_rs_phase``: acc/recv live in HBM; the fold
-    streams through the bounded VMEM window (``_seg_fold_row``) — the
-    bounded-buffer pipeline of the reference's segmented ring
+    """Segmented twin of ``_rs_phase``: acc/recv live in HBM as
+    (n, nseg, S, 128) / (n-1, nseg, S, 128); the fold streams through
+    the bounded VMEM window (``_seg_fold_row``) — the bounded-buffer
+    pipeline of the reference's segmented ring
     (``coll_base_allreduce.c:618``), which exists precisely so payload
     size is bounded by main memory, not the staging buffer."""
 
@@ -440,9 +468,10 @@ def _seg_rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
             device_id_type=pltpu.DeviceIdType.LOGICAL)
         rdma.start()
         rdma.wait()   # my partial for block recv_idx arrived (HBM)
-        _seg_fold_row(lax, pl, pltpu, acc_ref=acc_ref, recv_ref=recv_ref,
-                      k=k, recv_idx=recv_idx, col_off=0, nseg=nseg,
-                      seg=seg, va=va, vb=vb, load_sems=load_sems,
+        _seg_fold_row(lax, pl, pltpu,
+                      acc_row=lambda s: acc_ref.at[recv_idx, s],
+                      recv_row=lambda s: recv_ref.at[k, s],
+                      nseg=nseg, va=va, vb=vb, load_sems=load_sems,
                       wb_sems=wb_sems, fold=fold)
         return carry
 
@@ -451,22 +480,21 @@ def _seg_rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_all_reduce_seg(n: int, axis: str, blk: int, seg: int,
+def _build_all_reduce_seg(n: int, axis: str, nseg: int, srows: int,
                           dtype_str: str, interpret: bool,
                           op: str = "sum"):
     """Segmented ring all-reduce for large payloads: HBM-resident
-    (n, blk) accumulator, bounded VMEM window, same ring schedule as
-    the fused kernel.  The all-gather phase is pure HBM↔HBM remote DMA
-    and needs no window at all."""
-    assert blk % seg == 0, (blk, seg)
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    (n, nseg, S, 128) accumulator, bounded VMEM window, same ring
+    schedule as the fused kernel.  The all-gather phase is pure
+    HBM↔HBM remote DMA and needs no window at all."""
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     fold = _op_fn(jnp, op)
-    nseg = blk // seg
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref, va, vb,
                local_sem, send_sem, load_sems, wb_sems, rs_sems, ag_sems):
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
+        barrier(right, lax.rem(my - 1 + n, n))
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
@@ -474,7 +502,7 @@ def _build_all_reduce_seg(n: int, axis: str, blk: int, seg: int,
         done = _seg_rs_phase(
             lax, pl, pltpu, n=n, my=my, right=right, acc_ref=acc_ref,
             recv_ref=recv_ref, send_sem=send_sem, rs_sems=rs_sems,
-            align=0, fold=fold, nseg=nseg, seg=seg,
+            align=0, fold=fold, nseg=nseg,
             va=va, vb=vb, load_sems=load_sems, wb_sems=wb_sems)
         cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref.at[done],
                                     local_sem)
@@ -484,20 +512,29 @@ def _build_all_reduce_seg(n: int, axis: str, blk: int, seg: int,
         _ag_phase(lax, pl, pltpu, n=n, my=my, right=right,
                   out_ref=out_ref, send_sem=send_sem, ag_sems=ag_sems)
 
-    def call(x):  # x: (n, blk) per device
+    def call(x):  # x: (n, nseg, S, 128) per device
         kw = {}
         cp = cparams(5)
         if cp is not None:
             kw["compiler_params"] = cp
-        return pl.pallas_call(
+        dt = jnp.dtype(dtype_str)
+        # acc/recv are HBM-resident ring state: Mosaic only allocates
+        # VMEM/SMEM/semaphore scratch, so HBM buffers ride as extra
+        # ANY-space outputs (discarded) — same kernel arg order
+        out, _, _ = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            out_shape=(jax.ShapeDtypeStruct((n, nseg, srows, 128),
+                                            dtype_str),
+                       jax.ShapeDtypeStruct((n, nseg, srows, 128),
+                                            dtype_str),
+                       jax.ShapeDtypeStruct((n - 1, nseg, srows, 128),
+                                            dtype_str)),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.HBM((n, blk), jnp.dtype(dtype_str)),
-                            pltpu.HBM((n - 1, blk), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[pltpu.VMEM((2, srows, 128), dt),
+                            pltpu.VMEM((2, srows, 128), dt),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA((2, 2)),
@@ -507,25 +544,25 @@ def _build_all_reduce_seg(n: int, axis: str, blk: int, seg: int,
             interpret=interpret,
             **kw,
         )(x)
+        return out
 
     return call
 
 
 @functools.lru_cache(maxsize=64)
-def _build_reduce_scatter_seg(n: int, axis: str, blk: int, seg: int,
+def _build_reduce_scatter_seg(n: int, axis: str, nseg: int, srows: int,
                               dtype_str: str, interpret: bool,
                               op: str = "sum"):
     """Segmented ring reduce-scatter (owner-aligned, align=-1) — the
     large-payload twin of ``_build_reduce_scatter``."""
-    assert blk % seg == 0, (blk, seg)
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     fold = _op_fn(jnp, op)
-    nseg = blk // seg
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref, va, vb,
                local_sem, send_sem, load_sems, wb_sems, rs_sems):
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
+        barrier(right, lax.rem(my - 1 + n, n))
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
@@ -533,26 +570,33 @@ def _build_reduce_scatter_seg(n: int, axis: str, blk: int, seg: int,
         done = _seg_rs_phase(
             lax, pl, pltpu, n=n, my=my, right=right, acc_ref=acc_ref,
             recv_ref=recv_ref, send_sem=send_sem, rs_sems=rs_sems,
-            align=-1, fold=fold, nseg=nseg, seg=seg,
+            align=-1, fold=fold, nseg=nseg,
             va=va, vb=vb, load_sems=load_sems, wb_sems=wb_sems)
         cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref, local_sem)
         cp2.start()
         cp2.wait()
 
-    def call(x):  # x: (n, blk) per device -> (blk,) per device
+    def call(x):  # x: (n, nseg, S, 128) per device -> (nseg, S, 128)
         kw = {}
         cp = cparams(6)
         if cp is not None:
             kw["compiler_params"] = cp
-        return pl.pallas_call(
+        dt = jnp.dtype(dtype_str)
+        # HBM ring state as extra ANY outputs (see _build_all_reduce_seg)
+        out, _, _ = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((blk,), dtype_str),
+            out_shape=(jax.ShapeDtypeStruct((nseg, srows, 128),
+                                            dtype_str),
+                       jax.ShapeDtypeStruct((n, nseg, srows, 128),
+                                            dtype_str),
+                       jax.ShapeDtypeStruct((n - 1, nseg, srows, 128),
+                                            dtype_str)),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.HBM((n, blk), jnp.dtype(dtype_str)),
-                            pltpu.HBM((n - 1, blk), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[pltpu.VMEM((2, srows, 128), dt),
+                            pltpu.VMEM((2, srows, 128), dt),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA((2, 2)),
@@ -561,27 +605,29 @@ def _build_reduce_scatter_seg(n: int, axis: str, blk: int, seg: int,
             interpret=interpret,
             **kw,
         )(x)
+        return out
 
     return call
 
 
-def _bidi_done_and_ag(lax, pl, pltpu, *, n, my, right, left, half,
+def _bidi_done_and_ag(lax, pl, pltpu, *, n, my, right, left,
                       acc_ref, out_ref, local_sem, send_cw_sem,
                       send_ccw_sem, ag_cw_sems, ag_ccw_sems):
     """Shared tail of the bidirectional all-reduce kernels: copy each
     direction's completed half-block out, then run the mirrored
-    all-gather rings (both duplex directions busy every step)."""
-    h = half
+    all-gather rings (both duplex directions busy every step).
+
+    Refs are direction-leading — acc/out (n, 2, ..., S, 128), dir 0 =
+    clockwise half, dir 1 = counter-clockwise — so the per-direction
+    slices ride untiled leading dims (see ``_rs_phase``)."""
     done_cw = lax.rem(my + 1, n)
     done_ccw = lax.rem(my - 1 + n, n)
-    c1 = pltpu.make_async_copy(acc_ref.at[done_cw, pl.ds(0, h)],
-                               out_ref.at[done_cw, pl.ds(0, h)],
-                               local_sem)
+    c1 = pltpu.make_async_copy(acc_ref.at[done_cw, 0],
+                               out_ref.at[done_cw, 0], local_sem)
     c1.start()
     c1.wait()
-    c2 = pltpu.make_async_copy(acc_ref.at[done_ccw, pl.ds(h, h)],
-                               out_ref.at[done_ccw, pl.ds(h, h)],
-                               local_sem)
+    c2 = pltpu.make_async_copy(acc_ref.at[done_ccw, 1],
+                               out_ref.at[done_ccw, 1], local_sem)
     c2.start()
     c2.wait()
 
@@ -589,14 +635,14 @@ def _bidi_done_and_ag(lax, pl, pltpu, *, n, my, right, left, half,
         f_cw = lax.rem(my + 1 - k + n, n)
         f_ccw = lax.rem(my - 1 + k + n, n)
         d_cw = pltpu.make_async_remote_copy(
-            src_ref=out_ref.at[f_cw, pl.ds(0, h)],
-            dst_ref=out_ref.at[f_cw, pl.ds(0, h)],
+            src_ref=out_ref.at[f_cw, 0],
+            dst_ref=out_ref.at[f_cw, 0],
             send_sem=send_cw_sem, recv_sem=ag_cw_sems.at[k],
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL)
         d_ccw = pltpu.make_async_remote_copy(
-            src_ref=out_ref.at[f_ccw, pl.ds(h, h)],
-            dst_ref=out_ref.at[f_ccw, pl.ds(h, h)],
+            src_ref=out_ref.at[f_ccw, 1],
+            dst_ref=out_ref.at[f_ccw, 1],
             send_sem=send_ccw_sem, recv_sem=ag_ccw_sems.at[k],
             device_id=left,
             device_id_type=pltpu.DeviceIdType.LOGICAL)
@@ -610,23 +656,20 @@ def _bidi_done_and_ag(lax, pl, pltpu, *, n, my, right, left, half,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_all_reduce_seg_bidi(n: int, axis: str, half: int, seg: int,
+def _build_all_reduce_seg_bidi(n: int, axis: str, nseg: int, srows: int,
                                dtype_str: str, interpret: bool,
                                op: str = "sum"):
     """Segmented AND bidirectional ring all-reduce — the large-payload
-    champion: the (n, 2*half) payload is HBM-resident, columns [:half]
-    ride the clockwise ring and [half:] the counter-clockwise ring
+    champion: the (n, 2, nseg, S, 128) payload is HBM-resident, dir 0
+    rides the clockwise ring and dir 1 the counter-clockwise ring
     concurrently (both duplex ICI directions carry a half-payload every
     step), and each direction's fold streams through ONE shared
     double-buffered VMEM window (``_seg_fold_row`` drains fully between
     directions, so the window is reused — folds are VPU-sequential
     anyway; it is the DMAs that overlap).
     """
-    assert half % seg == 0, (half, seg)
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     fold = _op_fn(jnp, op)
-    nseg = half // seg
-    blk = 2 * half
 
     def kernel(x_ref, out_ref, acc_ref, recv_cw, recv_ccw, va, vb,
                local_sem, send_cw_sem, send_ccw_sem, load_sems, wb_sems,
@@ -634,11 +677,10 @@ def _build_all_reduce_seg_bidi(n: int, axis: str, half: int, seg: int,
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
         left = lax.rem(my - 1 + n, n)
+        barrier(right, left)
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
-
-        h = half
 
         def rs_step(k, carry):
             s_cw = lax.rem(my - k + 2 * n, n)
@@ -646,13 +688,13 @@ def _build_all_reduce_seg_bidi(n: int, axis: str, half: int, seg: int,
             s_ccw = lax.rem(my + k, n)
             r_ccw = lax.rem(my + 1 + k, n)
             d_cw = pltpu.make_async_remote_copy(
-                src_ref=acc_ref.at[s_cw, pl.ds(0, h)],
+                src_ref=acc_ref.at[s_cw, 0],
                 dst_ref=recv_cw.at[k],
                 send_sem=send_cw_sem, recv_sem=rs_cw_sems.at[k],
                 device_id=right,
                 device_id_type=pltpu.DeviceIdType.LOGICAL)
             d_ccw = pltpu.make_async_remote_copy(
-                src_ref=acc_ref.at[s_ccw, pl.ds(h, h)],
+                src_ref=acc_ref.at[s_ccw, 1],
                 dst_ref=recv_ccw.at[k],
                 send_sem=send_ccw_sem, recv_sem=rs_ccw_sems.at[k],
                 device_id=left,
@@ -660,44 +702,53 @@ def _build_all_reduce_seg_bidi(n: int, axis: str, half: int, seg: int,
             d_cw.start()
             d_ccw.start()          # both directions' DMAs in flight
             d_cw.wait()
-            _seg_fold_row(lax, pl, pltpu, acc_ref=acc_ref,
-                          recv_ref=recv_cw, k=k, recv_idx=r_cw,
-                          col_off=0, nseg=nseg, seg=seg, va=va, vb=vb,
+            _seg_fold_row(lax, pl, pltpu,
+                          acc_row=lambda s: acc_ref.at[r_cw, 0, s],
+                          recv_row=lambda s: recv_cw.at[k, s],
+                          nseg=nseg, va=va, vb=vb,
                           load_sems=load_sems, wb_sems=wb_sems,
                           fold=fold)
             d_ccw.wait()
-            _seg_fold_row(lax, pl, pltpu, acc_ref=acc_ref,
-                          recv_ref=recv_ccw, k=k, recv_idx=r_ccw,
-                          col_off=h, nseg=nseg, seg=seg, va=va, vb=vb,
+            _seg_fold_row(lax, pl, pltpu,
+                          acc_row=lambda s: acc_ref.at[r_ccw, 1, s],
+                          recv_row=lambda s: recv_ccw.at[k, s],
+                          nseg=nseg, va=va, vb=vb,
                           load_sems=load_sems, wb_sems=wb_sems,
                           fold=fold)
             return carry
 
         lax.fori_loop(0, n - 1, rs_step, 0)
         _bidi_done_and_ag(lax, pl, pltpu, n=n, my=my, right=right,
-                          left=left, half=half, acc_ref=acc_ref,
+                          left=left, acc_ref=acc_ref,
                           out_ref=out_ref, local_sem=local_sem,
                           send_cw_sem=send_cw_sem,
                           send_ccw_sem=send_ccw_sem,
                           ag_cw_sems=ag_cw_sems, ag_ccw_sems=ag_ccw_sems)
 
-    def call(x):  # x: (n, 2*half) per device
+    def call(x):  # x: (n, 2, nseg, S, 128) per device
         kw = {}
         cp = cparams(12)
         if cp is not None:
             kw["compiler_params"] = cp
-        return pl.pallas_call(
+        dt = jnp.dtype(dtype_str)
+        # HBM ring state as extra ANY outputs (see _build_all_reduce_seg)
+        out, _, _, _ = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            out_shape=(jax.ShapeDtypeStruct((n, 2, nseg, srows, 128),
+                                            dtype_str),
+                       jax.ShapeDtypeStruct((n, 2, nseg, srows, 128),
+                                            dtype_str),
+                       jax.ShapeDtypeStruct((n - 1, nseg, srows, 128),
+                                            dtype_str),
+                       jax.ShapeDtypeStruct((n - 1, nseg, srows, 128),
+                                            dtype_str)),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.HBM((n, blk), jnp.dtype(dtype_str)),
-                            pltpu.HBM((n - 1, half),
-                                      jnp.dtype(dtype_str)),
-                            pltpu.HBM((n - 1, half),
-                                      jnp.dtype(dtype_str)),
-                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((2, seg), jnp.dtype(dtype_str)),
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[pltpu.VMEM((2, srows, 128), dt),
+                            pltpu.VMEM((2, srows, 128), dt),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
@@ -710,26 +761,26 @@ def _build_all_reduce_seg_bidi(n: int, axis: str, half: int, seg: int,
             interpret=interpret,
             **kw,
         )(x)
+        return out
 
     return call
 
 
 @functools.lru_cache(maxsize=64)
-def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
+def _build_all_reduce_bidi(n: int, axis: str, rows: int, dtype_str: str,
                            interpret: bool, op: str = "sum"):
-    """Bidirectional ring all-reduce: the (n, 2*half) payload is split
-    into a clockwise half (columns [:half], sent rightward) and a
-    counter-clockwise half (columns [half:], sent leftward), with
-    mirrored reduce-scatter + all-gather schedules running concurrently.
-    ICI links are duplex, so both directions carry a half-payload every
+    """Bidirectional ring all-reduce: the (n, 2, rows, 128) payload is
+    split into a clockwise half (dir 0, sent rightward) and a
+    counter-clockwise half (dir 1, sent leftward), with mirrored
+    reduce-scatter + all-gather schedules running concurrently.  ICI
+    links are duplex, so both directions carry a half-payload every
     step — per-step wire time halves vs the unidirectional ring.
 
-    CW completes block (my+1)'s left half; CCW completes block (my-1)'s
-    right half; the mirrored all-gather phases then circulate both.
+    CW completes block (my+1)'s dir-0 half; CCW completes block
+    (my-1)'s dir-1 half; the mirrored all-gather phases circulate both.
     """
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     fold = _op_fn(jnp, op)
-    blk = 2 * half
 
     def kernel(x_ref, out_ref, acc_ref, recv_cw, recv_ccw,
                local_sem, send_cw_sem, send_ccw_sem,
@@ -737,11 +788,10 @@ def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
         left = lax.rem(my - 1 + n, n)
+        barrier(right, left)
         cp = pltpu.make_async_copy(x_ref, acc_ref, local_sem)
         cp.start()
         cp.wait()
-
-        h = half
 
         def rs_step(k, carry):
             s_cw = lax.rem(my - k + 2 * n, n)
@@ -749,13 +799,13 @@ def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
             s_ccw = lax.rem(my + k, n)
             r_ccw = lax.rem(my + 1 + k, n)
             d_cw = pltpu.make_async_remote_copy(
-                src_ref=acc_ref.at[s_cw, pl.ds(0, h)],
+                src_ref=acc_ref.at[s_cw, 0],
                 dst_ref=recv_cw.at[k],
                 send_sem=send_cw_sem, recv_sem=rs_cw_sems.at[k],
                 device_id=right,
                 device_id_type=pltpu.DeviceIdType.LOGICAL)
             d_ccw = pltpu.make_async_remote_copy(
-                src_ref=acc_ref.at[s_ccw, pl.ds(h, h)],
+                src_ref=acc_ref.at[s_ccw, 1],
                 dst_ref=recv_ccw.at[k],
                 send_sem=send_ccw_sem, recv_sem=rs_ccw_sems.at[k],
                 device_id=left,
@@ -764,35 +814,33 @@ def _build_all_reduce_bidi(n: int, axis: str, half: int, dtype_str: str,
             d_ccw.start()
             d_cw.wait()
             d_ccw.wait()
-            cur_cw = acc_ref[pl.ds(r_cw, 1), pl.ds(0, h)]
-            acc_ref[pl.ds(r_cw, 1), pl.ds(0, h)] = fold(
-                cur_cw, recv_cw[pl.ds(k, 1), :])
-            cur_ccw = acc_ref[pl.ds(r_ccw, 1), pl.ds(h, h)]
-            acc_ref[pl.ds(r_ccw, 1), pl.ds(h, h)] = fold(
-                cur_ccw, recv_ccw[pl.ds(k, 1), :])
+            acc_ref[r_cw, 0] = fold(acc_ref[r_cw, 0], recv_cw[k])
+            acc_ref[r_ccw, 1] = fold(acc_ref[r_ccw, 1], recv_ccw[k])
             return carry
 
         lax.fori_loop(0, n - 1, rs_step, 0)
         _bidi_done_and_ag(lax, pl, pltpu, n=n, my=my, right=right,
-                          left=left, half=half, acc_ref=acc_ref,
+                          left=left, acc_ref=acc_ref,
                           out_ref=out_ref, local_sem=local_sem,
                           send_cw_sem=send_cw_sem,
                           send_ccw_sem=send_ccw_sem,
                           ag_cw_sems=ag_cw_sems, ag_ccw_sems=ag_ccw_sems)
 
-    def call(x):  # x: (n, 2*half) per device
+    def call(x):  # x: (n, 2, rows, 128) per device
         kw = {}
         cp = cparams(7)
         if cp is not None:
             kw["compiler_params"] = cp
+        dt = jnp.dtype(dtype_str)
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((n, blk), dtype_str),
+            out_shape=jax.ShapeDtypeStruct((n, 2, rows, 128),
+                                           dtype_str),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.VMEM((n, blk), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((n - 1, half), jnp.dtype(dtype_str)),
-                            pltpu.VMEM((n - 1, half), jnp.dtype(dtype_str)),
+            scratch_shapes=[pltpu.VMEM((n, 2, rows, 128), dt),
+                            pltpu.VMEM((n - 1, rows, 128), dt),
+                            pltpu.VMEM((n - 1, rows, 128), dt),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(()),
@@ -817,10 +865,13 @@ def _build_all_to_all(n: int, axis: str, blk_shape, dtype_str: str,
     ``coll_base_alltoall.c`` pairwise-exchange algorithm, where step k
     pairs (i, i+k)).  Fully symmetric: one DMA per device per step.
     """
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
 
     def kernel(x_ref, out_ref, local_sem, send_sem, recv_sems):
         my = lax.axis_index(axis)
+        # pairwise exchange touches every peer: the entry barrier must
+        # cover them all, not just ring neighbors
+        barrier(*[lax.rem(my + k, n) for k in range(1, n)])
         cp = pltpu.make_async_copy(x_ref.at[my], out_ref.at[my],
                                    local_sem)
         cp.start()
@@ -860,8 +911,117 @@ def _build_all_to_all(n: int, axis: str, blk_shape, dtype_str: str,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_bcast(n: int, axis: str, nseg: int, seg: int, dtype_str: str,
-                 interpret: bool):
+def _build_all_to_all_v(n: int, axis: str, max_rows: int, width: int,
+                        chunk: int, dtype_str: str, interpret: bool):
+    """Ragged pairwise all-to-all — true alltoallv for MoE/EP dispatch
+    (``coll_base_alltoall.c`` pairwise exchange with per-pair sizes).
+
+    The per-pair row counts arrive as a runtime (n, n) int32 table in
+    SMEM, so ONE compile serves every routing outcome — MoE re-routes
+    every step, and a counts-specialized kernel would recompile per
+    batch.  Each pair moves ceil(cnt/chunk) fixed-shape (chunk, W)
+    DMAs: Mosaic needs static DMA shapes, but trip counts may be
+    dynamic scalars — wasted wire is bounded by chunk-1 rows per pair,
+    vs the padded ``all_to_all`` moving max_rows for every pair
+    regardless of raggedness.
+
+    Asymmetric counts mean send and receive chunk totals differ per
+    device, so the send loop uses ``wait_send`` and a separate receive
+    loop drains ``recv_sems`` by ``wait_recv`` — the split-phase form
+    of the symmetric kernels' ``wait()``.
+    """
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
+    full = (max_rows + chunk - 1) // chunk
+
+    def nchunks(rows):
+        # the interpreter emulates every remote DMA as a cross-device
+        # rendezvous, so per-device op counts must be SYMMETRIC there:
+        # interpret mode always moves whole blocks (validating the
+        # addressing/semaphore schedule); the dynamic ragged trip
+        # counts are a hardware feature, compile-proven by the AOT gate
+        if interpret:
+            return full
+        return (rows + chunk - 1) // chunk
+
+    def kernel(counts_ref, x_ref, out_ref, local_sem, send_sem,
+               recv_sems):
+        my = lax.axis_index(axis)
+        barrier(*[lax.rem(my + k, n) for k in range(1, n)])
+
+        # local block: out[my] rows [:counts[my,my]] come from x[my]
+        def local_chunk(c, carry):
+            sl = pl.ds(c * chunk, chunk)
+            cp = pltpu.make_async_copy(x_ref.at[my, sl],
+                                       out_ref.at[my, sl], local_sem)
+            cp.start()
+            cp.wait()
+            return carry
+
+        lax.fori_loop(0, nchunks(counts_ref[my, my]), local_chunk, 0)
+
+        def pair_step(k, carry):
+            dst = lax.rem(my + k, n)
+            src = lax.rem(my - k + n, n)
+
+            def send_chunk(c, carry2):
+                sl = pl.ds(c * chunk, chunk)
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=x_ref.at[dst, sl],
+                    dst_ref=out_ref.at[my, sl],
+                    send_sem=send_sem, recv_sem=recv_sems.at[k - 1],
+                    device_id=dst,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                rdma.start()
+                rdma.wait_send()
+                return carry2
+
+            lax.fori_loop(0, nchunks(counts_ref[my, dst]), send_chunk,
+                          0, unroll=False)
+
+            def recv_chunk(c, carry2):
+                sl = pl.ds(c * chunk, chunk)
+                # shape-only descriptor: wait_recv consumes exactly one
+                # inbound (chunk, W) DMA's bytes from recv_sems[k-1]
+                pltpu.make_async_remote_copy(
+                    src_ref=out_ref.at[src, sl],
+                    dst_ref=out_ref.at[src, sl],
+                    send_sem=send_sem, recv_sem=recv_sems.at[k - 1],
+                    device_id=src,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                ).wait_recv()
+                return carry2
+
+            lax.fori_loop(0, nchunks(counts_ref[src, my]), recv_chunk,
+                          0, unroll=False)
+            return carry
+
+        lax.fori_loop(1, n, pair_step, 0)
+
+    def call(counts, x):  # counts: (n, n) i32; x: (n, max_rows, W)
+        kw = {}
+        cp = cparams(13)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, max_rows, width),
+                                           dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(counts, x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
+def _build_bcast(n: int, axis: str, nseg: int, srows: int,
+                 dtype_str: str, interpret: bool):
     """Pipelined segmented ring broadcast — the "clamped conveyor": root
     streams S segments rightward and every hop forwards segment s one
     wave after receiving it, so all links are busy simultaneously and
@@ -880,7 +1040,7 @@ def _build_bcast(n: int, axis: str, nseg: int, seg: int, dtype_str: str,
     device aims its writes at a sink row (``out[S]``) so the conveyor
     never races root's source rows.
     """
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     waves = nseg + n - 2
 
     # root arrives as a runtime SMEM scalar, not a cache key: the kernel
@@ -889,6 +1049,7 @@ def _build_bcast(n: int, axis: str, nseg: int, seg: int, dtype_str: str,
     def kernel(root_ref, x_ref, out_ref, local_sem, send_sem, recv_sem):
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
+        barrier(right, lax.rem(my - 1 + n, n))
         rel = lax.rem(my - root_ref[0] + n, n)
         # everyone seeds out with its local buffer: root's rows are the
         # payload, other devices' rows are pre-valid filler the conveyor
@@ -920,14 +1081,15 @@ def _build_bcast(n: int, axis: str, nseg: int, seg: int, dtype_str: str,
 
         lax.fori_loop(0, waves, wave, 0)
 
-    def call(root, x):  # x: (nseg, seg) per device; returns root's rows
+    def call(root, x):  # x: (nseg, S, 128) per device; root's rows back
         kw = {}
         cp = cparams(8)
         if cp is not None:
             kw["compiler_params"] = cp
         out = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((nseg + 1, seg), dtype_str),
+            out_shape=jax.ShapeDtypeStruct((nseg + 1, srows, 128),
+                                           dtype_str),
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                       pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -1003,11 +1165,21 @@ def all_gather(x, mesh, axis: str, interpret: bool = True):
 _DEFAULT_SEG_ELEMS = 131072
 
 
-def _seg_shape(blk: int, seg_elems: int | None) -> tuple[int, int]:
-    """(window, padded block): the segment window never exceeds the ring
-    block, and the block is rounded up to a whole number of segments."""
-    seg = min(seg_elems or _DEFAULT_SEG_ELEMS, blk)
-    return seg, -(-blk // seg) * seg
+def _rows_for(elems: int) -> int:
+    """128-lane rows covering ``elems`` elements (≥1).  Every kernel
+    payload is shaped (..., rows, 128): Mosaic tiles the trailing two
+    dims, so the lane dim must be exactly 128 and all block/segment
+    indexing rides untiled leading dims."""
+    return max(1, -(-elems // 128))
+
+
+def _seg_rows(rows: int, seg_elems: int | None) -> tuple[int, int]:
+    """(window rows, padded block rows): the VMEM window is
+    ``seg_elems`` rounded down to whole 128-lane rows, never exceeding
+    the ring block; the block is rounded up to a whole number of
+    windows."""
+    srows = max(1, min((seg_elems or _DEFAULT_SEG_ELEMS) // 128, rows))
+    return srows, -(-rows // srows) * srows
 
 
 def _pad_value(op: str, dtype) -> float | int:
@@ -1033,22 +1205,25 @@ def _jit_reduce_scatter(mesh, axis: str, payload_shape, dtype_str: str,
 
     n = mesh.shape[axis]
     blk = int(np.prod(payload_shape)) if payload_shape else 1
+    rows = _rows_for(blk)
     if variant == "seg":
-        seg, blk_p = _seg_shape(blk, seg_elems)
-        inner = _build_reduce_scatter_seg(n, axis, blk_p, seg,
+        srows, rows = _seg_rows(rows, seg_elems)
+        inner = _build_reduce_scatter_seg(n, axis, rows // srows, srows,
                                           dtype_str, interpret, op)
+        shape_in = (n, rows // srows, srows, 128)
     else:
-        blk_p = blk
-        inner = _build_reduce_scatter(n, axis, blk, dtype_str,
+        inner = _build_reduce_scatter(n, axis, rows, dtype_str,
                                       interpret, op)
+        shape_in = (n, rows, 128)
+    padded = rows * 128
 
     def body(t):                       # t: (1, n, *S)
-        rows = t[0].reshape(n, blk)
-        if blk_p != blk:
-            rows = jnp.pad(rows, ((0, 0), (0, blk_p - blk)),
-                           constant_values=_pad_value(op, dtype_str))
-        out = inner(rows)              # (blk_p,)
-        return out[:blk].reshape((1,) + payload_shape)
+        r2 = t[0].reshape(n, blk)
+        if padded != blk:
+            r2 = jnp.pad(r2, ((0, 0), (0, padded - blk)),
+                         constant_values=_pad_value(op, dtype_str))
+        out = inner(r2.reshape(shape_in))
+        return out.reshape(-1)[:blk].reshape((1,) + payload_shape)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
                              out_specs=P(axis), check_vma=False))
@@ -1082,31 +1257,38 @@ def _jit_all_reduce(mesh, axis: str, payload_shape, dtype_str: str,
     n = mesh.shape[axis]
     size = int(np.prod(payload_shape)) if payload_shape else 1
     blk = -(-size // n)                # ceil
+    rows = _rows_for(blk)
     if variant == "seg":
-        seg, blk = _seg_shape(blk, seg_elems)
-        inner = _build_all_reduce_seg(n, axis, blk, seg, dtype_str,
-                                      interpret, op)
+        srows, rows = _seg_rows(rows, seg_elems)
+        inner = _build_all_reduce_seg(n, axis, rows // srows, srows,
+                                      dtype_str, interpret, op)
+        shape_in = (n, rows // srows, srows, 128)
     elif variant == "seg_bidi":
-        half = -(-blk // 2)
-        seg, half = _seg_shape(half, seg_elems)
-        blk = 2 * half
-        inner = _build_all_reduce_seg_bidi(n, axis, half, seg,
-                                           dtype_str, interpret, op)
+        hrows = -(-rows // 2)
+        srows, hrows = _seg_rows(hrows, seg_elems)
+        rows = 2 * hrows
+        inner = _build_all_reduce_seg_bidi(n, axis, hrows // srows,
+                                           srows, dtype_str, interpret,
+                                           op)
+        shape_in = (n, 2, hrows // srows, srows, 128)
     elif variant == "bidi":
-        blk = blk + (blk % 2)          # even split across directions
-        inner = _build_all_reduce_bidi(n, axis, blk // 2, dtype_str,
+        hrows = -(-rows // 2)          # even row split per direction
+        rows = 2 * hrows
+        inner = _build_all_reduce_bidi(n, axis, hrows, dtype_str,
                                        interpret, op)
+        shape_in = (n, 2, hrows, 128)
     else:
-        inner = _build_all_reduce(n, axis, blk, dtype_str, interpret,
+        inner = _build_all_reduce(n, axis, rows, dtype_str, interpret,
                                   op)
-    padded = blk * n
+        shape_in = (n, rows, 128)
+    padded = rows * 128 * n
 
     def body(t):                       # t: (1, *S)
         flat = t.reshape(-1)
         if padded != size:
             flat = jnp.pad(flat, (0, padded - size),
                            constant_values=_pad_value(op, dtype_str))
-        out = inner(flat.reshape(n, blk))      # (n, blk) reduced
+        out = inner(flat.reshape(shape_in))
         return out.reshape(-1)[:size].reshape(payload_shape)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis),
@@ -1177,6 +1359,66 @@ def all_to_all(x, mesh, axis: str, interpret: bool = True):
 
 
 @functools.lru_cache(maxsize=256)
+def _jit_all_to_all_v(mesh, axis: str, max_rows: int, width: int,
+                      chunk: int, dtype_str: str, interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    inner = _build_all_to_all_v(n, axis, max_rows, width, chunk,
+                                dtype_str, interpret)
+
+    def body(c, t):                    # c: (n, n) replicated; t: (1, n, R, W)
+        return inner(c, t[0])[None]
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(), P(axis)),
+                             out_specs=P(axis), check_vma=False))
+
+
+def all_to_all_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
+                 interpret: bool = True):
+    """Ragged all-to-all (true alltoallv): ``x`` is (n, n, R, W)
+    sharded on the leading rank axis — rank i's block j carries
+    ``counts[i, j]`` valid rows (≤ R) for rank j — and rank j receives
+    them in ``out[j, i, :counts[i, j]]`` (the ``alltoall_array``
+    row-is-my-received convention).  Rows past the count are
+    unspecified.
+
+    ``counts`` is a runtime (n, n) int32 operand, NOT a compile-time
+    constant: one compiled program serves every MoE routing outcome.
+    Wire bytes per pair are ceil(count/chunk_rows)*chunk_rows rows —
+    ≤1.2x the ideal ragged byte count for real dispatch sizes, where
+    the padded ``all_to_all`` moves the full R regardless.  W must be
+    a multiple of 128 lanes (MoE hidden dims are)."""
+    jax, jnp, lax, pl, pltpu = _mods()
+
+    n = mesh.shape[axis]
+    if x.ndim != 4 or x.shape[0] != n or x.shape[1] != n:
+        raise ValueError(
+            f"all_to_all_v needs a ({n}, {n}, R, W) array on this "
+            f"mesh, got {tuple(x.shape)}")
+    if x.shape[3] % 128 != 0:
+        raise ValueError(
+            f"all_to_all_v row width must be a multiple of 128 lanes, "
+            f"got {x.shape[3]} (pad the feature dim)")
+    if n == 1:
+        return x
+    chunk_rows = int(chunk_rows)
+    R = int(x.shape[2])
+    # the kernel slices fixed (chunk, W) windows: the row dim must be a
+    # whole number of chunks or the last window overruns the buffer
+    Rp = -(-R // chunk_rows) * chunk_rows
+    if Rp != R:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, Rp - R), (0, 0)))
+    counts = jnp.asarray(counts, jnp.int32)
+    fn = _jit_all_to_all_v(mesh, axis, Rp, int(x.shape[3]), chunk_rows,
+                           str(x.dtype), interpret)
+    out = fn(counts, x)
+    return out[:, :, :R] if Rp != R else out
+
+
+@functools.lru_cache(maxsize=256)
 def _jit_all_reduce_torus(mesh, axes, payload_shape, dtype_str: str,
                           op: str, interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
@@ -1186,31 +1428,42 @@ def _jit_all_reduce_torus(mesh, axes, payload_shape, dtype_str: str,
     a0, a1 = axes
     n0, n1 = mesh.shape[a0], mesh.shape[a1]
     size = int(np.prod(payload_shape)) if payload_shape else 1
-    blk0 = -(-size // n0)
-    blk1 = -(-blk0 // n1)
+    rows0 = _rows_for(-(-size // n0))
+    size1 = rows0 * 128                # phase-1 block, in elements
+    rows1 = _rows_for(-(-size1 // n1))
     # the kernels run over a FLATTENED 1-D mesh with sub-ring index
     # arithmetic ((i0, i1) <-> i0*n1+i1): scalar LOGICAL device ids
-    # stay interpreter-runnable and lower identically on hardware
-    flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
-    rs0 = _build_reduce_scatter(n0, "_t", blk0, dtype_str, interpret,
+    # stay interpreter-runnable and lower identically on hardware.
+    # Transpose the device grid into ``axes`` order first — the sub-ring
+    # arithmetic assumes a0-major linearization, and axes=("y","x") on
+    # an ("x","y") mesh would otherwise still sum correctly but walk
+    # non-neighbor ICI links
+    devs = np.asarray(mesh.devices)
+    order = tuple(mesh.axis_names.index(a) for a in (a0, a1))
+    devs = np.transpose(devs, order + tuple(
+        i for i in range(devs.ndim) if i not in order))
+    flat_mesh = Mesh(devs.reshape(-1), ("_t",))
+    rs0 = _build_reduce_scatter(n0, "_t", rows0, dtype_str, interpret,
                                 op, sub=(n0, n1, 0))
-    ar1 = _build_all_reduce(n1, "_t", blk1, dtype_str, interpret, op,
+    ar1 = _build_all_reduce(n1, "_t", rows1, dtype_str, interpret, op,
                             sub=(n0, n1, 1))
-    ag0 = _build_all_gather(n0, "_t", (blk0,), dtype_str, interpret,
-                            sub=(n0, n1, 0))
+    ag0 = _build_all_gather(n0, "_t", (rows0, 128), dtype_str,
+                            interpret, sub=(n0, n1, 0))
     pad = _pad_value(op, dtype_str)
 
     def body(t):                       # t: (1, *S)
         flat = t.reshape(-1)
-        if blk0 * n0 != size:
-            flat = jnp.pad(flat, (0, blk0 * n0 - size),
+        if rows0 * 128 * n0 != size:
+            flat = jnp.pad(flat, (0, rows0 * 128 * n0 - size),
                            constant_values=pad)
-        part = rs0(flat.reshape(n0, blk0))         # (blk0,) over a0
-        if blk1 * n1 != blk0:
-            part = jnp.pad(part, (0, blk1 * n1 - blk0),
-                           constant_values=pad)
-        red = ar1(part.reshape(n1, blk1)).reshape(-1)[:blk0]  # over a1
-        full = ag0(red)                            # (n0, blk0) over a0
+        part = rs0(flat.reshape(n0, rows0, 128))  # (rows0, 128) over a0
+        pflat = part.reshape(-1)
+        if rows1 * 128 * n1 != size1:
+            pflat = jnp.pad(pflat, (0, rows1 * 128 * n1 - size1),
+                            constant_values=pad)
+        red = ar1(pflat.reshape(n1, rows1, 128))  # over a1
+        red = red.reshape(-1)[:size1].reshape(rows0, 128)
+        full = ag0(red)                           # (n0, rows0, 128)
         return full.reshape(-1)[:size].reshape(payload_shape)
 
     return jax.jit(shard_map(body, mesh=flat_mesh, in_specs=P("_t"),
@@ -1254,16 +1507,16 @@ def _jit_bcast(mesh, axis: str, payload_shape, dtype_str: str,
 
     n = mesh.shape[axis]
     size = int(np.prod(payload_shape)) if payload_shape else 1
-    seg = min(seg_elems, size)
-    nseg = -(-size // seg)
-    padded = nseg * seg
-    inner = _build_bcast(n, axis, nseg, seg, dtype_str, interpret)
+    srows = max(1, min(seg_elems // 128, _rows_for(size)))
+    nseg = -(-_rows_for(size) // srows)
+    padded = nseg * srows * 128
+    inner = _build_bcast(n, axis, nseg, srows, dtype_str, interpret)
 
     def body(r, t):                    # r: (1,) int32; t: (1, *S)
         flat = t.reshape(-1)
         if padded != size:
             flat = jnp.pad(flat, (0, padded - size))
-        out = inner(r, flat.reshape(nseg, seg))   # (nseg, seg) = root's
+        out = inner(r, flat.reshape(nseg, srows, 128))  # root's rows
         return out.reshape(-1)[:size].reshape((1,) + payload_shape)
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(), P(axis)),

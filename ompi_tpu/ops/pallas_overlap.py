@@ -68,12 +68,13 @@ def _build_fused_matmul(n: int, axis: str, m_blk: int, k_loc: int,
     just-in-time block compute, and DMA/semaphore discipline either way
     (a fix to one schedule is a fix to both).
     """
-    jax, jnp, lax, pl, pltpu, cparams = _ring_kernels(n, axis, interpret)
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
 
     def kernel(a_ref, b_ref, out_ref, a_vmem, b_vmem, acc_ref, recv_ref,
                local_sem, send_sem, rs_sems, *maybe_ag_sems):
         my = lax.axis_index(axis)
         right = lax.rem(my + 1, n)
+        barrier(right, lax.rem(my - 1 + n, n))
         # operands land in VMEM first: compute dereferences need VMEM
         # residency on hardware (ANY-space inputs may live in HBM)
         ca = pltpu.make_async_copy(a_ref, a_vmem, local_sem)
