@@ -54,8 +54,8 @@ def main() -> None:
         if var is None:
             raise SystemExit("coll/pallas did not register its vars "
                              "(component excluded?)")
-        old = var._value
-        var._value = 95
+        old = var.value
+        var.set(95)       # the MPI_T-style cvar write API
         rt.reset_for_testing()
         try:
             w2 = ompi_tpu.init()
@@ -69,7 +69,7 @@ def main() -> None:
                 b, np.broadcast_to(x[n - 1], x.shape), rtol=1e-6)
             print(f"allreduce + pipelined bcast via {owner}: ok")
         finally:
-            var._value = old
+            var.set(old)
             rt.reset_for_testing()
             ompi_tpu.init()
 

@@ -130,6 +130,7 @@ class File:
         self.filetype: Datatype = BYTE
         self._fp = 0               # individual pointer, etype units
         self._sfp_key = f"__sfp__:{os.path.abspath(filename)}"
+        self._split = None         # active split collective (kind, end)
 
     # -- open / close -----------------------------------------------------
     @classmethod
@@ -363,6 +364,88 @@ class File:
         self._advance(buf, len(data))
         return self._from_stream(data, buf)
 
+    # -- split collectives (MPI_File_read_all_begin/end family) ----------
+    # The reference carries these as begin/end halves over its two-phase
+    # collective engine (``ompi/mpi/c/file_read_all_begin.c`` ->
+    # ``mca_common_ompio_file_read_all_begin``).  Here the collective
+    # engine is synchronous, so *begin* runs the collective and parks
+    # the delivery while *end* hands it to the caller — the standard's
+    # contract is what matters and is enforced: one outstanding split
+    # collective per handle, matching end call, same buffer at end.
+
+    def _assert_no_split(self) -> None:
+        """Must run BEFORE a begin's I/O: a rejected begin must not
+        have touched the file or advanced any pointer."""
+        if self._split is not None:
+            raise RuntimeError(
+                f"split collective {self._split[0]}_begin already "
+                "active: MPI allows one outstanding split collective "
+                "per file handle")
+
+    def _split_begin(self, kind: str, buf, finish) -> None:
+        self._assert_no_split()
+        self._split = (kind, buf, finish)
+
+    def _split_end(self, kind: str, buf):
+        self._check()
+        if self._split is None:
+            raise RuntimeError(f"{kind}_end without {kind}_begin")
+        active, begin_buf, finish = self._split
+        if active != kind:
+            raise RuntimeError(
+                f"{kind}_end does not match active split collective "
+                f"{active}_begin")
+        if begin_buf is not buf:
+            raise RuntimeError(
+                f"{kind}_end must receive the same buffer passed to "
+                f"{kind}_begin")
+        self._split = None
+        return finish()
+
+    def read_all_begin(self, buf) -> None:
+        self._check()
+        self._assert_no_split()
+        data = self.io_module.read_at_all(self, self._fp,
+                                          _stream_nbytes(buf))
+        self._advance(buf, len(data))
+        self._split_begin("read_all", buf,
+                          lambda: self._from_stream(data, buf))
+
+    def read_all_end(self, buf) -> int:
+        return self._split_end("read_all", buf)
+
+    def write_all_begin(self, buf) -> None:
+        self._check()
+        self._assert_no_split()
+        data, _ = self._to_stream(buf)
+        n = self.io_module.write_at_all(self, self._fp, data)
+        self._advance(buf, len(data))
+        self._split_begin("write_all", buf, lambda: n)
+
+    def write_all_end(self, buf) -> int:
+        return self._split_end("write_all", buf)
+
+    def read_at_all_begin(self, offset: int, buf) -> None:
+        self._check()
+        self._assert_no_split()
+        data = self.io_module.read_at_all(self, offset,
+                                          _stream_nbytes(buf))
+        self._split_begin("read_at_all", buf,
+                          lambda: self._from_stream(data, buf))
+
+    def read_at_all_end(self, buf) -> int:
+        return self._split_end("read_at_all", buf)
+
+    def write_at_all_begin(self, offset: int, buf) -> None:
+        self._check()
+        self._assert_no_split()
+        data, _ = self._to_stream(buf)
+        n = self.io_module.write_at_all(self, offset, data)
+        self._split_begin("write_at_all", buf, lambda: n)
+
+    def write_at_all_end(self, buf) -> int:
+        return self._split_end("write_at_all", buf)
+
     def seek(self, offset: int, whence: int = SEEK_SET) -> None:
         self._check()
         if whence == SEEK_SET:
@@ -417,6 +500,62 @@ class File:
         pos = self._shared_fetch_add(n_et)
         data = self.io_module.read_at(self, pos, nbytes)
         return self._from_stream(data, buf)
+
+    # -- ordered shared-pointer collectives (MPI_File_read_ordered) ------
+    def _ordered_pos(self, nbytes: int) -> int:
+        """Collective rank-ordered carve-out of the shared pointer:
+        every rank learns everyone's element count, rank 0 advances the
+        shared counter once by the total, and each rank's region starts
+        at the old value plus the counts of the ranks before it — the
+        reference's sharedfp ordered algorithm
+        (``ompio/sharedfp/base``) on the coord-backed counter."""
+        n_et = -(-nbytes // max(1, self.etype.size))
+        if self.comm is None or self.comm.size == 1:
+            return self._shared_fetch_add(n_et)
+        counts = np.asarray(self.comm.allgather(
+            np.array([n_et], np.int64))).reshape(-1)
+        rank = self.comm.rank
+        base = np.zeros(1, np.int64)
+        if rank == 0:
+            base[0] = self._shared_fetch_add(int(counts.sum()))
+        base = np.asarray(self.comm.bcast(base, root=0)).reshape(-1)
+        return int(base[0]) + int(counts[:rank].sum())
+
+    def read_ordered(self, buf) -> int:
+        self._check()
+        nbytes = _stream_nbytes(buf)
+        pos = self._ordered_pos(nbytes)
+        data = self.io_module.read_at(self, pos, nbytes)
+        return self._from_stream(data, buf)
+
+    def write_ordered(self, buf) -> int:
+        self._check()
+        data, _ = self._to_stream(buf)
+        pos = self._ordered_pos(len(data))
+        return self.io_module.write_at(self, pos, data)
+
+    def read_ordered_begin(self, buf) -> None:
+        self._check()
+        self._assert_no_split()
+        nbytes = _stream_nbytes(buf)
+        pos = self._ordered_pos(nbytes)
+        data = self.io_module.read_at(self, pos, nbytes)
+        self._split_begin("read_ordered", buf,
+                          lambda: self._from_stream(data, buf))
+
+    def read_ordered_end(self, buf) -> int:
+        return self._split_end("read_ordered", buf)
+
+    def write_ordered_begin(self, buf) -> None:
+        self._check()
+        self._assert_no_split()
+        data, _ = self._to_stream(buf)
+        pos = self._ordered_pos(len(data))
+        n = self.io_module.write_at(self, pos, data)
+        self._split_begin("write_ordered", buf, lambda: n)
+
+    def write_ordered_end(self, buf) -> int:
+        return self._split_end("write_ordered", buf)
 
     def seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
         """Collective in MPI; here any rank may reset the shared counter."""

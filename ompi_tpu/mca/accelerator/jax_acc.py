@@ -114,7 +114,11 @@ class _StagingPool:
                      # the LRU would flush every warm buffer first
         key = self._key(buf.shape, buf.dtype)
         with self._lock:
-            self._free.setdefault(key, []).append(buf)
+            lst = self._free.setdefault(key, [])
+            if any(b is buf for b in lst):
+                return   # double release: pooling the same ndarray
+                         # twice would alias two later acquires
+            lst.append(buf)
             self._free.move_to_end(key)
             self._bytes += buf.nbytes
             while self._bytes > self.max_bytes and self._free:

@@ -211,17 +211,40 @@ class XlaCollModule:
     def reduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM,
                      root: int = 0):
         """Reduction lands in root's row; other rows are zero (their
-        content is undefined per MPI — zeros make misuse visible)."""
+        content is undefined per MPI — zeros make misuse visible).
+
+        Binomial ppermute tree toward root (the device-native shape of
+        ``coll_base_reduce.c``'s binomial algorithm): log2(n) halving
+        rounds, each sender transmitting its partial exactly once, so
+        total wire traffic is (n-1)·S — an allreduce-then-mask would
+        move ~2x that and an all_gather construction n²·S."""
         import jax
         import jax.numpy as jnp
 
         P = self._P
-        reduce_body = self._reduce_in_shard(op)
+        n, ax = self.n, self.axis
+        fold = op_mod.jax_fold(op, None)
 
         def body(t):  # (1, *S)
-            r = reduce_body(t[0])
-            me = jax.lax.axis_index(self.axis)
-            return jnp.where(me == root, r, jnp.zeros_like(r))[None]
+            me = jax.lax.axis_index(ax)
+            rel = jnp.mod(me - root, n)
+            cur = t[0]
+            k = 1
+            while k < n:           # largest power of two below n
+                k *= 2
+            k //= 2
+            while k >= 1:
+                # senders rel in [k, min(2k, n)) -> receivers rel - k;
+                # after the round the active set halves to [0, k)
+                pairs = [((root + r) % n, (root + r - k) % n)
+                         for r in range(k, min(2 * k, n))]
+                recvd = jax.lax.ppermute(cur, ax, pairs)
+                # ppermute delivers zeros to non-targets: mask the fold
+                # (max/min/prod would corrupt on a zero fill)
+                is_recv = (rel < k) & (rel + k < n)
+                cur = jnp.where(is_recv, fold(cur, recvd), cur)
+                k //= 2
+            return jnp.where(me == root, cur, jnp.zeros_like(cur))[None]
 
         fn, x = self._get(
             comm, self._keyfor("reduce", x, op, root), x,
@@ -296,7 +319,15 @@ class XlaCollModule:
         return [full[i, :counts[i]] for i in range(self.n)]
 
     def gather_array(self, comm, x, root: int = 0):
-        """Gathered rows land at root; non-root rows are zero."""
+        """Gathered rows land at root; non-root rows are zero.
+
+        Binomial ppermute tree toward root (``coll_base_gather.c``
+        binomial): at round k each sender forwards its accumulated
+        k-block subtree window once, so total wire traffic is
+        O(n·log n·S/2) — an all_gather-then-mask would move n²·S.  The
+        window is a static (k, *S) slice per round (XLA needs static
+        shapes); boundary subtrees clamp identically on both sides of a
+        pair and the overlap adds zeros, so the add-paste is exact."""
         import jax
         import jax.numpy as jnp
 
@@ -304,9 +335,32 @@ class XlaCollModule:
         n, ax = self.n, self.axis
 
         def body(t):  # (1, *S) -> (1, n, *S)
-            g = jax.lax.all_gather(t[0], ax)
             me = jax.lax.axis_index(ax)
-            return jnp.where(me == root, g, jnp.zeros_like(g))[None]
+            rel = jnp.mod(me - root, n)
+            zero_starts = (0,) * (t.ndim - 1)
+            buf = jnp.zeros((n,) + t.shape[1:], t.dtype)
+            buf = jax.lax.dynamic_update_slice(
+                buf, t, (rel,) + zero_starts)   # my block at slot rel
+            k = 1
+            while k < n:
+                # senders rel ≡ k (mod 2k) own the k-block window
+                # [rel, rel+k); the receiver rel-k pastes it at the
+                # same global slots.  dynamic_slice clamps both sides
+                # to n-k in lockstep (receiver start rel+k == sender
+                # start), and clamp-overlapped slots are still zero on
+                # the sending side, so buf + contrib never collides.
+                pairs = [((root + r) % n, (root + r - k) % n)
+                         for r in range(k, n, 2 * k)]
+                win = jax.lax.dynamic_slice(
+                    buf, (rel,) + zero_starts, (k,) + t.shape[1:])
+                recvd = jax.lax.ppermute(win, ax, pairs)
+                contrib = jax.lax.dynamic_update_slice(
+                    jnp.zeros_like(buf), recvd,
+                    (rel + k,) + zero_starts)
+                buf = buf + contrib   # non-receivers add ppermute zeros
+                k *= 2
+            out = jnp.roll(buf, root, axis=0)   # slot rel -> rank order
+            return jnp.where(me == root, out, jnp.zeros_like(out))[None]
 
         fn, x = self._get(
             comm, self._keyfor("gather", x, root), x,

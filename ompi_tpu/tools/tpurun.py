@@ -2,28 +2,257 @@
 
 The reference's ``mpirun`` is a symlink to PRRTE's ``prte``
 (``ompi/tools/mpirun/Makefile.am:3-7``): it launches processes and gives
-them a PMIx server.  tpurun does the same for one host: starts the
-coordination service (``ompi_tpu.rte.coord.CoordServer``), spawns N ranks
-with identity in the environment, streams their output with rank prefixes,
+them a PMIx server.  tpurun does the same: starts the coordination
+service (``ompi_tpu.rte.coord.CoordServer``), spawns N ranks with
+identity in the environment, streams their output with rank prefixes,
 and tears the job down on first failure (mpirun's kill-job-on-abort
-behavior).  Multi-host launch composes this with any remote executor (ssh,
-k8s, slurm) pointing OTPU_COORD at rank 0's server.
+behavior).
+
+Multi-host launch (``--hostfile``) composes this the way mpirun's
+ssh/rsh plm does (``prte`` launching remote daemons): the head parses
+the hostfile, assigns ranks to hosts byslot, binds the coord service on
+a routable interface, and drives one *child launcher* per remote host
+through the launch agent (``ssh`` by default) —
+``tpurun --child-of HEAD:PORT --ranks 4,5,…`` — which spawns its local
+ranks with ``OTPU_COORD`` pointing back at the head.  Rank output flows
+back through the agent's stdout.  ``--launch-agent local`` runs the
+child launchers as plain subprocesses, exercising the identical
+head/child protocol without sshd (CI; emulated multi-node).
 """
 from __future__ import annotations
 
 import argparse
 import os
-import signal
+import shlex
+import socket
 import subprocess
 import sys
 import threading
 import time
 
 
+def _parse_hostfile(path: str) -> list:
+    """mpirun hostfile lines: ``host [slots=N]``; # comments."""
+    hosts = []
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            slots = 1
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
+                    slots = int(tok.split("=", 1)[1])
+            hosts.append((parts[0], slots))
+    if not hosts:
+        raise SystemExit(f"tpurun: hostfile {path!r} lists no hosts")
+    return hosts
+
+
+def _assign_ranks(hosts: list, nprocs: int, oversubscribe: bool) -> list:
+    """Byslot assignment (mpirun's default RMAPS policy): fill each
+    host's slots in hostfile order; ``--oversubscribe`` wraps around."""
+    total = sum(s for _, s in hosts)
+    if total == 0:
+        raise SystemExit("tpurun: hostfile has zero total slots")
+    if nprocs > total and not oversubscribe:
+        raise SystemExit(
+            f"tpurun: {nprocs} ranks exceed {total} hostfile slots "
+            "(use --oversubscribe, like mpirun)")
+    out = [[] for _ in hosts]
+    r = 0
+    while r < nprocs:
+        for i, (_, slots) in enumerate(hosts):
+            take = min(slots, nprocs - r)
+            out[i].extend(range(r, r + take))
+            r += take
+            if r >= nprocs:
+                break
+    return out
+
+
+_LOCAL_NAMES = ("localhost", "127.0.0.1", "::1")
+
+
+def _is_local_host(host: str) -> bool:
+    return (host in _LOCAL_NAMES or host == socket.gethostname()
+            or host == socket.getfqdn())
+
+
+def _monitor(procs_list, rank_of, *, enable_recovery: bool, label: str,
+             on_fail=None, abort_check=None) -> int:
+    """ONE monitor loop for head and child launchers (they must never
+    diverge on failure policy): poll children; without recovery the
+    first nonzero exit ends the job with that code; with recovery each
+    death is reported once via ``on_fail(rank, rc)`` and the group
+    keeps running (job fails only if nothing succeeded).
+    ``abort_check()`` may return an exit code for out-of-band aborts
+    (the head's coord-service MPI_Abort path)."""
+    exit_code = 0
+    reported: set = set()
+    try:
+        while True:
+            snapshot = list(procs_list)
+            alive = [p for p in snapshot if p.poll() is None]
+            failed = [p for p in snapshot
+                      if p.poll() is not None and p.returncode != 0]
+            if abort_check is not None:
+                code = abort_check()
+                if code is not None:
+                    exit_code = code
+                    break
+            if failed:
+                if enable_recovery:
+                    for p in failed:
+                        rank = rank_of(p)
+                        if rank not in reported:
+                            reported.add(rank)
+                            print(f"{label}: rank {rank} failed (exit "
+                                  f"{p.returncode}); continuing "
+                                  "(recovery)", file=sys.stderr)
+                            if on_fail is not None:
+                                on_fail(rank, p.returncode)
+                else:
+                    exit_code = failed[0].returncode
+                    break
+            if not alive:
+                if enable_recovery and snapshot and not any(
+                        p.returncode == 0 for p in snapshot):
+                    # recovery mode, but nothing survived to completion
+                    exit_code = next(p.returncode for p in snapshot
+                                     if p.returncode != 0)
+                break
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        exit_code = 130
+    return exit_code
+
+
+def _teardown(procs_list, pumps, exit_code: int) -> None:
+    """Shared job teardown: kill survivors on failure (mpirun's
+    kill-job-on-abort), drain cleanly on success, join the pumps."""
+    for p in procs_list:
+        if p.poll() is None:
+            if exit_code:
+                p.kill()
+            else:
+                p.wait()
+    for p in procs_list:
+        p.wait()
+    for t in pumps:
+        t.join(timeout=2)
+
+
+def _child_main(args, cmd) -> int:
+    """Child-launcher mode (``--child-of``): the per-host daemon of the
+    multi-host launch — spawn this host's rank subset with OTPU_COORD
+    pointing at the head's coord service, stream rank-prefixed output
+    (the head passes it through verbatim), and mirror the head's
+    failure policy: first failure tears the local group down (the head
+    then sees our nonzero exit), or with --enable-recovery each death
+    is published as a proc_failed event and the group keeps running."""
+    ranks = [int(r) for r in args.ranks.split(",") if r != ""]
+    env_base = dict(os.environ)
+    import ompi_tpu as _pkg
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(_pkg.__file__)))
+    env_base["PYTHONPATH"] = (
+        env_base["PYTHONPATH"] + os.pathsep + pkg_root
+        if env_base.get("PYTHONPATH") else pkg_root)
+    env_base["OTPU_NPROCS"] = str(args.nprocs)
+    env_base["OTPU_COORD"] = args.child_of
+    if args.node_id:
+        env_base["OTPU_NODE_ID"] = args.node_id
+    if not args.with_tpu:
+        env_base.pop("PALLAS_AXON_POOL_IPS", None)
+        env_base["JAX_PLATFORMS"] = "cpu"
+    for name, value in args.mca:
+        env_base["OTPU_MCA_" + name.removeprefix("otpu_")] = value
+
+    procs: dict[subprocess.Popen, int] = {}
+    pumps = []
+
+    def _pump(rank: int, stream) -> None:
+        for line in iter(stream.readline, b""):
+            sys.stdout.write(f"[{rank}] {line.decode(errors='replace')}")
+            sys.stdout.flush()
+
+    for rank in ranks:
+        env = dict(env_base)
+        env["OTPU_RANK"] = str(rank)
+        if args.bind_to != "none":
+            env["OTPU_BIND_POLICY"] = args.bind_to
+            env["OTPU_LOCAL_NRANKS"] = str(len(ranks))
+        try:
+            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+        except OSError as exc:
+            print(f"tpurun[child]: cannot launch {cmd[0]!r}: {exc}",
+                  file=sys.stderr)
+            for q in procs:
+                q.kill()
+            return 127
+        procs[p] = rank
+        t = threading.Thread(target=_pump, args=(rank, p.stdout),
+                             daemon=True)
+        t.start()
+        pumps.append(t)
+
+    def publish_failed(rank: int, rc: int) -> None:
+        try:
+            from ompi_tpu.rte.coord import CoordClient
+
+            # args.child_of is the head's address: OTPU_COORD lives
+            # only in the ranks' env, not this launcher's os.environ
+            h, _, prt = args.child_of.rpartition(":")
+            c = CoordClient(addr=(h, int(prt)))
+            c.event_publish("proc_failed",
+                            {"rank": rank, "origin": "launcher"})
+            c.close()
+        except Exception as exc:
+            print(f"tpurun[child]: failure publish failed: {exc}",
+                  file=sys.stderr)
+
+    exit_code = _monitor(
+        procs, procs.__getitem__,
+        enable_recovery=args.enable_recovery,
+        label="tpurun[child]", on_fail=publish_failed)
+    _teardown(list(procs), pumps, exit_code)
+    return exit_code
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpurun", description="Launch an ompi_tpu multi-process job")
     ap.add_argument("-n", "-np", type=int, default=1, dest="nprocs")
+    ap.add_argument("--hostfile", default=None,
+                    help="Multi-host launch: 'host [slots=N]' per line "
+                         "(mpirun hostfile format); remote hosts get a "
+                         "child launcher via --launch-agent")
+    ap.add_argument("--launch-agent", default="ssh -o BatchMode=yes",
+                    dest="launch_agent",
+                    help="Command that runs the child launcher on a "
+                         "remote host ('<agent> <host> <command>'); the "
+                         "special value 'local' runs child launchers as "
+                         "plain subprocesses (emulated multi-node / CI)")
+    ap.add_argument("--coord-host", default=None,
+                    help="Address remote ranks use to reach the coord "
+                         "service (default: this host's primary address "
+                         "when a hostfile names remote hosts)")
+    ap.add_argument("--remote-python", default=None,
+                    help="Python interpreter for child launchers "
+                         "(default: this interpreter for 'local' agent, "
+                         "python3 over ssh)")
+    ap.add_argument("--wdir", default=None,
+                    help="Working directory child launchers cd into "
+                         "(default over ssh: current directory)")
+    ap.add_argument("--oversubscribe", action="store_true",
+                    help="Allow more ranks than hostfile slots")
+    # internal: child-launcher mode (one per remote host)
+    ap.add_argument("--child-of", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--ranks", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--node-id", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--mca", action="append", nargs=2, default=[],
                     metavar=("NAME", "VALUE"),
                     help="Set an MCA variable for all ranks")
@@ -57,10 +286,30 @@ def main(argv=None) -> int:
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
 
+    if args.child_of:
+        return _child_main(args, cmd)
+
     from ompi_tpu.rte.coord import CoordServer
 
-    server = CoordServer(args.nprocs, port=args.coord_port)
-    host, port = server.addr
+    hosts = rank_groups = None
+    if args.hostfile:
+        hosts = _parse_hostfile(args.hostfile)
+        rank_groups = _assign_ranks(hosts, args.nprocs,
+                                    args.oversubscribe)
+        any_remote = (args.launch_agent != "local"
+                      and any(not _is_local_host(h) for h, _ in hosts))
+        # remote ranks must reach the coord service: bind every
+        # interface and advertise a routable address instead of loopback
+        bind = "0.0.0.0" if any_remote else "127.0.0.1"
+        server = CoordServer(args.nprocs, host=bind,
+                             port=args.coord_port)
+        port = server.addr[1]
+        host = args.coord_host or (
+            socket.gethostbyname(socket.gethostname()) if any_remote
+            else "127.0.0.1")
+    else:
+        server = CoordServer(args.nprocs, port=args.coord_port)
+        host, port = server.addr
 
     env_base = dict(os.environ)
     # Ranks must be able to import ompi_tpu no matter how tpurun itself was
@@ -80,20 +329,25 @@ def main(argv=None) -> int:
         env_base["OTPU_MCA_" + name.removeprefix("otpu_")] = value
 
     procs: list[subprocess.Popen] = []
-    proc_rank: dict = {}            # Popen -> global rank
+    proc_rank: dict = {}            # Popen -> global rank | node label
     pumps: list[threading.Thread] = []
 
-    def _pump(rank: int, stream) -> None:
+    def _pump(rank, stream) -> None:
+        # child launchers (rank None) already prefix their ranks: raw
+        prefix = "" if rank is None else f"[{rank}] "
         for line in iter(stream.readline, b""):
-            sys.stdout.write(f"[{rank}] {line.decode(errors='replace')}")
+            sys.stdout.write(prefix + line.decode(errors="replace"))
             sys.stdout.flush()
 
-    def _launch(rank: int, env: dict, argv=None) -> subprocess.Popen:
+    def _launch(rank, env: dict, argv=None) -> subprocess.Popen:
         p = subprocess.Popen(argv or cmd, env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
         proc_rank[p] = rank       # before append: the monitor loop reads
         procs.append(p)           # proc_rank for any proc it can see
-        t = threading.Thread(target=_pump, args=(rank, p.stdout), daemon=True)
+        t = threading.Thread(
+            target=_pump,
+            args=(rank if isinstance(rank, int) else None, p.stdout),
+            daemon=True)
         t.start()
         pumps.append(t)
         return p
@@ -124,73 +378,80 @@ def main(argv=None) -> int:
 
     server.set_spawn_handler(_spawn_handler)
 
-    for rank in range(args.nprocs):
-        env = dict(env_base)
-        env["OTPU_RANK"] = str(rank)
-        if args.bind_to != "none":
-            env["OTPU_BIND_POLICY"] = args.bind_to
-            env["OTPU_LOCAL_NRANKS"] = str(args.nprocs)
-        if args.fake_nodes > 0:
-            env["OTPU_NODE_ID"] = f"node{rank * args.fake_nodes // args.nprocs}"
-        try:
-            _launch(rank, env)
-        except OSError as exc:
-            print(f"tpurun: cannot launch {cmd[0]!r}: {exc}", file=sys.stderr)
-            for q in procs:
-                q.kill()
-            server.close()
-            return 127
-
-    exit_code = 0
-    reported_failed: set = set()
-    try:
-        while True:
-            snapshot = list(procs)
-            alive = [p for p in snapshot if p.poll() is None]
-            failed = [p for p in snapshot
-                      if p.poll() is not None and p.returncode != 0]
-            if server.aborted is not None:
-                exit_code = server.aborted
-                break
-            if failed:
-                if args.enable_recovery:
-                    # ULFM: report the death, keep the job running — the
-                    # PRRTE-daemon-detects-child-death path of the reference
-                    for p in failed:
-                        rank = proc_rank[p]
-                        if rank not in reported_failed:
-                            reported_failed.add(rank)
-                            print(f"tpurun: rank {rank} failed (exit "
-                                  f"{p.returncode}); continuing (recovery)",
-                                  file=sys.stderr)
-                            server.publish("proc_failed",
-                                           {"rank": rank, "origin": "launcher"})
-                else:
-                    exit_code = failed[0].returncode
-                    break
-            if not alive:
-                if args.enable_recovery and not any(
-                        p.returncode == 0 for p in snapshot):
-                    # recovery mode, but nothing survived to completion:
-                    # the job as a whole failed
-                    exit_code = next(p.returncode for p in procs
-                                     if p.returncode != 0)
-                break
-            time.sleep(0.05)
-    except KeyboardInterrupt:
-        exit_code = 130
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                if exit_code:
-                    p.kill()  # job teardown on failure, like mpirun
-                else:
-                    p.wait()
-        for p in procs:
-            p.wait()
-        for t in pumps:
-            t.join(timeout=2)
+    def _abort_launch(what: str, exc) -> int:
+        print(f"tpurun: cannot launch {what!r}: {exc}", file=sys.stderr)
+        for q in procs:
+            q.kill()
         server.close()
+        return 127
+
+    if args.hostfile:
+        # one child launcher per hostfile entry (the ssh plm's remote
+        # daemon); each spawns its rank subset against our coord addr
+        for (host_name, _), ranks in zip(hosts, rank_groups):
+            if not ranks:
+                continue
+            run_local = (args.launch_agent == "local"
+                         or _is_local_host(host_name))
+            # locally-executed children keep THIS interpreter (venv);
+            # only a genuinely remote host falls back to PATH's python3
+            rpy = args.remote_python or (
+                sys.executable if run_local else "python3")
+            child = [rpy, "-m", "ompi_tpu.tools.tpurun",
+                     "--child-of", f"{host}:{port}",
+                     "--ranks", ",".join(str(r) for r in ranks),
+                     "-n", str(args.nprocs), "--node-id", host_name]
+            if args.enable_recovery:
+                child.append("--enable-recovery")
+            if args.with_tpu:
+                child.append("--with-tpu")
+            if args.bind_to != "none":
+                child += ["--bind-to", args.bind_to]
+            for name, value in args.mca:
+                child += ["--mca", name, value]
+            child += ["--"] + cmd
+            if run_local:
+                argv_full = child
+            else:
+                wdir = args.wdir or os.getcwd()
+                argv_full = args.launch_agent.split() + [
+                    host_name,
+                    f"cd {shlex.quote(wdir)} && {shlex.join(child)}"]
+            try:
+                _launch(f"node:{host_name}", env_base, argv=argv_full)
+            except OSError as exc:
+                return _abort_launch(argv_full[0], exc)
+    else:
+        for rank in range(args.nprocs):
+            env = dict(env_base)
+            env["OTPU_RANK"] = str(rank)
+            if args.bind_to != "none":
+                env["OTPU_BIND_POLICY"] = args.bind_to
+                env["OTPU_LOCAL_NRANKS"] = str(args.nprocs)
+            if args.fake_nodes > 0:
+                env["OTPU_NODE_ID"] = \
+                    f"node{rank * args.fake_nodes // args.nprocs}"
+            try:
+                _launch(rank, env)
+            except OSError as exc:
+                return _abort_launch(cmd[0], exc)
+
+    def publish_failed(rank, rc) -> None:
+        # ULFM: report the death, keep the job running — the
+        # PRRTE-daemon-detects-child-death path of the reference.
+        # Child launchers publish their OWN ranks' failures; a dead
+        # child launcher (non-int label) is only reported.
+        if isinstance(rank, int):
+            server.publish("proc_failed",
+                           {"rank": rank, "origin": "launcher"})
+
+    exit_code = _monitor(
+        procs, proc_rank.__getitem__,
+        enable_recovery=args.enable_recovery, label="tpurun",
+        on_fail=publish_failed,
+        abort_check=lambda: server.aborted)
+    _teardown(procs, pumps, exit_code)
+    server.close()
     if exit_code:
         print(f"tpurun: job terminated with exit code {exit_code}",
               file=sys.stderr)

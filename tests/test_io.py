@@ -357,3 +357,96 @@ def test_fcoll_domain_partitioning_unit():
     # routing splits a run crossing the cut
     pieces = list(OmpioModule._route(edges, 900, 200))
     assert sum(t for _, _, t in pieces) == 200
+
+
+def test_split_collectives(tmp_path):
+    """MPI_File_*_all_begin/end semantics: one outstanding split
+    collective per handle, matching end, same buffer at end
+    (``ompi/mpi/c/file_read_all_begin.c`` family)."""
+    from ompi_tpu.api import file as fmod
+
+    path = str(tmp_path / "split.bin")
+    f = fmod.File.open(None, path, fmod.MODE_CREATE | fmod.MODE_RDWR)
+    data = np.arange(8, dtype=np.int32)
+    f.write_all_begin(data)
+    with pytest.raises(RuntimeError):       # one outstanding per handle
+        f.write_all_begin(data)
+    with pytest.raises(RuntimeError):       # mismatched end kind
+        f.read_all_end(data)
+    assert f.write_all_end(data) == data.nbytes
+    with pytest.raises(RuntimeError):       # end without begin
+        f.write_all_end(data)
+
+    f.seek(0)
+    out = np.zeros_like(data)
+    f.read_all_begin(out)
+    with pytest.raises(RuntimeError):       # wrong buffer at end
+        f.read_all_end(np.zeros_like(data))
+    f.read_all_end(out)
+    np.testing.assert_array_equal(out, data)
+
+    # at-variants do not move the individual pointer
+    fp_before = f.get_position()
+    two = (data * 2).copy()
+    f.write_at_all_begin(0, two)
+    f.write_at_all_end(two)
+    back = np.zeros_like(data)
+    f.read_at_all_begin(0, back)
+    f.read_at_all_end(back)
+    np.testing.assert_array_equal(back, two)
+    assert f.get_position() == fp_before
+    f.close()
+
+
+def test_ordered_single_process(tmp_path):
+    from ompi_tpu.api import file as fmod
+
+    path = str(tmp_path / "ordered.bin")
+    f = fmod.File.open(None, path, fmod.MODE_CREATE | fmod.MODE_RDWR)
+    a = np.arange(4, dtype=np.float32)
+    b = np.arange(4, 8, dtype=np.float32)
+    assert f.write_ordered(a) == a.nbytes   # appends at shared pointer
+    f.write_ordered_begin(b)
+    assert f.write_ordered_end(b) == b.nbytes
+    f.seek_shared(0)
+    out = np.zeros(8, np.float32)
+    f.read_ordered_begin(out)
+    f.read_ordered_end(out)
+    np.testing.assert_array_equal(out, np.arange(8, dtype=np.float32))
+    f.close()
+
+
+def test_mp_ordered_collective(tmp_path):
+    """read/write_ordered across 4 ranks: rank-ordered disjoint regions
+    from ONE shared-pointer carve-out (sharedfp ordered algorithm)."""
+    path = tmp_path / "ordered_mp.dat"
+    script = tmp_path / "ordered_mp.py"
+    script.write_text(textwrap.dedent(f"""
+        import numpy as np, ompi_tpu
+        from ompi_tpu.api.file import File
+        w = ompi_tpu.init()
+        r = w.rank
+        f = File.open(w, {str(path)!r}, "c+")
+        # ragged per-rank records: rank r writes r+1 floats of value r
+        rec = np.full(r + 1, float(r), np.float32)
+        f.write_ordered(rec)
+        w.barrier()
+        # the file must be rank-ordered: 0 | 1 1 | 2 2 2 | 3 3 3 3
+        whole = np.zeros(10, np.float32)
+        f.read_at(0, whole)
+        expect = np.concatenate([np.full(i + 1, float(i), np.float32)
+                                 for i in range(4)])
+        assert np.array_equal(whole, expect), whole
+        # ordered read: same carve-out discipline, everyone gets its own
+        # region back
+        f.seek_shared(0)
+        w.barrier()
+        mine = np.zeros(r + 1, np.float32)
+        f.read_ordered(mine)
+        assert np.array_equal(mine, rec), (r, mine)
+        f.close()
+        print(f"ordered io OK rank {{r}}")
+    """))
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("ordered io OK") == 4

@@ -87,3 +87,45 @@ def test_coll_demo_absent_by_default(fresh_runtime):
 
     w = ompi_tpu.init()
     assert not getattr(w.c_coll["allreduce"], "_demo_wrapped", False)
+
+
+def test_template_pml_disabled_by_default(fresh_runtime):
+    from ompi_tpu.base import mca
+
+    fw = mca.framework("pml")
+    fw.open()
+    names = [c.name for c in fw.available]
+    assert "template" not in names      # opt-in only, like pml/example
+    assert "ob1" in names
+    assert fw.select().name == "ob1"    # never outranks the real pml
+
+
+def test_template_pml_enabled_loopback(fresh_runtime):
+    from ompi_tpu.base import mca
+    from ompi_tpu.mca.pml.template import COMPONENT as tpl
+
+    fw = mca.framework("pml")
+    fw.discover()
+    registry.set("otpu_pml_template_enable", True)
+    try:
+        fw.open()
+        assert tpl in fw.available
+
+        class FakeComm:
+            cid = 0
+            rank = 0
+
+        pml = tpl.get_module(rte=None)
+        comm = FakeComm()
+        pml.add_comm(comm)
+        data = np.arange(6, dtype=np.float32)
+        pml.send(comm, data, dest=0, tag=9)
+        out = np.zeros(6, np.float32)
+        st = pml.recv(comm, out, source=-1, tag=-1)   # wildcards match
+        assert (st.source, st.tag) == (0, 9)
+        np.testing.assert_array_equal(out, data)
+        with pytest.raises(RuntimeError):
+            pml.isend(comm, data, dest=1, tag=0)      # loopback only
+        pml.finalize()
+    finally:
+        registry.set("otpu_pml_template_enable", False)
