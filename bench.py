@@ -18,6 +18,7 @@ Set OTPU_BENCH_FAST=1 to skip everything but the primary metric.
 import json
 import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -191,6 +192,145 @@ class DeviceBench:
         return self._timed_pair("allreduce_persistent", h,
                                 self.raw_fn("allreduce"), x, x, nbytes,
                                 iters)
+
+
+#: bf16 peak FLOP/s by device_kind substring (public TPU specs); f32
+#: runs the MXU at half rate on these generations
+_CHIP_PEAK_BF16 = (
+    ("v6", 918e12), ("trillium", 918e12), ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def _chip_peak_flops(device_kind: str, dtype: str = "bf16"):
+    kind = (device_kind or "").lower()
+    for pat, bf16 in _CHIP_PEAK_BF16:
+        if pat in kind:
+            return bf16 if dtype == "bf16" else bf16 / 2.0
+    return None
+
+
+def mfu_rows() -> list:
+    """Single-chip MFU rows — achieved FLOP/s ÷ chip peak for (a) the
+    flagship train step (``__graft_entry__.entry``), (b) the pallas
+    flash-attention block kernel vs its jnp twin, (c) the MXU matmul
+    the fused GEMM-overlap kernel builds on.  The op/avx discipline
+    (``ompi/mca/op/avx/op_avx_functions.c``): keep the math at hardware
+    peak, and measure that claim.  Train-step FLOPs come from XLA's
+    cost analysis (not hand math); the pallas kernel's inner FLOPs are
+    invisible to XLA and use the closed-form attention count.  Off-TPU
+    the peak is unknowable: rows carry grade=dryrun and ``mfu: null``.
+    """
+    from ompi_tpu.base.jaxenv import apply_platform_env
+
+    apply_platform_env()   # JAX_PLATFORMS=cpu must beat any boot hook
+    import jax
+    import jax.numpy as jnp
+
+    rows = []
+    kind = getattr(jax.devices()[0], "device_kind", "?")
+    on_tpu = jax.default_backend() == "tpu"
+    grade = "device" if on_tpu else "dryrun"
+
+    def row(name, flops, secs, dtype, extra=None):
+        peak = _chip_peak_flops(kind, dtype) if on_tpu else None
+        achieved = flops / secs
+        r = {"metric": name, "grade": grade, "device_kind": kind,
+             "tflops": round(achieved / 1e12, 3),
+             "model_flops": int(flops),
+             "lat_us": round(secs * 1e6, 1),
+             "mfu": round(achieved / peak, 4) if peak else None}
+        if peak:
+            r["peak_tflops_assumed"] = round(peak / 1e12, 1)
+        if extra:
+            r.update(extra)
+        rows.append(r)
+        return r
+
+    # (a) flagship train step at bench scale: same program as the
+    # driver contract (__graft_entry__.entry -> parallel.dryrun), with
+    # OTPU_MODEL_SCALE raising the width/seq dims to MXU-saturating
+    # sizes — tracing-scale shapes would measure dispatch, not FLOPs
+    old_scale = os.environ.get("OTPU_MODEL_SCALE")
+    try:
+        os.environ["OTPU_MODEL_SCALE"] = os.environ.get(
+            "OTPU_BENCH_MODEL_SCALE", "64" if on_tpu else "4")
+        scale = int(os.environ["OTPU_MODEL_SCALE"])
+        from ompi_tpu.parallel.dryrun import make_step_and_args
+
+        fn, example_args, _ = make_step_and_args(jax.devices()[:1])
+        jfn = jax.jit(fn)
+        ca = jfn.lower(*example_args).compile().cost_analysis() or {}
+        flops = float(ca.get("flops", 0.0))
+        t = _time_fn(lambda a: jfn(*a), example_args, iters=10)
+        row("mfu_train_step", flops, t, "f32",
+            extra={"model_scale": scale})
+    except Exception as exc:
+        print(f"mfu: train step failed: {exc}", file=sys.stderr)
+    finally:
+        if old_scale is None:
+            os.environ.pop("OTPU_MODEL_SCALE", None)
+        else:
+            os.environ["OTPU_MODEL_SCALE"] = old_scale
+
+    # (b) flash-attention block kernel vs the jnp twin it replaces
+    try:
+        from ompi_tpu.ops import flash_attention as fa
+
+        b_, h, sq, skv, d = (4, 8, 2048, 2048, 128) if on_tpu \
+            else (1, 2, 256, 256, 128)   # interpreter is ~1000x slower
+        key = jax.random.PRNGKey(0)
+        dt = jnp.bfloat16 if on_tpu else jnp.float32
+        q = jax.random.normal(key, (b_, h, sq, d), dt)
+        k = jax.random.normal(key, (b_, h, skv, d), dt)
+        v = jax.random.normal(key, (b_, h, skv, d), dt)
+        m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+        num0 = jnp.zeros(q.shape, jnp.float32)
+        den0 = jnp.zeros(q.shape[:-1], jnp.float32)
+        # 2 MXU matmuls (qk^T, pv): 2 * 2*sq*skv*d each, per (b, h)
+        flops = 4.0 * b_ * h * sq * skv * d
+        flash = jax.jit(lambda a: fa.flash_block_update(*a))
+        t_flash = _time_fn(flash, (q, k, v, m0, num0, den0), iters=10)
+        jnp_twin = jax.jit(lambda a: fa._update_jnp(*a))
+        t_jnp = _time_fn(jnp_twin, (q, k, v, m0, num0, den0), iters=10)
+        row("mfu_flash_attention", flops, t_flash,
+            "bf16" if on_tpu else "f32",
+            extra={"vs_jnp_speedup": round(t_jnp / t_flash, 3)})
+    except Exception as exc:
+        print(f"mfu: flash attention failed: {exc}", file=sys.stderr)
+
+    # (c) the MXU phase of the fused GEMM-overlap kernel: a plain bf16
+    # matmul at benchmark size is its compute roofline
+    try:
+        mm = 4096 if on_tpu else 1024
+        a = jnp.ones((mm, mm), jnp.bfloat16)
+        bmat = jnp.ones((mm, mm), jnp.bfloat16)
+        f = jax.jit(lambda ab: ab[0] @ ab[1])
+        t = _time_fn(f, (a, bmat), iters=10)
+        row("mfu_matmul_bf16", 2.0 * mm ** 3, t, "bf16",
+            extra={"dim": mm})
+    except Exception as exc:
+        print(f"mfu: matmul failed: {exc}", file=sys.stderr)
+    return rows
+
+
+def mfu_rows_subprocess() -> list:
+    """Run ``--mfu`` in a fresh CPU-pinned interpreter and parse its
+    JSON lines — the tunnel-down-safe path (the parent process must
+    never import jax when the accelerator may hang the import)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mfu"],
+            env=env, cwd=here, capture_output=True, text=True,
+            timeout=900)
+        return [json.loads(ln) for ln in proc.stdout.splitlines()
+                if ln.startswith("{")]
+    except Exception as exc:
+        print(f"mfu subprocess failed: {exc}", file=sys.stderr)
+        return []
 
 
 def host_ring_smoke() -> dict:
@@ -632,9 +772,12 @@ def _atomic_write(path: str, text: str) -> None:
 
 
 def write_sweep(ndev, results, multidev_rows, header_note="",
-                stale_device_rows=None, stale_rounds=0) -> None:
+                stale_device_rows=None, stale_rounds=0,
+                mfu=None) -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     payload = {"ndev": ndev, "results": results}
+    if mfu:
+        payload["mfu"] = mfu
     if stale_device_rows:
         payload["stale_device_rows"] = stale_device_rows
         payload["stale_rounds"] = stale_rounds
@@ -645,6 +788,17 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
     if header_note:
         lines += [header_note, ""]
     lines += [f"Devices: {ndev}", ""] + _table(results)
+    if mfu:
+        lines += ["", "## Single-chip MFU", ""]
+        for r in mfu:
+            mfu_s = (f"{r['mfu'] * 100:.1f}% of "
+                     f"{r.get('peak_tflops_assumed', '?')} TF peak"
+                     if r.get("mfu") is not None
+                     else "mfu n/a (non-TPU backend)")
+            extra = (f", {r['vs_jnp_speedup']}x vs jnp"
+                     if "vs_jnp_speedup" in r else "")
+            lines.append(f"- `{r['metric']}` [{r['grade']}]: "
+                         f"{r['tflops']} TFLOP/s ({mfu_s}){extra}")
     if stale_device_rows:
         age = (f"at least {stale_rounds} fallback round(s) old"
                if stale_rounds else "previous round")
@@ -699,12 +853,14 @@ def unreachable_fallback(detail: str, fast: bool) -> None:
             stale, stale_rounds = _previous_device_rows()
             rows = host_rows()
             mrows = multidev_sweep()
+            mfu = mfu_rows_subprocess()  # dryrun grade (hermetic: the
+            # parent must never import jax while the tunnel is down)
             write_sweep(0, rows, mrows, header_note=(
                 "**TPU tunnel unreachable this round**: fresh device "
                 "rows absent; host-path rows + the virtual-CPU section "
                 "ran, and older device rows are carried below for "
                 "reference."), stale_device_rows=stale,
-                stale_rounds=stale_rounds)
+                stale_rounds=stale_rounds, mfu=mfu)
             recorded = True
         except Exception as exc:
             # the honest-zero metric line below must print regardless
@@ -760,6 +916,24 @@ def _pallas_first_run(devs, mesh, interp: bool) -> dict:
     chk("bcast",
         pc.bcast(put(x), mesh, "x", root=1, interpret=interp),
         np.broadcast_to(x[1], x.shape), tol=1e-6)
+    chk("alltoall",
+        pc.all_to_all(put(x2), mesh, "x", interpret=interp),
+        np.swapaxes(x2, 0, 1), tol=1e-6)
+    xv = rng.standard_normal((n, n, 8, 128)).astype(np.float32)
+    cnt = rng.integers(1, 9, (n, n)).astype(np.int32)
+    a2av = np.asarray(pc.all_to_all_v(put(xv), cnt, mesh, "x",
+                                      interpret=interp))
+    checks["alltoallv_ragged"] = all(
+        np.array_equal(a2av[j, i, :cnt[i, j]], xv[i, j, :cnt[i, j]])
+        for i in range(n) for j in range(n))
+    if n % 2 == 0 and n >= 4:
+        from jax.sharding import Mesh
+
+        mesh2 = Mesh(np.asarray(devs).reshape(2, n // 2), ("x", "y"))
+        chk("allreduce_torus",
+            pc.all_reduce_torus(put(x.reshape(2, n // 2, -1)), mesh2,
+                                ("x", "y"), interpret=interp),
+            x.sum(0))
 
     # the fused compute+communicate kernels are part of the evidence
     # set too (pallas_overlap: new collective_ids, real RDMA semantics
@@ -778,6 +952,21 @@ def _pallas_first_run(devs, mesh, interp: bool) -> dict:
                                  interpret=interp),
         want.reshape(n, m // n, n_out), tol=1e-3)
     return checks
+
+
+def _ladder_row(coll: str, variant: str, nbytes: int, xla_us: float,
+                pallas_us: float, interp: bool) -> dict:
+    """One LADDER_PROBE row.  Interpreter-grade timings misrepresent
+    the pallas/xla crossover by 10-25x (the interpreter serializes what
+    hardware overlaps), so dryrun rows carry ``binding: false`` and NO
+    winner — a decision ladder seeded from them would permanently gate
+    pallas off.  Only device-grade rows declare one."""
+    row = {"coll": coll, "variant": variant, "nbytes": nbytes,
+           "xla_us": xla_us, "pallas_us": pallas_us,
+           "binding": not interp}
+    row["winner"] = (None if interp
+                     else ("pallas" if pallas_us < xla_us else "xla"))
+    return row
 
 
 def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
@@ -803,13 +992,9 @@ def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
 
             pair = b._timed_pair(f"ladder_{variant}", b.fw_fn("allreduce"),
                                  pallas_fn, x, x, nbytes, iters=6)
-            rows.append({"coll": "allreduce", "variant": variant,
-                         "nbytes": nbytes,
-                         "xla_us": pair["fw_lat_us"],
-                         "pallas_us": pair["raw_lat_us"],
-                         "winner": "pallas"
-                         if pair["raw_lat_us"] < pair["fw_lat_us"]
-                         else "xla"})
+            rows.append(_ladder_row("allreduce", variant, nbytes,
+                                    pair["fw_lat_us"],
+                                    pair["raw_lat_us"], interp))
 
     # bcast + alltoall crossovers: the other slots coll/pallas can own
     for coll in ("bcast", "alltoall"):
@@ -834,13 +1019,9 @@ def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
                                  else (lambda t: b.world
                                        .alltoall_array(t)),
                                  pallas_coll_fn, x, x, nbytes, iters=6)
-            rows.append({"coll": coll, "variant": "ring",
-                         "nbytes": nbytes,
-                         "xla_us": pair["fw_lat_us"],
-                         "pallas_us": pair["raw_lat_us"],
-                         "winner": "pallas"
-                         if pair["raw_lat_us"] < pair["fw_lat_us"]
-                         else "xla"})
+            rows.append(_ladder_row(coll, "ring", nbytes,
+                                    pair["fw_lat_us"],
+                                    pair["raw_lat_us"], interp))
         except Exception as exc:
             print(f"ladder {coll} failed: {exc}", file=sys.stderr)
 
@@ -869,13 +1050,65 @@ def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
     pair = b._timed_pair(
         "ladder_matmul", fused, lambda args: unfused(*args),
         (key_a, key_b), (key_a, key_b), M * K * 4, iters=6)
-    rows.append({"coll": "matmul_allreduce", "variant": "overlap",
-                 "nbytes": M * K * 4,
-                 "xla_us": pair["raw_lat_us"],
-                 "pallas_us": pair["fw_lat_us"],
-                 "winner": "pallas"
-                 if pair["fw_lat_us"] < pair["raw_lat_us"] else "xla"})
+    rows.append(_ladder_row("matmul_allreduce", "overlap", M * K * 4,
+                            pair["raw_lat_us"], pair["fw_lat_us"],
+                            interp))
     return rows
+
+
+def _pallas_aot_gate(here: str) -> dict:
+    """Pre-gate: AOT-compile every coll/pallas kernel for a real TPU
+    topology (no hardware needed — libtpu's Mosaic compiler runs
+    offline).  Runs in a subprocess with a scrubbed env so a site boot
+    hook pinning an accelerator tunnel can't hang the compile-only
+    path.  Writes PALLAS_AOT.json; a kernel failing here would fail on
+    a live pod, so the device sweep shouldn't bother until this is
+    green."""
+    import importlib.util
+
+    if importlib.util.find_spec("libtpu") is None:
+        # no offline Mosaic compiler on this machine: the gate cannot
+        # run, which is NOT a compile failure (the CI test skips on the
+        # same condition) — report skipped, don't fail pod-smoke
+        print("pod-smoke: pallas AOT gate skipped (no libtpu)",
+              file=sys.stderr)
+        return {"skipped": True, "reason": "libtpu unavailable"}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p) or here
+    out = os.path.join(here, "PALLAS_AOT.json")
+    try:
+        # a crashed run must not report green off a previous run's file
+        try:
+            os.remove(out)
+        except FileNotFoundError:
+            pass
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.pallas_aot",
+             "--out", out],
+            cwd=here, env=env, capture_output=True, text=True,
+            timeout=900)
+        if proc.returncode not in (0, 1) or not os.path.exists(out):
+            # rc 1 = compiled-with-failures (the file says which); any
+            # other rc means the gate itself crashed
+            raise RuntimeError(
+                f"pallas_aot rc={proc.returncode}: "
+                f"{proc.stderr[-400:]}")
+        res = json.loads(open(out).read())
+        summary = {"ok": res.get("ok", False),
+                   "n_compiled": res.get("n_compiled", 0),
+                   "n_kernels": res.get("n_kernels", 0),
+                   "topology": res.get("topology")}
+        print(f"pod-smoke: pallas AOT {summary['n_compiled']}/"
+              f"{summary['n_kernels']} kernels compiled for "
+              f"{summary['topology']}")
+        return summary
+    except Exception as exc:
+        print(f"pod-smoke: pallas AOT gate failed: {exc}",
+              file=sys.stderr)
+        return {"ok": False, "error": str(exc)[:300]}
 
 
 def pod_smoke(dry_run: bool = False) -> int:
@@ -892,6 +1125,7 @@ def pod_smoke(dry_run: bool = False) -> int:
     """
     here = os.path.dirname(os.path.abspath(__file__))
     report = {"dry_run": dry_run, "phases": {}}
+    report["phases"]["pallas_aot"] = _pallas_aot_gate(here)
     if dry_run:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -943,7 +1177,9 @@ def pod_smoke(dry_run: bool = False) -> int:
                   json.dumps({"grade": grade, "rows": ladder}, indent=1))
     report["phases"]["ladder_probe"] = {"grade": grade,
                                         "rows": len(ladder)}
-    ok_all = all(checks.values())
+    aot = report["phases"]["pallas_aot"]
+    ok_all = (all(checks.values())
+              and (aot.get("ok", False) or aot.get("skipped", False)))
     if not dry_run and platform == "tpu":
         # the canonical sweep + driver metric line (init is idempotent;
         # main() finalizes).  The report records what actually happened
@@ -1019,13 +1255,18 @@ def main() -> None:
             results.append(b.persistent_point(PRIMARY))
         except Exception as exc:
             print(f"persistent failed: {exc}", file=sys.stderr)
+        try:
+            mfu = mfu_rows()
+        except Exception as exc:
+            print(f"mfu rows failed: {exc}", file=sys.stderr)
+            mfu = []
         # nothing after the TPU measurements may lose them: the sweep
         # files and the contract metric line must survive any CPU-side
         # failure (hung multidev child, unwritable bench dir, ...)
         try:
             results.extend(host_rows())
             multidev_rows = multidev_sweep()
-            write_sweep(b.ndev, results, multidev_rows)
+            write_sweep(b.ndev, results, multidev_rows, mfu=mfu)
         except Exception as exc:
             print(f"post-TPU sweep recording failed: {exc}",
                   file=sys.stderr)
@@ -1044,5 +1285,8 @@ if __name__ == "__main__":
             print(row)
     elif "--pod-smoke" in sys.argv:
         sys.exit(pod_smoke(dry_run="--dry-run" in sys.argv))
+    elif "--mfu" in sys.argv:
+        for row in mfu_rows():
+            print(json.dumps(row))
     else:
         main()

@@ -33,18 +33,26 @@ _sp_impl_var = registry.register(
 def model_dims(spec: MeshSpec, layers: int = None) -> dict:
     """``layers`` defaults to one per pipeline stage; override (a
     multiple of pp) to hold model depth fixed across mesh specs — the
-    pp=2-vs-pp=1 equivalence tests depend on it."""
+    pp=2-vs-pp=1 equivalence tests depend on it.
+
+    ``OTPU_MODEL_SCALE`` multiplies the width/sequence dims (default 1:
+    the compile-check scale every correctness test uses).  The bench's
+    single-chip MFU row raises it so the SAME flagship program is
+    measured at MXU-saturating sizes instead of tracing-scale ones."""
+    import os
+
+    scale = max(1, int(os.environ.get("OTPU_MODEL_SCALE", "1") or 1))
     tp, sp, dp, pp = spec.tp, spec.sp, spec.dp, spec.pp
     L = pp if layers is None else int(layers)
     if L % pp:
         raise ValueError(f"layers={L} not divisible by pp={pp}")
-    d = 8
-    hd = 4
+    d = 8 * scale
+    hd = 4 * scale
     n_heads = 2 * tp
-    ff = 8 * tp
+    ff = 8 * tp * scale
     n_experts = 2 * tp
-    ffe = 4
-    s_local = 4
+    ffe = 4 * scale
+    s_local = 4 * scale
     M = 2                      # microbatches
     mb = tp                    # microbatch rows per device (keeps MoE even)
     t_local = mb * s_local     # MoE tokens per device per microbatch

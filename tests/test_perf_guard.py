@@ -62,8 +62,9 @@ def test_allreduce_per_byte_cost_stays_linear(tmp_path):
         f"per-byte cost grew {per_byte_big / per_byte_small:.1f}x "
         f"from 256KB ({small_t * 1e3:.1f}ms) to 4MB ({big_t * 1e3:.1f}ms)")
     # absolute backstops: sweep measures 4MB ≈25ms / 256KB ≈1.3ms and
-    # the in-suite harness runs ~1.4x slower (~35ms / ~2ms), so these
-    # bounds keep ~2x contention headroom while still failing a 3x
-    # regression (the linearity assert above is the primary guard)
-    assert big_t < 0.075, f"4MB allreduce took {big_t * 1e3:.0f}ms"
-    assert small_t < 0.008, f"256KB allreduce took {small_t * 1e3:.1f}ms"
+    # the in-suite harness runs ~1.4x slower (~35ms / ~2ms).  The
+    # linearity assert above is the primary guard; these only catch a
+    # catastrophic (order-of-magnitude) collapse, with enough headroom
+    # that a loaded single-core CI host doesn't flake them
+    assert big_t < 0.30, f"4MB allreduce took {big_t * 1e3:.0f}ms"
+    assert small_t < 0.032, f"256KB allreduce took {small_t * 1e3:.1f}ms"
