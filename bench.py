@@ -151,14 +151,25 @@ class DeviceBench:
             out2 = raw(xr)
         jax.block_until_ready((out, out2))
         fw_s, raw_s = [], []
-        for _ in range(iters):
+        for i in range(iters):
+            # alternate which side goes first: over a tunnel the second
+            # call of a pair rides a warm connection, and a fixed order
+            # would hand that advantage to one side systematically
+            # (suspected in round 2's allgather-4MB 0.609 — fw and raw
+            # compile to byte-identical programs there)
+            first, second = (fw, raw) if i % 2 == 0 else (raw, fw)
+            xa, xb = (x, xr) if i % 2 == 0 else (xr, x)
             t0 = time.perf_counter()
-            jax.block_until_ready(fw(x))
+            jax.block_until_ready(first(xa))
             t1 = time.perf_counter()
-            jax.block_until_ready(raw(xr))
+            jax.block_until_ready(second(xb))
             t2 = time.perf_counter()
-            fw_s.append(t1 - t0)
-            raw_s.append(t2 - t1)
+            if i % 2 == 0:
+                fw_s.append(t1 - t0)
+                raw_s.append(t2 - t1)
+            else:
+                raw_s.append(t1 - t0)
+                fw_s.append(t2 - t1)
         fw_t, raw_t = statistics.median(fw_s), statistics.median(raw_s)
         pair_ratio = statistics.median(r / f_ for f_, r in zip(fw_s, raw_s))
         f = _bus_factor(coll.split("_")[0], self.ndev)
