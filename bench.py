@@ -275,8 +275,11 @@ def mfu_rows() -> list:
         ca = jfn.lower(*example_args).compile().cost_analysis() or {}
         flops = float(ca.get("flops", 0.0))
         t = _time_fn(lambda a: jfn(*a), example_args, iters=10)
-        row("mfu_train_step", flops, t, "f32",
-            extra={"model_scale": scale})
+        # f32 params, but JAX default matmul precision runs one bf16
+        # MXU pass per f32 matmul on TPU — bf16 peak is the roofline
+        row("mfu_train_step", flops, t, "bf16",
+            extra={"model_scale": scale,
+                   "matmul_precision": "default (bf16 MXU passes)"})
     except Exception as exc:
         print(f"mfu: train step failed: {exc}", file=sys.stderr)
     finally:

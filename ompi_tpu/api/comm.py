@@ -72,6 +72,27 @@ def as_buffer(buf) -> tuple[np.ndarray, int, Datatype]:
     return arr, arr.size, from_numpy_dtype(arr.dtype)
 
 
+#: live-communicator registry for debugger introspection
+#: (``runtime.debugger.comm_table`` — the handle-table walk of
+#: ``ompi/debuggers/ompi_common_dll.c``).  Weak: registration must not
+#: keep freed communicators alive.
+_live_comms: "weakref.WeakSet" = None  # initialized lazily below
+
+
+def _register_live(comm) -> None:
+    global _live_comms
+    import weakref
+
+    if _live_comms is None:
+        _live_comms = weakref.WeakSet()
+    _live_comms.add(comm)
+
+
+def live_comms() -> list:
+    """Snapshot of live communicators (debugger support)."""
+    return sorted(_live_comms or [], key=lambda c: (c.cid, c.epoch))
+
+
 class Comm(AttributeHost):
     _cid_lock = threading.Lock()
 
@@ -103,6 +124,7 @@ class Comm(AttributeHost):
         self._rank = group.rank_of(rte.my_world_rank) if rte else 0
         if parent is not None:
             self.errhandler = parent.errhandler
+        _register_live(self)
 
     # -- accessors -------------------------------------------------------
     @property

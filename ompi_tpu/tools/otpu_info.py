@@ -61,7 +61,22 @@ def main(argv=None) -> int:
     ap.add_argument("--topo", action="store_true",
                     help="Show host + device topology (hwloc analog; "
                          "lstopo-lite)")
+    ap.add_argument("--debug-dump", action="store_true",
+                    help="Debugger handle introspection: live "
+                         "communicators, pml message queues, proctable "
+                         "(the MPIR/ompi_common_dll analog) as JSON — "
+                         "initializes the runtime in this process")
     args = ap.parse_args(argv)
+
+    if args.debug_dump:
+        import json
+
+        import ompi_tpu
+        from ompi_tpu.runtime import debugger
+
+        ompi_tpu.init()
+        print(json.dumps(debugger.dump(), indent=1, default=str))
+        return 0
 
     import ompi_tpu
     from ompi_tpu.base.var import registry
