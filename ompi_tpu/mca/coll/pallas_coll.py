@@ -303,7 +303,11 @@ class PallasCollComponent(Component):
             "vmem_max_bytes", vtype=VarType.SIZE, default="8m",
             help="Per-rank payload crossover from the fused all-VMEM "
                  "ring kernel to the segmented HBM-resident one "
-                 "(bounded VMEM window)")
+                 "(bounded VMEM window).  The default is the "
+                 "Mosaic-measured ceiling: on a v5e-8 topology the "
+                 "fused kernel's acc+recv footprint compiles at 8MB "
+                 "per-rank payload and is VMEM-exhausted at 16MB "
+                 "(pallas_aot round-5 probe)")
         self._seg = self.register_var(
             "seg_bytes", vtype=VarType.SIZE, default="512k",
             help="VMEM window size per buffer for the segmented ring "

@@ -159,6 +159,34 @@ def cases(mesh1d, mesh2d):
                                       "bfloat16", False),
         (_sds((n, m, k_loc), bf16, mesh1d, P("x")),
          _sds((n, k_loc, n_out), bf16, mesh1d, P("x")))))
+
+    # -- production-size cases: VMEM budgets and semaphore pressure are
+    # shape-dependent, so tiny-shape compiles alone would under-prove
+    # the contract.  Sizes mirror the sweep's upper rows (64MB payloads
+    # per device; TP-layer-scale fused GEMM).
+    BIG = (64 << 20) // 4                  # 64MB f32 per device
+    case("big_all_reduce_seg", lambda: (
+        pc._jit_all_reduce(mesh1d, "x", (BIG,), "float32", "sum",
+                           False, "seg", None),
+        (ring_arg((BIG,)),)))
+    case("big_all_reduce_seg_bidi", lambda: (
+        pc._jit_all_reduce(mesh1d, "x", (BIG,), "float32", "sum",
+                           False, "seg_bidi", None),
+        (ring_arg((BIG,)),)))
+    case("big_all_reduce_fused_4mb", lambda: (
+        pc._jit_all_reduce(mesh1d, "x", ((4 << 20) // 4,), "float32",
+                           "sum", False, "fused", None),
+        (ring_arg(((4 << 20) // 4,)),)))
+    case("big_matmul_allreduce_1k", lambda: (
+        po._jit_matmul_allreduce(mesh1d, "x", 1024, 1024, 1024,
+                                 "bfloat16", False),
+        (_sds((n, 1024, 1024), bf16, mesh1d, P("x")),
+         _sds((n, 1024, 1024), bf16, mesh1d, P("x")))))
+    case("big_all_to_all_v", lambda: (
+        pc._jit_all_to_all_v(mesh1d, "x", 2048, 1024, 8, "float32",
+                             False),
+        (_sds((n, n), jnp.int32, mesh1d, P()),
+         _sds((n, n, 2048, 1024), f32, mesh1d, P("x")))))
     return out
 
 
