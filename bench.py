@@ -97,8 +97,20 @@ class DeviceBench:
         P, sm = self._P, self._sm
         n = self.ndev
 
-        def bcast_body(t):   # the same binomial ppermute tree as
+        def bcast_body(t):   # the same two-regime selection as
             me = jax.lax.axis_index("x")     # xla.py bcast_array
+            nbytes_payload = int(np.prod(t.shape[1:])) * t.dtype.itemsize
+            if nbytes_payload >= (256 << 10):   # scatter+allgather
+                contrib = jnp.where(me == 0, t[0], jnp.zeros_like(t[0]))
+                flat = contrib.reshape(-1)
+                blk = -(-flat.shape[0] // n)
+                if blk * n != flat.shape[0]:
+                    flat = jnp.pad(flat, (0, blk * n - flat.shape[0]))
+                part = jax.lax.psum_scatter(flat.reshape(n, blk), "x",
+                                            scatter_dimension=0,
+                                            tiled=False)
+                full = jax.lax.all_gather(part, "x")
+                return full.reshape(-1)[:t[0].size].reshape(t.shape)
             rel = me % n
             cur = t
             k = 1

@@ -72,7 +72,8 @@ class PersistentColl:
 
 
 class XlaCollModule:
-    def __init__(self, comm, devices, axis_name: str = "mpi") -> None:
+    def __init__(self, comm, devices, axis_name: str = "mpi",
+                 bcast_sa_min_bytes: int = 256 << 10) -> None:
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -80,6 +81,7 @@ class XlaCollModule:
         self.axis = axis_name
         self.mesh = Mesh(np.array(self.devices), (axis_name,))
         self.n = len(self.devices)
+        self.bcast_sa_min_bytes = int(bcast_sa_min_bytes)
         self._cache: dict = {}
         self._lock = threading.Lock()
         self._P = P
@@ -252,12 +254,17 @@ class XlaCollModule:
         return fn(x)
 
     def bcast_array(self, comm, x, root: int = 0):
-        """Binomial-tree broadcast: log2(n) ppermute rounds over ICI.
+        """Broadcast with the reference's two-regime selection
+        (``coll_base_bcast.c`` + the tuned bcast ladder):
 
-        XLA's CollectivePermute disallows one-to-many pairs, so the tree is
-        explicit — the device-native shape of the reference's binomial bcast
-        (``coll_base_bcast.c`` binomial algorithm), each round doubling the
-        set of devices holding root's data.
+        * small payloads — binomial ppermute tree, log2(n) rounds
+          (XLA's CollectivePermute disallows one-to-many pairs, so the
+          tree is explicit), latency-optimal;
+        * payloads ≥ ``bcast_sa_min_bytes`` — scatter+allgather:
+          root's buffer is masked into a psum_scatter (each link
+          carries S/n-sized shards, zeros fold in free) and an
+          all_gather restores it everywhere — two pipelined ring phases
+          moving ~2S/n per link instead of log2(n) serial full-S hops.
         """
         if isinstance(x, self._jax_array):
             fn = self._fast(self._keyfor("bcast", x, root))
@@ -268,8 +275,10 @@ class XlaCollModule:
 
         P = self._P
         n, ax = self.n, self.axis
+        per_payload = (int(np.prod(x.shape[1:])) *
+                       np.dtype(x.dtype).itemsize)
 
-        def body(t):  # t: (1, *S)
+        def body_tree(t):  # t: (1, *S)
             me = jax.lax.axis_index(ax)
             rel = (me - root) % n
             cur = t
@@ -283,6 +292,22 @@ class XlaCollModule:
                 k *= 2
             return cur
 
+        def body_sa(t):  # t: (1, *S)
+            me = jax.lax.axis_index(ax)
+            contrib = jnp.where(me == root, t[0], jnp.zeros_like(t[0]))
+            flat = contrib.reshape(-1)
+            size = flat.shape[0]
+            blk = -(-size // n)
+            if blk * n != size:
+                flat = jnp.pad(flat, (0, blk * n - size))
+            part = jax.lax.psum_scatter(flat.reshape(n, blk), ax,
+                                        scatter_dimension=0,
+                                        tiled=False)
+            full = jax.lax.all_gather(part, ax)        # (n, blk)
+            return full.reshape(-1)[:size].reshape(t.shape)
+
+        body = (body_sa if per_payload >= self.bcast_sa_min_bytes
+                else body_tree)
         fn, x = self._get(
             comm, self._keyfor("bcast", x, root), x,
             lambda: self._shard_map(body, P(self.axis), P(self.axis)))
@@ -441,18 +466,50 @@ class XlaCollModule:
         return fn(x)
 
     def scatter_array(self, comm, x, root: int = 0):
-        """Scatter root's buffer: x (n, n, *S) where row root holds root's
-        n blocks; rank i receives block i.  One all_to_all moves only the
-        root's blocks' worth of data per link (non-root rows are dead
-        freight XLA may DCE after the swap-select)."""
+        """Scatter root's buffer: x (n, n, *S) where row root holds
+        root's n blocks; rank i receives block i.
+
+        Binomial ppermute tree outward from root — the exact mirror of
+        :meth:`gather_array`'s tree (``coll_base_scatter.c`` binomial):
+        at round k (descending) each holder forwards the half of its
+        subtree window it does not keep, so total wire traffic is
+        O(n·log n·S/2) where the previous all_to_all construction moved
+        every rank's dead-freight row (n²·S).  Same static-window +
+        clamp-lockstep discipline as the gather tree, halving instead
+        of doubling."""
         import jax
+        import jax.numpy as jnp
 
         P = self._P
+        n, ax = self.n, self.axis
+        kmax = 1
+        while kmax * 2 < n:
+            kmax *= 2
 
-        def body(t):  # (1, n, *S) -> (n, 1, *S) after the exchange
-            y = jax.lax.all_to_all(t, self.axis, split_axis=1, concat_axis=0)
-            # y[s] = (1, *S) block received from source s; keep root's
-            return y[root]
+        def body(t):  # (1, n, *S) -> (1, *S)
+            me = jax.lax.axis_index(ax)
+            rel = jnp.mod(me - root, n)
+            blk = t[0]                      # (n, *S); valid at root only
+            zero_starts = (0,) * (blk.ndim - 1)
+            # slot-rotate so the tree runs in rel space: buf slot s =
+            # block of rel s (root holds all, everyone else zeros)
+            buf = jnp.where(rel == 0, jnp.roll(blk, -root, axis=0),
+                            jnp.zeros_like(blk))
+            k = kmax
+            while k >= 1:
+                # holders rel ≡ 0 (mod 2k) own window [rel, rel+2k);
+                # they forward the upper half [rel+k, rel+2k) to rel+k
+                pairs = [((root + r) % n, (root + r + k) % n)
+                         for r in range(0, n - k, 2 * k)]
+                win = jax.lax.dynamic_slice(
+                    buf, (rel + k,) + zero_starts,
+                    (k,) + blk.shape[1:])
+                recvd = jax.lax.ppermute(win, ax, pairs)
+                contrib = jax.lax.dynamic_update_slice(
+                    jnp.zeros_like(buf), recvd, (rel,) + zero_starts)
+                buf = buf + contrib   # non-receivers add ppermute zeros
+                k //= 2
+            return jax.lax.dynamic_index_in_dim(buf, rel, 0)
 
         fn, x = self._get(
             comm, self._keyfor("scatter", x, root), x,
@@ -567,6 +624,14 @@ class XlaCollComponent(Component):
         self._axis = self.register_var(
             "axis_name", default="mpi",
             help="Mesh axis name used for coll/xla collective programs")
+        self._bcast_sa = self.register_var(
+            "bcast_sa_min_bytes", vtype=VarType.SIZE, default="256k",
+            help="Payloads at least this large broadcast via "
+                 "scatter+allgather (~2S/n per link, two pipelined ring "
+                 "phases) instead of the binomial tree (log2(n) serial "
+                 "full-S hops) — the large-message switch of the "
+                 "reference's coll_bcast_decision ladder "
+                 "(coll_tuned_decision_fixed.c bcast rules)")
 
     def comm_query(self, comm):
         rte = comm.rte
@@ -578,8 +643,9 @@ class XlaCollComponent(Component):
             return None
         if not devices or any(d is None for d in devices):
             return None
-        return self._prio.value, XlaCollModule(comm, devices,
-                                               self._axis.value)
+        return self._prio.value, XlaCollModule(
+            comm, devices, self._axis.value,
+            bcast_sa_min_bytes=int(self._bcast_sa.value))
 
 
 COMPONENT = XlaCollComponent()

@@ -101,3 +101,51 @@ def test_reduce_wire_bytes_binomial(world, xla):
     assert "all-reduce" not in hlo
     wire = _wire_bytes(hlo)
     assert 0 < wire <= 8 * S, f"reduce moves {wire} B vs 7S={7 * S}"
+
+
+def test_scatter_wire_bytes_binomial(world, xla):
+    host = np.random.default_rng(2).standard_normal((8, 8, 128)) \
+        .astype(np.float32)
+    dev = xla.make_world_array(host)
+    before = set(xla._cache)
+    out = np.asarray(world.scatter_array(dev, root=4))
+    np.testing.assert_allclose(out, host[4], rtol=1e-6)
+    hlo = _compiled_hlo(xla, before, dev)
+    S = 128 * 4
+    # binomial halving: k=4: 1x4S, k=2: 2x2S, k=1: 4x1S = 12S; the
+    # all_to_all construction moved every rank's dead freight (56S)
+    assert "all-to-all" not in hlo
+    wire = _wire_bytes(hlo)
+    assert 0 < wire <= 14 * S, f"scatter moves {wire} B vs 12S={12 * S}"
+
+
+def test_bcast_large_scatter_allgather(world, xla):
+    """Above bcast_sa_min_bytes the program must be the two ring phases
+    (reduce-scatter + all-gather), not log2(n) serial full-S ppermute
+    hops — and still correct from any root."""
+    S = xla.bcast_sa_min_bytes // 4 + 1024   # f32 elems, above the bar
+    host = np.random.default_rng(3).standard_normal((8, S)) \
+        .astype(np.float32)
+    dev = xla.make_world_array(host)
+    before = set(xla._cache)
+    out = np.asarray(world.bcast_array(dev, root=6))
+    np.testing.assert_allclose(out, np.broadcast_to(host[6], out.shape),
+                               rtol=1e-6)
+    hlo = _compiled_hlo(xla, before, dev)
+    assert "collective-permute" not in hlo   # no tree hops
+    assert "reduce-scatter" in hlo or "all-reduce-scatter" in hlo, \
+        "scatter phase missing"
+    assert "all-gather" in hlo, "allgather phase missing"
+
+
+def test_bcast_small_stays_binomial(world, xla):
+    host = np.random.default_rng(4).standard_normal((8, 64)) \
+        .astype(np.float32)
+    dev = xla.make_world_array(host)
+    before = set(xla._cache)
+    out = np.asarray(world.bcast_array(dev, root=2))
+    np.testing.assert_allclose(out, np.broadcast_to(host[2], out.shape),
+                               rtol=1e-6)
+    hlo = _compiled_hlo(xla, before, dev)
+    assert "collective-permute" in hlo       # the tree
+    assert "reduce-scatter" not in hlo
