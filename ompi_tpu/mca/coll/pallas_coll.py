@@ -193,6 +193,22 @@ class PallasCollModule:
         return [[full[i, j, :int(counts[j][i])] for j in range(self.n)]
                 for i in range(self.n)]
 
+    def allgatherv_array(self, comm, x, counts):
+        """True ragged allgatherv: the ring forwards each block as
+        count-sized chunked DMAs (``ops.pallas_collectives.
+        all_gather_v``) instead of coll/xla's padded all_gather —
+        wire bytes follow the raggedness."""
+        x = self._place(comm, x)
+        if (not self._size_ok(x) or x.ndim != 3
+                or x.shape[0] != self.n or x.shape[2] % 128 != 0):
+            return self._delegate("allgatherv_array", comm, x, counts)
+        from ompi_tpu.ops import pallas_collectives as pc
+
+        full = pc.all_gather_v(x, list(counts), self.mesh, self.axis,
+                               interpret=self.interpret)
+        # coll/xla return contract: per-rank views sliced to counts[i]
+        return [full[i, :int(counts[i])] for i in range(self.n)]
+
     def persistent_coll(self, comm, coll: str, template, *args):
         """MPI_*_init analog bound to the CACHED pallas jitted program:
         when this component owns the slot, the persistent handle

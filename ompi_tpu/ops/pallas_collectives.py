@@ -1020,6 +1020,101 @@ def _build_all_to_all_v(n: int, axis: str, max_rows: int, width: int,
 
 
 @functools.lru_cache(maxsize=64)
+def _build_all_gather_v(n: int, axis: str, max_rows: int, width: int,
+                        chunk: int, dtype_str: str, interpret: bool):
+    """Ragged ring all-gather (true allgatherv): per-rank valid row
+    counts arrive as a runtime (n,) int32 table, and each ring step
+    forwards a block as ceil(count/chunk) fixed-shape (chunk, W) DMAs —
+    wire bytes follow the raggedness instead of every block moving
+    max_rows (``coll_base_allgatherv.c`` ring with per-peer counts).
+    Same static-shape/dynamic-trip-count discipline as
+    ``_build_all_to_all_v``; the interpreter runs the symmetric
+    full-block schedule (its DMA emulation needs matched op counts) and
+    the ragged trip counts are AOT-compile-proven."""
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
+    full = (max_rows + chunk - 1) // chunk
+
+    def nchunks(rows):
+        if interpret:
+            return full
+        return (rows + chunk - 1) // chunk
+
+    def kernel(counts_ref, x_ref, out_ref, local_sem, send_sem,
+               recv_sems):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        left = lax.rem(my - 1 + n, n)
+        barrier(right, left)
+
+        def local_chunk(c, carry):
+            sl = pl.ds(c * chunk, chunk)
+            cp = pltpu.make_async_copy(x_ref.at[sl],
+                                       out_ref.at[my, sl], local_sem)
+            cp.start()
+            cp.wait()
+            return carry
+
+        lax.fori_loop(0, nchunks(counts_ref[my]), local_chunk, 0)
+
+        def step(k, carry):
+            s_send = lax.rem(my - k + 1 + 2 * n, n)   # freshest block
+            s_recv = lax.rem(my - k + 2 * n, n)       # lands from left
+
+            def send_chunk(c, c2):
+                sl = pl.ds(c * chunk, chunk)
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=out_ref.at[s_send, sl],
+                    dst_ref=out_ref.at[s_send, sl],
+                    send_sem=send_sem, recv_sem=recv_sems.at[k - 1],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                rdma.start()
+                rdma.wait_send()
+                return c2
+
+            lax.fori_loop(0, nchunks(counts_ref[s_send]), send_chunk,
+                          0, unroll=False)
+
+            def recv_chunk(c, c2):
+                sl = pl.ds(c * chunk, chunk)
+                pltpu.make_async_remote_copy(
+                    src_ref=out_ref.at[s_recv, sl],
+                    dst_ref=out_ref.at[s_recv, sl],
+                    send_sem=send_sem, recv_sem=recv_sems.at[k - 1],
+                    device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                ).wait_recv()
+                return c2
+
+            lax.fori_loop(0, nchunks(counts_ref[s_recv]), recv_chunk,
+                          0, unroll=False)
+            return carry
+
+        lax.fori_loop(1, n, step, 0)
+
+    def call(counts, x):  # counts: (n,) i32; x: (max_rows, W)
+        kw = {}
+        cp = cparams(14)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, max_rows, width),
+                                           dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((n - 1,))],
+            interpret=interpret,
+            **kw,
+        )(counts, x)
+
+    return call
+
+
+@functools.lru_cache(maxsize=64)
 def _build_bcast(n: int, axis: str, nseg: int, srows: int,
                  dtype_str: str, interpret: bool):
     """Pipelined segmented ring broadcast — the "clamped conveyor": root
@@ -1356,6 +1451,58 @@ def all_to_all(x, mesh, axis: str, interpret: bool = True):
         return x
     return _jit_all_to_all(mesh, axis, tuple(x.shape[2:]), str(x.dtype),
                            interpret)(x)
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_all_gather_v(mesh, axis: str, max_rows: int, width: int,
+                      chunk: int, dtype_str: str, interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    inner = _build_all_gather_v(n, axis, max_rows, width, chunk,
+                                dtype_str, interpret)
+
+    def body(c, t):                    # c: (n,) replicated; t: (1, R, W)
+        return inner(c, t[0])          # (n, R, W) replicated
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(), P(axis)),
+                             out_specs=P(), check_vma=False))
+
+
+def all_gather_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
+                 interpret: bool = True):
+    """Ragged all-gather (true allgatherv): ``x`` is (n, R, W) sharded
+    on the leading rank axis — rank i's block carries ``counts[i]``
+    valid rows (≤ R) — and every rank receives (n, R, W) with
+    ``out[i, :counts[i]]`` valid.  ``counts`` is a runtime operand:
+    one compile serves every raggedness.  Wire bytes per block are
+    ceil(count/chunk_rows)*chunk_rows rows where the padded all_gather
+    always moves R.  W must be a multiple of 128 lanes."""
+    jax, jnp, lax, pl, pltpu = _mods()
+
+    n = mesh.shape[axis]
+    if x.ndim != 3 or x.shape[0] != n:
+        raise ValueError(
+            f"all_gather_v needs a ({n}, R, W) array on this mesh, "
+            f"got {tuple(x.shape)}")
+    if x.shape[2] % 128 != 0:
+        raise ValueError(
+            f"all_gather_v row width must be a multiple of 128 lanes, "
+            f"got {x.shape[2]} (pad the feature dim)")
+    if n == 1:
+        return x
+    chunk_rows = int(chunk_rows)
+    R = int(x.shape[1])
+    Rp = -(-R // chunk_rows) * chunk_rows
+    if Rp != R:
+        x = jnp.pad(x, ((0, 0), (0, Rp - R), (0, 0)))
+    counts = jnp.asarray(counts, jnp.int32)
+    fn = _jit_all_gather_v(mesh, axis, Rp, int(x.shape[2]), chunk_rows,
+                           str(x.dtype), interpret)
+    out = fn(counts, x)
+    return out[:, :R] if Rp != R else out
 
 
 @functools.lru_cache(maxsize=256)

@@ -131,6 +131,11 @@ def cases(mesh1d, mesh2d):
         pc._jit_all_to_all_v(mesh1d, "x", 64, 256, 8, "float32", False),
         (_sds((n, n), jnp.int32, mesh1d, P()),
          _sds((n, n, 64, 256), f32, mesh1d, P("x")))))
+    case("all_gather_v_ragged", lambda: (
+        pc._jit_all_gather_v(mesh1d, "x", 64, 256, 8, "float32",
+                             False),
+        (_sds((n,), jnp.int32, mesh1d, P()),
+         _sds((n, 64, 256), f32, mesh1d, P("x")))))
     case("bcast", lambda: (
         pc._jit_bcast(mesh1d, "x", (PAY,), "float32", False, SEG),
         (_sds((1,), jnp.int32, mesh1d, P()), ring_arg((PAY,)))))

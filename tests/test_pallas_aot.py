@@ -38,7 +38,7 @@ def test_all_kernels_aot_compile():
                    "reduce_scatter_seg", "all_reduce_fused",
                    "all_reduce_seg", "all_reduce_bidi",
                    "all_reduce_seg_bidi", "all_reduce_max",
-                   "all_to_all", "all_to_all_v_ragged", "bcast",
+                   "all_to_all", "all_to_all_v_ragged", "all_gather_v_ragged", "bcast",
                    "all_reduce_torus", "matmul_allreduce",
                    "matmul_reduce_scatter"):
         assert expect in names, f"AOT case list lost {expect}"

@@ -528,3 +528,36 @@ def test_component_alltoallv_ragged(pallas_world):
             c = counts[j][i]
             np.testing.assert_array_equal(
                 np.asarray(outs[i][j]), host[j, i, :c])
+
+
+def test_kernel_all_gather_v_ragged(mesh):
+    """Ragged ring allgatherv: block i arrives with counts[i] valid
+    rows everywhere (interpret mode moves whole blocks — symmetric
+    DMA emulation; ragged trips are AOT-proven)."""
+    import jax
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    n, R, W = 8, 12, 128          # R deliberately not a chunk multiple
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((n, R, W)).astype(np.float32)
+    counts = rng.integers(0, R + 1, n).astype(np.int32)
+    out = np.asarray(pc.all_gather_v(jax.device_put(x), counts,
+                                     mesh, "x"))
+    for i in range(n):
+        np.testing.assert_array_equal(out[i, :counts[i]],
+                                      x[i, :counts[i]])
+
+
+def test_component_allgatherv_ragged(pallas_world):
+    w = pallas_world
+    n, R, W = 8, 8, 128
+    rng = np.random.default_rng(9)
+    host = rng.standard_normal((n, R, W)).astype(np.float32)
+    counts = [(3 * i) % (R + 1) for i in range(n)]
+    outs = w.allgatherv_array(host, counts)
+    owner = w.c_coll["allgatherv_array"].__self__.__class__.__name__
+    assert owner == "PallasCollModule", owner
+    for i in range(n):
+        np.testing.assert_array_equal(np.asarray(outs[i]),
+                                      host[i, :counts[i]])
