@@ -183,6 +183,15 @@ class PallasCollModule:
             return self._delegate("alltoallv_array", comm, x, counts)
         import numpy as np
 
+        if np.asarray(counts).shape != (self.n, self.n):
+            # same error contract as coll/xla: malformed counts surface
+            # as MpiError, never as a bad SMEM table / IndexError
+            from ompi_tpu.api.errors import ErrorClass, MpiError
+
+            raise MpiError(
+                ErrorClass.ERR_BUFFER,
+                f"alltoallv needs an ({self.n}, {self.n}) counts "
+                f"table, got {np.asarray(counts).shape}")
         from ompi_tpu.ops import pallas_collectives as pc
 
         full = pc.all_to_all_v(x, np.asarray(counts, np.int32),
@@ -202,6 +211,13 @@ class PallasCollModule:
         if (not self._size_ok(x) or x.ndim != 3
                 or x.shape[0] != self.n or x.shape[2] % 128 != 0):
             return self._delegate("allgatherv_array", comm, x, counts)
+        if len(counts) != self.n:
+            # coll/xla's error contract (allgatherv_array)
+            from ompi_tpu.api.errors import ErrorClass, MpiError
+
+            raise MpiError(
+                ErrorClass.ERR_BUFFER,
+                f"allgatherv needs {self.n} counts, got {len(counts)}")
         from ompi_tpu.ops import pallas_collectives as pc
 
         full = pc.all_gather_v(x, list(counts), self.mesh, self.axis,

@@ -931,17 +931,7 @@ def _build_all_to_all_v(n: int, axis: str, max_rows: int, width: int,
     of the symmetric kernels' ``wait()``.
     """
     jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
-    full = (max_rows + chunk - 1) // chunk
-
-    def nchunks(rows):
-        # the interpreter emulates every remote DMA as a cross-device
-        # rendezvous, so per-device op counts must be SYMMETRIC there:
-        # interpret mode always moves whole blocks (validating the
-        # addressing/semaphore schedule); the dynamic ragged trip
-        # counts are a hardware feature, compile-proven by the AOT gate
-        if interpret:
-            return full
-        return (rows + chunk - 1) // chunk
+    nchunks = _ragged_nchunks(max_rows, chunk, interpret)
 
     def kernel(counts_ref, x_ref, out_ref, local_sem, send_sem,
                recv_sems):
@@ -1032,12 +1022,7 @@ def _build_all_gather_v(n: int, axis: str, max_rows: int, width: int,
     full-block schedule (its DMA emulation needs matched op counts) and
     the ragged trip counts are AOT-compile-proven."""
     jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
-    full = (max_rows + chunk - 1) // chunk
-
-    def nchunks(rows):
-        if interpret:
-            return full
-        return (rows + chunk - 1) // chunk
+    nchunks = _ragged_nchunks(max_rows, chunk, interpret)
 
     def kernel(counts_ref, x_ref, out_ref, local_sem, send_sem,
                recv_sems):
@@ -1258,6 +1243,24 @@ def all_gather(x, mesh, axis: str, interpret: bool = True):
 #: default VMEM window (elements) for the segmented kernels when the
 #: caller does not size it
 _DEFAULT_SEG_ELEMS = 131072
+
+
+def _ragged_nchunks(max_rows: int, chunk: int, interpret: bool):
+    """Trip-count rule shared by the ragged (counts-driven) kernels.
+
+    The interpreter emulates every remote DMA as a cross-device
+    rendezvous, so per-device op counts must be SYMMETRIC there:
+    interpret mode always moves whole blocks (validating addressing
+    and semaphore schedules); the dynamic ragged trip counts are a
+    hardware feature, compile-proven by the AOT gate."""
+    full = (max_rows + chunk - 1) // chunk
+
+    def nchunks(rows):
+        if interpret:
+            return full
+        return (rows + chunk - 1) // chunk
+
+    return nchunks
 
 
 def _rows_for(elems: int) -> int:
@@ -1498,7 +1501,13 @@ def all_gather_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
     Rp = -(-R // chunk_rows) * chunk_rows
     if Rp != R:
         x = jnp.pad(x, ((0, 0), (0, Rp - R), (0, 0)))
-    counts = jnp.asarray(counts, jnp.int32)
+    # clamp to the block size (see all_to_all_v: an oversized count
+    # means out-of-bounds remote DMA on hardware)
+    counts = jnp.clip(jnp.asarray(counts, jnp.int32), 0, R)
+    if counts.shape != (n,):
+        raise ValueError(
+            f"all_gather_v needs ({n},) counts, got "
+            f"{tuple(counts.shape)}")
     fn = _jit_all_gather_v(mesh, axis, Rp, int(x.shape[2]), chunk_rows,
                            str(x.dtype), interpret)
     out = fn(counts, x)
@@ -1558,7 +1567,14 @@ def all_to_all_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
     Rp = -(-R // chunk_rows) * chunk_rows
     if Rp != R:
         x = jnp.pad(x, ((0, 0), (0, 0), (0, Rp - R), (0, 0)))
-    counts = jnp.asarray(counts, jnp.int32)
+    # clamp to the block size: a count beyond R would drive the chunk
+    # loops past the block on hardware — out-of-bounds remote DMA into
+    # the neighbor's adjacent slot, not an error
+    counts = jnp.clip(jnp.asarray(counts, jnp.int32), 0, R)
+    if counts.shape != (n, n):
+        raise ValueError(
+            f"all_to_all_v needs an ({n}, {n}) counts table, got "
+            f"{tuple(counts.shape)}")
     fn = _jit_all_to_all_v(mesh, axis, Rp, int(x.shape[3]), chunk_rows,
                            str(x.dtype), interpret)
     out = fn(counts, x)
