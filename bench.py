@@ -934,6 +934,9 @@ def _pallas_first_run(devs, mesh, interp: bool) -> dict:
     chk("allreduce_max",
         pc.all_reduce(put(x), mesh, "x", "max", interpret=interp),
         x.max(0), tol=1e-6)
+    chk("allreduce_wire16",
+        pc.all_reduce(put(x), mesh, "x", "sum", interpret=interp,
+                      variant="wire16"), x.sum(0), tol=0.25)
     chk("reduce_scatter",
         pc.reduce_scatter(put(x2), mesh, "x", "sum", interpret=interp),
         x2.sum(0))

@@ -47,7 +47,7 @@ class PallasCollModule:
     def __init__(self, comm, devices, axis_name: str, interpret: bool,
                  max_bytes: int, vmem_max_bytes: int,
                  seg_bytes: int, bidirectional: bool,
-                 min_bytes: int = 0) -> None:
+                 min_bytes: int = 0, wire16: bool = False) -> None:
         import jax
         from jax.sharding import Mesh
 
@@ -61,6 +61,7 @@ class PallasCollModule:
         self.vmem_max_bytes = vmem_max_bytes
         self.seg_bytes = seg_bytes
         self.bidirectional = bidirectional
+        self.wire16 = wire16
         self._jax_array = jax.Array
         self._fallback = None   # resolved at comm_enable
 
@@ -128,6 +129,11 @@ class PallasCollModule:
         from ompi_tpu.ops import pallas_collectives as pc
 
         variant, seg_elems = self._route(x)
+        if (self.wire16 and ring_op == "sum"
+                and str(x.dtype) == "float32" and variant == "fused"):
+            # opt-in compressed wire (f32 acc, bf16 bytes); only the
+            # fused regime has a wire16 kernel so far
+            variant = "wire16"
         return pc.all_reduce(x, self.mesh, self.axis, ring_op,
                              interpret=self.interpret, variant=variant,
                              seg_elems=seg_elems)
@@ -349,6 +355,14 @@ class PallasCollComponent(Component):
             help="Use the bidirectional ring all-reduce (both ICI "
                  "directions carry half the payload each step) for "
                  "fused-size payloads")
+        self._wire16 = self.register_var(
+            "wire16", vtype=VarType.BOOL, default=False,
+            help="Opt-in wire compression for float32 SUM allreduce: "
+                 "f32 accumulation, bf16 bytes on the ICI — halves "
+                 "per-step wire time at bf16 value precision "
+                 "(bit-identical across ranks; worst-case error "
+                 "O(n*2^-8) relative to partial magnitudes).  Changes "
+                 "numerics, so never on by default")
         self._axis = self.register_var(
             "axis_name", default="mpi",
             help="Mesh axis name for coll/pallas kernels")
@@ -378,7 +392,8 @@ class PallasCollComponent(Component):
             vmem_max_bytes=int(self._vmem_max.value),
             seg_bytes=int(self._seg.value),
             bidirectional=bool(self._bidi.value),
-            min_bytes=int(self._min.value))
+            min_bytes=int(self._min.value),
+            wire16=bool(self._wire16.value))
 
 
 COMPONENT = PallasCollComponent()
