@@ -120,6 +120,18 @@ class PallasCollModule:
             return "bidi", None
         return "fused", None
 
+    def _allreduce_variant(self, x, ring_op):
+        """ONE routing rule for one-shot AND persistent allreduce (a
+        persistent handle must never diverge numerically from the
+        one-shot slot it mirrors)."""
+        variant, seg_elems = self._route(x)
+        if (self.wire16 and ring_op == "sum"
+                and str(x.dtype) == "float32" and variant == "fused"):
+            # opt-in compressed wire (f32 acc, bf16 bytes); only the
+            # fused regime has a wire16 kernel so far
+            variant = "wire16"
+        return variant, seg_elems
+
     # -- collective slots ------------------------------------------------
     def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
         x = self._place(comm, x)
@@ -128,12 +140,7 @@ class PallasCollModule:
             return self._delegate("allreduce_array", comm, x, op)
         from ompi_tpu.ops import pallas_collectives as pc
 
-        variant, seg_elems = self._route(x)
-        if (self.wire16 and ring_op == "sum"
-                and str(x.dtype) == "float32" and variant == "fused"):
-            # opt-in compressed wire (f32 acc, bf16 bytes); only the
-            # fused regime has a wire16 kernel so far
-            variant = "wire16"
+        variant, seg_elems = self._allreduce_variant(x, ring_op)
         return pc.all_reduce(x, self.mesh, self.axis, ring_op,
                              interpret=self.interpret, variant=variant,
                              seg_elems=seg_elems)
@@ -256,7 +263,8 @@ class PallasCollModule:
         from ompi_tpu.ops import pallas_collectives as pc
 
         if coll == "allreduce":
-            variant, seg_elems = self._route(template)
+            variant, seg_elems = self._allreduce_variant(template,
+                                                         ring_op)
 
             def fn(x, v=variant, s=seg_elems):
                 return pc.all_reduce(x, self.mesh, self.axis, ring_op,
