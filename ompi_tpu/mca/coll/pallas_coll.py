@@ -166,6 +166,9 @@ class PallasCollModule:
             variant, seg_elems = "fused", None
         elif variant == "seg_bidi":  # ...so large payloads keep the
             variant = "seg"          # segmented HBM bound unidirectional
+        if (self.wire16 and ring_op == "sum"
+                and str(x.dtype) == "float32" and variant == "fused"):
+            variant = "wire16"       # same opt-in codec as allreduce
         return pc.reduce_scatter(x, self.mesh, self.axis, ring_op,
                                  interpret=self.interpret, variant=variant,
                                  seg_elems=seg_elems)

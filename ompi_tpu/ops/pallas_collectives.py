@@ -415,16 +415,22 @@ def _build_all_reduce_wire16(n: int, axis: str, rows: int,
 @functools.lru_cache(maxsize=64)
 def _build_reduce_scatter(n: int, axis: str, rows: int, dtype_str: str,
                           interpret: bool, op: str = "sum",
-                          sub=None):
+                          sub=None, wire16: bool = False):
     """Ring reduce-scatter: n-1 steps, fold fused into the ring;
     device i ends owning fully-reduced block i (the first half of
     ``coll_base_allreduce.c:341``'s ring, block-owner aligned).
-    Blocks are (rows, 128) — see ``_rs_phase`` on the layout."""
+    Blocks are (rows, 128) — see ``_rs_phase`` on the layout.
+
+    ``wire16`` (f32 payloads): partials cross the wire at bf16 through
+    ``_rs_phase``'s codec hooks, folds stay f32, and — unlike the
+    all-reduce twin — the owner's result needs no rounding pass: each
+    block lives on exactly one rank, so full-f32 output is returned
+    (absolute error ~n·2^-8·max|partial| from the wire roundings)."""
     jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(n, axis, interpret)
     fold = _op_fn(jnp, op)
 
     def kernel(x_ref, out_ref, acc_ref, recv_ref,
-               local_sem, send_sem, rs_sems):
+               local_sem, send_sem, rs_sems, *maybe_stage):
         my, dev = _ring_fn(lax, axis, sub)
         right = dev(lax.rem(my + 1, n))
         barrier(right, dev(lax.rem(my - 1 + n, n)))
@@ -436,7 +442,10 @@ def _build_reduce_scatter(n: int, axis: str, rows: int, dtype_str: str,
         done = _rs_phase(lax, pl, pltpu, n=n, my=my, right=right,
                          acc_ref=acc_ref, recv_ref=recv_ref,
                          send_sem=send_sem, rs_sems=rs_sems, align=-1,
-                         fold=fold)
+                         fold=fold,
+                         stage_ref=maybe_stage[0] if wire16 else None,
+                         decode=(lambda p: p.astype(jnp.float32))
+                         if wire16 else None)
         cp2 = pltpu.make_async_copy(acc_ref.at[done], out_ref, local_sem)
         cp2.start()
         cp2.wait()
@@ -446,18 +455,21 @@ def _build_reduce_scatter(n: int, axis: str, rows: int, dtype_str: str,
         cp = cparams(4)
         if cp is not None:
             kw["compiler_params"] = cp
+        dt = jnp.dtype(dtype_str)
+        recv_dt = jnp.dtype("bfloat16") if wire16 else dt
+        scratch = [pltpu.VMEM((n, rows, 128), dt),
+                   pltpu.VMEM((n - 1, rows, 128), recv_dt),
+                   pltpu.SemaphoreType.DMA(()),
+                   pltpu.SemaphoreType.DMA(()),
+                   pltpu.SemaphoreType.DMA((n - 1,))]
+        if wire16:
+            scratch.append(pltpu.VMEM((rows, 128), recv_dt))
         return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((rows, 128), dtype_str),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[pltpu.VMEM((n, rows, 128),
-                                       jnp.dtype(dtype_str)),
-                            pltpu.VMEM((n - 1, rows, 128),
-                                       jnp.dtype(dtype_str)),
-                            pltpu.SemaphoreType.DMA(()),
-                            pltpu.SemaphoreType.DMA(()),
-                            pltpu.SemaphoreType.DMA((n - 1,))],
+            scratch_shapes=scratch,
             interpret=interpret,
             **kw,
         )(x)
@@ -1401,6 +1413,14 @@ def _jit_reduce_scatter(mesh, axis: str, payload_shape, dtype_str: str,
         inner = _build_reduce_scatter_seg(n, axis, rows // srows, srows,
                                           dtype_str, interpret, op)
         shape_in = (n, rows // srows, srows, 128)
+    elif variant == "wire16":
+        if dtype_str not in ("float32", "f32"):
+            raise ValueError(
+                "wire16 compresses float32 payloads to bf16 wire "
+                f"bytes; got dtype {dtype_str}")
+        inner = _build_reduce_scatter(n, axis, rows, dtype_str,
+                                      interpret, op, wire16=True)
+        shape_in = (n, rows, 128)
     else:
         inner = _build_reduce_scatter(n, axis, rows, dtype_str,
                                       interpret, op)

@@ -128,6 +128,10 @@ def cases(mesh1d, mesh2d):
         pc._jit_all_reduce(mesh1d, "x", (n * PAY,), "float32", "sum",
                            False, "wire16", None),
         (ring_arg((n * PAY,)),)))
+    case("reduce_scatter_wire16", lambda: (
+        pc._jit_reduce_scatter(mesh1d, "x", (PAY,), "float32", "sum",
+                               False, "wire16", None),
+        (_sds((n, n, PAY), f32, mesh1d, P("x")),)))
     case("all_to_all", lambda: (
         pc._jit_all_to_all(mesh1d, "x", (8, 128), "float32", False),
         (_sds((n, n, 8, 128), f32, mesh1d, P("x")),)))

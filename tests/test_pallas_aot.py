@@ -37,7 +37,7 @@ def test_all_kernels_aot_compile():
     for expect in ("right_permute", "all_gather", "reduce_scatter_fused",
                    "reduce_scatter_seg", "all_reduce_fused",
                    "all_reduce_seg", "all_reduce_bidi",
-                   "all_reduce_seg_bidi", "all_reduce_max", "all_reduce_wire16",
+                   "all_reduce_seg_bidi", "all_reduce_max", "all_reduce_wire16", "reduce_scatter_wire16",
                    "all_to_all", "all_to_all_v_ragged", "all_gather_v_ragged", "bcast",
                    "all_reduce_torus", "matmul_allreduce",
                    "matmul_reduce_scatter"):
