@@ -22,6 +22,10 @@ import numpy as np
 
 from ompi_tpu.datatype.core import Datatype
 
+# whole-element pack jobs at least this many bytes fan out over the
+# threads-framework worker pool instead of the single-thread native loop
+_POOL_PACK_MIN = 256 * 1024
+
 
 class ConvertorFlags(enum.IntFlag):
     NONE = 0
@@ -166,6 +170,26 @@ class Convertor:
             from ompi_tpu import native
 
             view = packed[: nelem * dt.size]
+            # big jobs go wide: the threads framework's pool splits the
+            # element loop across native workers (the GIL-free analog of
+            # the reference running its pack engine on progress threads)
+            if nelem * dt.size >= _POOL_PACK_MIN:
+                from ompi_tpu.mca.threads import base as threads_base
+
+                pool = threads_base.get_pool()
+                if getattr(pool, "parallel_pack", False) and pool.size > 1:
+                    if to_packed:
+                        pool.pack(self._mem, view, self._seg_offs,
+                                  self._seg_lens, dt.extent,
+                                  self.base_offset, first_elem,
+                                  nelem).wait()
+                    else:
+                        chunk = np.ascontiguousarray(view)
+                        pool.unpack(self._mem, chunk, self._seg_offs,
+                                    self._seg_lens, dt.extent,
+                                    self.base_offset, first_elem,
+                                    nelem).wait()
+                    return
             if to_packed:
                 native.pack_elems(self._mem, view, self._seg_offs,
                                   self._seg_lens, dt.extent,

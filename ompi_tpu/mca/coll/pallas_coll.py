@@ -132,6 +132,19 @@ class PallasCollModule:
             variant = "wire16"
         return variant, seg_elems
 
+    def _reduce_scatter_variant(self, x, ring_op):
+        """ONE routing rule for one-shot AND persistent reduce_scatter
+        (same never-diverge contract as ``_allreduce_variant``)."""
+        variant, seg_elems = self._route(x)
+        if variant == "bidi":        # no bidi reduce-scatter kernel (yet)
+            variant, seg_elems = "fused", None
+        elif variant == "seg_bidi":  # ...so large payloads keep the
+            variant = "seg"          # segmented HBM bound unidirectional
+        if (self.wire16 and ring_op == "sum"
+                and str(x.dtype) == "float32" and variant == "fused"):
+            variant = "wire16"       # same opt-in codec as allreduce
+        return variant, seg_elems
+
     # -- collective slots ------------------------------------------------
     def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
         x = self._place(comm, x)
@@ -161,14 +174,7 @@ class PallasCollModule:
             return self._delegate("reduce_scatter_array", comm, x, op)
         from ompi_tpu.ops import pallas_collectives as pc
 
-        variant, seg_elems = self._route(x)
-        if variant == "bidi":       # no bidi reduce-scatter kernel (yet)
-            variant, seg_elems = "fused", None
-        elif variant == "seg_bidi":  # ...so large payloads keep the
-            variant = "seg"          # segmented HBM bound unidirectional
-        if (self.wire16 and ring_op == "sum"
-                and str(x.dtype) == "float32" and variant == "fused"):
-            variant = "wire16"       # same opt-in codec as allreduce
+        variant, seg_elems = self._reduce_scatter_variant(x, ring_op)
         return pc.reduce_scatter(x, self.mesh, self.axis, ring_op,
                                  interpret=self.interpret, variant=variant,
                                  seg_elems=seg_elems)
@@ -274,11 +280,8 @@ class PallasCollModule:
                                      interpret=self.interpret,
                                      variant=v, seg_elems=s)
         elif coll == "reduce_scatter":
-            variant, seg_elems = self._route(template)
-            if variant == "bidi":       # same remaps as the one-shot slot
-                variant, seg_elems = "fused", None
-            elif variant == "seg_bidi":
-                variant = "seg"
+            variant, seg_elems = self._reduce_scatter_variant(template,
+                                                              ring_op)
 
             def fn(x, v=variant, s=seg_elems):
                 return pc.reduce_scatter(x, self.mesh, self.axis,

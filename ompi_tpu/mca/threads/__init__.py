@@ -1,0 +1,17 @@
+"""MCA ``threads`` framework — the host-path threading substrate.
+
+Reference: ``opal/mca/threads/`` — the pluggable layer (pthreads,
+argobots, qthreads) everything above uses for threads, mutexes, and
+condition variables, so the whole stack can be rebuilt on a different
+concurrency substrate at configure time.
+
+The TPU-native translation: Python-level thread *API* concurrency is
+absorbed by :mod:`threading` (and stays GIL-serialised — see
+COVERAGE.md), so what this framework actually provides is the part the
+GIL takes away: a worker pool executing the host data path's tight
+loops (memcpy, datatype pack/unpack, elementwise reduction math) as
+pure native code in true parallel.  Components compete to provide the
+pool; ``threads/native`` backs it with the C++ pool in
+``native/otpu_native.cc``, ``threads/python`` is the degraded but
+always-available fallback.
+"""

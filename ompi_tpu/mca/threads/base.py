@@ -1,0 +1,126 @@
+"""threads framework base: the WorkPool contract + process-global pool.
+
+Reference: ``opal/mca/threads/thread.h`` (create/join et al.) collapses
+here to one surface — a work pool with typed jobs — because the jobs
+the reference spreads across raw threads (progress loops, pack engines,
+reduction math) are exactly the typed loops the native core implements.
+
+Jobs return a :class:`Work` handle (``test``/``wait``), mirroring the
+request-completion idiom of the rest of the stack so callers can overlap
+a background pack with their own work and complete it like any request.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.base import mca
+
+
+class Work:
+    """Completion handle for one submitted pool job."""
+
+    def test(self) -> bool:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def wait(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class CompletedWork(Work):
+    """Already-done job (inline execution paths)."""
+
+    def test(self) -> bool:
+        return True
+
+    def wait(self) -> None:
+        return
+
+
+class WorkPool:
+    """The substrate contract: typed parallel jobs over ``size`` workers.
+
+    All addresses are raw byte addresses (``ndarray.ctypes.data``);
+    arrays passed whole must be C-contiguous.  The caller owns buffer
+    lifetimes until ``wait`` returns — the ``memchecker`` freeze idiom
+    applies exactly as it does to nonblocking sends.
+    """
+
+    size: int = 1
+    #: True when pack/unpack actually run as parallel native loops —
+    #: the convertor only fans out when the substrate makes it a win
+    parallel_pack: bool = False
+
+    def memcpy(self, dst: np.ndarray, src: np.ndarray) -> Work:
+        raise NotImplementedError  # pragma: no cover - interface
+
+    def reduce(self, op: str, acc: np.ndarray,
+               src: np.ndarray) -> Work:
+        """Elementwise ``acc = acc <op> src`` (sum/prod/max/min)."""
+        raise NotImplementedError  # pragma: no cover - interface
+
+    def pack(self, mem: np.ndarray, out: np.ndarray, seg_off, seg_len,
+             extent: int, base_offset: int, first_elem: int,
+             nelem: int) -> Work:
+        raise NotImplementedError  # pragma: no cover - interface
+
+    def unpack(self, mem: np.ndarray, chunk: np.ndarray, seg_off,
+               seg_len, extent: int, base_offset: int, first_elem: int,
+               nelem: int) -> Work:
+        raise NotImplementedError  # pragma: no cover - interface
+
+    def close(self) -> None:  # pragma: no cover - hook
+        pass
+
+
+class ThreadsComponent(mca.Component):
+    """A threads component builds WorkPools."""
+
+    def make_pool(self, nworkers: int) -> WorkPool:
+        raise NotImplementedError  # pragma: no cover - interface
+
+
+_pool: Optional[WorkPool] = None
+_pool_lock = threading.Lock()
+
+
+def framework() -> mca.Framework:
+    return mca.framework("threads", "host-path threading substrate")
+
+
+def default_workers() -> int:
+    import os
+
+    var = mca.registry.lookup("otpu_threads_pool_workers")
+    if var is not None and int(var.value) > 0:
+        return int(var.value)
+    return max(2, min(4, os.cpu_count() or 2))
+
+
+def get_pool() -> WorkPool:
+    """Process-global pool from the selected component (lazy)."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            comp = framework().select()
+            if comp is None:  # python component always opens; belt+braces
+                from ompi_tpu.mca.threads.python import COMPONENT as comp
+            _pool = comp.make_pool(default_workers())
+        return _pool
+
+
+def shutdown_pool() -> None:
+    global _pool
+    with _pool_lock:
+        if _pool is not None:
+            _pool.close()
+            _pool = None
+
+
+mca.registry.register(
+    "threads", "pool", "workers",
+    vtype=mca.VarType.INT, default=0,
+    help="Worker count for the threads framework's work pool "
+         "(0 = auto: min(4, cpu_count))")

@@ -51,7 +51,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 tmp = so + f".tmp{os.getpid()}"
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", tmp],
+                     "-pthread", _SRC, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, so)
             lib = ctypes.CDLL(so)
@@ -99,6 +99,32 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.otpu_atomic_store_u64.restype = None
         lib.otpu_atomic_store_u64.argtypes = [ctypes.c_void_p,
                                               ctypes.c_uint64]
+        # worker pool (mca/threads native substrate)
+        lib.otpu_pool_create.restype = ctypes.c_int64
+        lib.otpu_pool_create.argtypes = [ctypes.c_int32]
+        lib.otpu_pool_destroy.restype = None
+        lib.otpu_pool_destroy.argtypes = [ctypes.c_int64]
+        lib.otpu_pool_size.restype = ctypes.c_int32
+        lib.otpu_pool_size.argtypes = [ctypes.c_int64]
+        lib.otpu_pool_memcpy.restype = ctypes.c_int64
+        lib.otpu_pool_memcpy.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.otpu_pool_reduce.restype = ctypes.c_int64
+        lib.otpu_pool_reduce.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        for name in ("otpu_pool_pack", "otpu_pool_unpack"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [
+                ctypes.c_int64, _U8P, _U8P, _I64P, _I64P,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64]
+        lib.otpu_pool_test.restype = ctypes.c_int32
+        lib.otpu_pool_test.argtypes = [ctypes.c_int64]
+        lib.otpu_pool_wait.restype = None
+        lib.otpu_pool_wait.argtypes = [ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -165,6 +191,66 @@ def atomic_load_u64(addr: int) -> int:
 
 def atomic_store_u64(addr: int, v: int) -> None:
     _load().otpu_atomic_store_u64(addr, v)
+
+
+# -- worker pool (mca/threads native substrate) ---------------------------
+
+#: reduce op codes shared with otpu_pool_reduce
+POOL_OPS = {"sum": 0, "prod": 1, "max": 2, "min": 3}
+#: dtype codes shared with otpu_pool_reduce
+POOL_DTYPES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3}
+
+
+def pool_create(nthreads: int) -> int:
+    return int(_load().otpu_pool_create(nthreads))
+
+
+def pool_destroy(handle: int) -> None:
+    _load().otpu_pool_destroy(handle)
+
+
+def pool_size(handle: int) -> int:
+    return int(_load().otpu_pool_size(handle))
+
+
+def pool_memcpy(handle: int, dst_addr: int, src_addr: int,
+                nbytes: int) -> int:
+    """Parallel memcpy; returns a ticket for pool_wait/pool_test."""
+    return int(_load().otpu_pool_memcpy(handle, dst_addr, src_addr, nbytes))
+
+
+def pool_reduce(handle: int, op: str, dtype: str, acc_addr: int,
+                src_addr: int, count: int) -> int:
+    """Parallel elementwise ``acc = acc <op> src``; returns a ticket."""
+    return int(_load().otpu_pool_reduce(
+        handle, POOL_OPS[op], POOL_DTYPES[dtype], acc_addr, src_addr,
+        count))
+
+
+def pool_pack(handle: int, mem: np.ndarray, out: np.ndarray,
+              seg_off: np.ndarray, seg_len: np.ndarray, extent: int,
+              base_offset: int, first_elem: int, nelem: int) -> int:
+    """Parallel whole-element gather (pack_elems split over workers)."""
+    return int(_load().otpu_pool_pack(
+        handle, mem, out, seg_off, seg_len, len(seg_off), extent,
+        base_offset, first_elem, nelem))
+
+
+def pool_unpack(handle: int, mem: np.ndarray, chunk: np.ndarray,
+                seg_off: np.ndarray, seg_len: np.ndarray, extent: int,
+                base_offset: int, first_elem: int, nelem: int) -> int:
+    return int(_load().otpu_pool_unpack(
+        handle, mem, chunk, seg_off, seg_len, len(seg_off), extent,
+        base_offset, first_elem, nelem))
+
+
+def pool_test(ticket: int) -> bool:
+    return bool(_load().otpu_pool_test(ticket))
+
+
+def pool_wait(ticket: int) -> None:
+    """Block until done and free the ticket (call exactly once)."""
+    _load().otpu_pool_wait(ticket)
 
 
 # -- sm ring entry points -------------------------------------------------

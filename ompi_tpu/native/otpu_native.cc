@@ -8,11 +8,19 @@
 // through ctypes when the shared library is available and fall back to
 // their numpy implementations otherwise.
 //
-// Build: g++ -O3 -shared -fPIC otpu_native.cc -o libotpu_native.so
-// (driven lazily by ompi_tpu/native/__init__.py).
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread otpu_native.cc
+//        -o libotpu_native.so
+// (driven lazily by ompi_tpu/native/__init__.py; -pthread is required
+// by the worker pool's std::thread).
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
 
 extern "C" {
 
@@ -225,6 +233,271 @@ uint64_t otpu_atomic_load_u64(const uint8_t *ptr) {
 
 void otpu_atomic_store_u64(uint8_t *ptr, uint64_t v) {
     __atomic_store_n((uint64_t *)ptr, v, __ATOMIC_RELEASE);
+}
+
+// ---- threads: native worker pool ---------------------------------------
+//
+// The reference's threading substrate (`opal/mca/threads/threads.h`) gives
+// the host data path real OS threads — progress, packing, and reduction
+// math run concurrently with the application.  A Python framework cannot
+// get that from `threading` (the GIL serialises it), so the pool lives
+// here: jobs are split into per-worker chunks of pure C++ (memcpy, the
+// datatype element loops above, elementwise reduction math), ctypes drops
+// the GIL for the submitting call, and the workers never touch Python.
+// One job -> one ticket; a ticket completes when every chunk ran.
+
+}  // extern "C" (the pool internals below are C++; the API re-opens it)
+
+namespace {
+
+struct OtpuTicket {
+    std::atomic<int64_t> remaining;
+    std::mutex m;
+    std::condition_variable cv;
+    explicit OtpuTicket(int64_t n) : remaining(n) {}
+};
+
+struct OtpuChunk {
+    int32_t kind;            // 0 memcpy, 1 pack, 2 unpack, 3 reduce
+    OtpuTicket *ticket;
+    uint8_t *dst;
+    const uint8_t *src;
+    int64_t n;
+    int32_t op, dtype;       // reduce: op 0 sum 1 prod 2 max 3 min;
+                             // dtype 0 f32 1 f64 2 i32 3 i64
+    const int64_t *seg_off, *seg_len;
+    int64_t nseg, extent, base_offset, first_elem, nelem;
+};
+
+template <typename T>
+static void reduce_span(T *acc, const T *src, int64_t count, int32_t op) {
+    switch (op) {
+    case 0: for (int64_t i = 0; i < count; ++i) acc[i] += src[i]; break;
+    case 1: for (int64_t i = 0; i < count; ++i) acc[i] *= src[i]; break;
+    case 2: for (int64_t i = 0; i < count; ++i)
+                acc[i] = acc[i] < src[i] ? src[i] : acc[i];
+            break;
+    default: for (int64_t i = 0; i < count; ++i)
+                acc[i] = src[i] < acc[i] ? src[i] : acc[i];
+    }
+}
+
+static void run_chunk(const OtpuChunk &c) {
+    switch (c.kind) {
+    case 0:
+        std::memcpy(c.dst, c.src, (size_t)c.n);
+        break;
+    case 1:
+        otpu_pack_elems(c.src, c.dst, c.seg_off, c.seg_len, c.nseg,
+                        c.extent, c.base_offset, c.first_elem, c.nelem);
+        break;
+    case 2:
+        otpu_unpack_elems(c.dst, c.src, c.seg_off, c.seg_len, c.nseg,
+                          c.extent, c.base_offset, c.first_elem, c.nelem);
+        break;
+    default:
+        switch (c.dtype) {
+        case 0: reduce_span((float *)c.dst, (const float *)c.src,
+                            c.n, c.op); break;
+        case 1: reduce_span((double *)c.dst, (const double *)c.src,
+                            c.n, c.op); break;
+        case 2: reduce_span((int32_t *)c.dst, (const int32_t *)c.src,
+                            c.n, c.op); break;
+        default: reduce_span((int64_t *)c.dst, (const int64_t *)c.src,
+                             c.n, c.op);
+        }
+    }
+}
+
+struct OtpuPool {
+    std::vector<std::thread> workers;
+    std::deque<OtpuChunk> queue;
+    std::mutex m;
+    std::condition_variable cv;
+    bool stop = false;
+
+    explicit OtpuPool(int32_t n) {
+        for (int32_t i = 0; i < n; ++i)
+            workers.emplace_back([this] { loop(); });
+    }
+
+    void loop() {
+        for (;;) {
+            OtpuChunk c;
+            {
+                std::unique_lock<std::mutex> lk(m);
+                cv.wait(lk, [this] { return stop || !queue.empty(); });
+                if (queue.empty())
+                    return;            // stop && drained
+                c = queue.front();
+                queue.pop_front();
+            }
+            run_chunk(c);
+            {
+                // decrement under the ticket mutex: a waiter holding it
+                // cannot observe remaining==0 and free the ticket while
+                // this worker is still about to touch it
+                std::lock_guard<std::mutex> lk(c.ticket->m);
+                if (c.ticket->remaining.fetch_sub(
+                        1, std::memory_order_acq_rel) == 1)
+                    c.ticket->cv.notify_all();
+            }
+        }
+    }
+
+    OtpuTicket *submit(std::vector<OtpuChunk> &chunks) {
+        OtpuTicket *t = new OtpuTicket((int64_t)chunks.size());
+        {
+            std::lock_guard<std::mutex> lk(m);
+            for (auto &c : chunks) {
+                c.ticket = t;
+                queue.push_back(c);
+            }
+        }
+        cv.notify_all();
+        return t;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t otpu_pool_create(int32_t nthreads) {
+    if (nthreads < 1)
+        nthreads = 1;
+    return (int64_t)(intptr_t) new OtpuPool(nthreads);
+}
+
+void otpu_pool_destroy(int64_t pool) {
+    OtpuPool *p = (OtpuPool *)(intptr_t)pool;
+    {
+        std::lock_guard<std::mutex> lk(p->m);
+        p->stop = true;
+    }
+    p->cv.notify_all();
+    for (auto &w : p->workers)
+        w.join();
+    delete p;
+}
+
+int32_t otpu_pool_size(int64_t pool) {
+    return (int32_t)((OtpuPool *)(intptr_t)pool)->workers.size();
+}
+
+// Split [0, n) into per-worker spans of at least `grain` units.
+static std::vector<std::pair<int64_t, int64_t>> spans(
+        int64_t n, int64_t nworkers, int64_t grain) {
+    int64_t pieces = n / grain;
+    if (pieces > nworkers) pieces = nworkers;
+    if (pieces < 1) pieces = 1;
+    std::vector<std::pair<int64_t, int64_t>> out;
+    int64_t per = n / pieces, rem = n % pieces, at = 0;
+    for (int64_t i = 0; i < pieces; ++i) {
+        int64_t len = per + (i < rem ? 1 : 0);
+        out.emplace_back(at, len);
+        at += len;
+    }
+    return out;
+}
+
+int64_t otpu_pool_memcpy(int64_t pool, uint8_t *dst, const uint8_t *src,
+                         int64_t n) {
+    OtpuPool *p = (OtpuPool *)(intptr_t)pool;
+    std::vector<OtpuChunk> cs;
+    for (auto &sp : spans(n, (int64_t)p->workers.size(), 1 << 16)) {
+        OtpuChunk c{};
+        c.kind = 0;
+        c.dst = dst + sp.first;
+        c.src = src + sp.first;
+        c.n = sp.second;
+        cs.push_back(c);
+    }
+    return (int64_t)(intptr_t)p->submit(cs);
+}
+
+int64_t otpu_pool_reduce(int64_t pool, int32_t op, int32_t dtype,
+                         uint8_t *acc, const uint8_t *src, int64_t count) {
+    OtpuPool *p = (OtpuPool *)(intptr_t)pool;
+    int64_t esz = (dtype == 0 || dtype == 2) ? 4 : 8;
+    std::vector<OtpuChunk> cs;
+    for (auto &sp : spans(count, (int64_t)p->workers.size(), 1 << 14)) {
+        OtpuChunk c{};
+        c.kind = 3;
+        c.op = op;
+        c.dtype = dtype;
+        c.dst = acc + sp.first * esz;
+        c.src = src + sp.first * esz;
+        c.n = sp.second;
+        cs.push_back(c);
+    }
+    return (int64_t)(intptr_t)p->submit(cs);
+}
+
+static int64_t pool_packish(int64_t pool, int32_t kind, uint8_t *mem,
+                            uint8_t *stream, const int64_t *seg_off,
+                            const int64_t *seg_len, int64_t nseg,
+                            int64_t extent, int64_t base_offset,
+                            int64_t first_elem, int64_t nelem) {
+    OtpuPool *p = (OtpuPool *)(intptr_t)pool;
+    int64_t elem_packed = 0;
+    for (int64_t j = 0; j < nseg; ++j)
+        elem_packed += seg_len[j];
+    std::vector<OtpuChunk> cs;
+    for (auto &sp : spans(nelem, (int64_t)p->workers.size(), 64)) {
+        OtpuChunk c{};
+        c.kind = kind;
+        uint8_t *schunk = stream + sp.first * elem_packed;
+        if (kind == 1) {               // pack: mem -> stream
+            c.src = mem;
+            c.dst = schunk;
+        } else {                       // unpack: stream -> mem
+            c.dst = mem;
+            c.src = schunk;
+        }
+        c.seg_off = seg_off;
+        c.seg_len = seg_len;
+        c.nseg = nseg;
+        c.extent = extent;
+        c.base_offset = base_offset;
+        c.first_elem = first_elem + sp.first;
+        c.nelem = sp.second;
+        cs.push_back(c);
+    }
+    return (int64_t)(intptr_t)p->submit(cs);
+}
+
+int64_t otpu_pool_pack(int64_t pool, uint8_t *mem, uint8_t *out,
+                       const int64_t *seg_off, const int64_t *seg_len,
+                       int64_t nseg, int64_t extent, int64_t base_offset,
+                       int64_t first_elem, int64_t nelem) {
+    return pool_packish(pool, 1, mem, out, seg_off, seg_len, nseg, extent,
+                        base_offset, first_elem, nelem);
+}
+
+int64_t otpu_pool_unpack(int64_t pool, uint8_t *mem, uint8_t *in,
+                         const int64_t *seg_off, const int64_t *seg_len,
+                         int64_t nseg, int64_t extent, int64_t base_offset,
+                         int64_t first_elem, int64_t nelem) {
+    return pool_packish(pool, 2, mem, in, seg_off, seg_len, nseg, extent,
+                        base_offset, first_elem, nelem);
+}
+
+int32_t otpu_pool_test(int64_t ticket) {
+    OtpuTicket *t = (OtpuTicket *)(intptr_t)ticket;
+    return t->remaining.load(std::memory_order_acquire) == 0 ? 1 : 0;
+}
+
+// Blocks until done, then frees the ticket (call exactly once).
+void otpu_pool_wait(int64_t ticket) {
+    OtpuTicket *t = (OtpuTicket *)(intptr_t)ticket;
+    {
+        std::unique_lock<std::mutex> lk(t->m);
+        t->cv.wait(lk, [t] {
+            return t->remaining.load(std::memory_order_acquire) == 0;
+        });
+    }
+    delete t;
 }
 
 }  // extern "C"

@@ -307,6 +307,9 @@ def finalize() -> None:
                     fin()
             if _rte is not None:
                 _rte.finalize()
+            from ompi_tpu.mca.threads import base as _threads_base
+
+            _threads_base.shutdown_pool()
             mca.close_all()
         finally:
             from ompi_tpu.runtime import progress

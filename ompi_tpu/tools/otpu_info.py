@@ -20,7 +20,7 @@ import sys
 # the static component universe (the autogen.pl role: every framework the
 # build knows about, discovered via import so registration side-effects run)
 _FRAMEWORK_NAMES = ("pml", "bml", "btl", "coll", "osc", "io", "topo",
-                    "accelerator")
+                    "accelerator", "threads")
 
 
 def _discover_all():

@@ -623,6 +623,35 @@ def test_component_wire16_opt_in(pallas_world):
         mod.wire16 = old
 
 
+def test_component_wire16_persistent_matches_oneshot(pallas_world):
+    """A persistent reduce_scatter handle must route through the SAME
+    wire16 upgrade as the one-shot slot — identical inputs, identical
+    (compressed-wire) answers (regression: the persistent branch once
+    skipped the wire16 remap and silently diverged numerically)."""
+    w = pallas_world
+    mod = w.c_coll["reduce_scatter_array"].__self__
+    assert mod.__class__.__name__ == "PallasCollModule"
+    old = mod.wire16
+    mod.wire16 = True
+    try:
+        rng = np.random.default_rng(13)
+        host = rng.standard_normal((8, 8, 128)).astype(np.float32)
+        from ompi_tpu.api import op
+
+        one_shot = np.asarray(w.reduce_scatter_array(host, op.SUM))
+        handle = w.c_coll["persistent_coll"](w, "reduce_scatter", host,
+                                             op.SUM)
+        persistent = np.asarray(handle(host))
+        np.testing.assert_array_equal(persistent, one_shot)
+        # and the compressed wire really ran: full-precision answer
+        # (wire16 off) must differ
+        mod.wire16 = False
+        exact = np.asarray(w.reduce_scatter_array(host, op.SUM))
+        assert not np.allclose(one_shot, exact, rtol=1e-6)
+    finally:
+        mod.wire16 = old
+
+
 def test_kernel_reduce_scatter_wire16(mesh):
     """Wire-compressed reduce-scatter: bf16 on the wire, f32 folds and
     f32 owner output (no cross-rank rounding needed: each block lives
