@@ -119,6 +119,22 @@ def shutdown_pool() -> None:
             _pool = None
 
 
+def _reset_after_fork() -> None:
+    # native worker threads do not survive fork(): drop the handle (the
+    # child rebuilds lazily) and renew the lock in case the parent held
+    # it mid-fork.  The reference's substrate has the same rule — OS
+    # threads are per-process (opal/mca/threads).
+    global _pool, _pool_lock
+    _pool_lock = threading.Lock()
+    _pool = None
+
+
+import os as _os  # noqa: E402  (registration must follow the handler)
+
+if hasattr(_os, "register_at_fork"):
+    _os.register_at_fork(after_in_child=_reset_after_fork)
+
+
 mca.registry.register(
     "threads", "pool", "workers",
     vtype=mca.VarType.INT, default=0,

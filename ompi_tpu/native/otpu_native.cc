@@ -271,14 +271,22 @@ struct OtpuChunk {
 
 template <typename T>
 static void reduce_span(T *acc, const T *src, int64_t count, int32_t op) {
+    // max/min match np.maximum/np.minimum exactly, including NaN
+    // propagation from EITHER operand (src!=src catches a NaN src; a
+    // NaN acc keeps itself because 'acc < NaN' is false) — the
+    // sub-threshold numpy path and the python substrate must be
+    // bit-interchangeable with this one.  For integers x!=x is
+    // constant-false and folds away.
     switch (op) {
     case 0: for (int64_t i = 0; i < count; ++i) acc[i] += src[i]; break;
     case 1: for (int64_t i = 0; i < count; ++i) acc[i] *= src[i]; break;
     case 2: for (int64_t i = 0; i < count; ++i)
-                acc[i] = acc[i] < src[i] ? src[i] : acc[i];
+                acc[i] = (src[i] != src[i] || acc[i] < src[i])
+                             ? src[i] : acc[i];
             break;
     default: for (int64_t i = 0; i < count; ++i)
-                acc[i] = src[i] < acc[i] ? src[i] : acc[i];
+                acc[i] = (src[i] != src[i] || src[i] < acc[i])
+                             ? src[i] : acc[i];
     }
 }
 

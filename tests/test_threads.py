@@ -77,9 +77,17 @@ def test_reduce_matches_numpy(pools, name, op, dtype):
     rng = np.random.default_rng(11)
     a = (rng.random(100003) * 3 + 1).astype(dtype)
     b = (rng.random(100003) * 3 + 1).astype(dtype)
-    want = fn(a, b)
-    pool.reduce(op, a, b).wait()
-    np.testing.assert_allclose(a, want, rtol=1e-6)
+    if dtype.startswith("float"):
+        # NaN propagation must match numpy from EITHER operand
+        a[100], b[200] = np.nan, np.nan
+        want = fn(a, b)
+        pool.reduce(op, a, b).wait()
+        np.testing.assert_allclose(a, want, rtol=1e-6, equal_nan=True)
+        assert np.isnan(a[100]) and np.isnan(a[200])
+    else:
+        want = fn(a, b)
+        pool.reduce(op, a, b).wait()
+        np.testing.assert_allclose(a, want, rtol=1e-6)
 
 
 @pytest.mark.parametrize("name", ["python", "native"])
@@ -262,6 +270,57 @@ def test_workers_var_controls_size():
     finally:
         var.set(old)
         tbase.shutdown_pool()
+
+
+def test_op_host_reduce_pool_path_matches():
+    """Op.reduce_arrays above the fan-out threshold (pool path) must be
+    bit-identical to the plain ufunc path below it."""
+    from ompi_tpu.api import op
+
+    n = op._POOL_REDUCE_MIN // 4 + 31
+    rng = np.random.default_rng(17)
+    a = (rng.random(n) + 1).astype(np.float32)
+    b = (rng.random(n) + 1).astype(np.float32)
+    for o, uf in ((op.SUM, np.add), (op.PROD, np.multiply),
+                  (op.MAX, np.maximum), (op.MIN, np.minimum)):
+        got = o.reduce_arrays(a, b)
+        np.testing.assert_array_equal(got, uf(a, b))
+    # below-threshold small path still exact
+    np.testing.assert_array_equal(
+        op.SUM.reduce_arrays(a[:100], b[:100]), np.add(a[:100], b[:100]))
+    # non-contiguous operands must take the plain path, not corrupt
+    s = a[::2]
+    np.testing.assert_array_equal(
+        op.SUM.reduce_arrays(s, b[: s.size].copy()),
+        np.add(s, b[: s.size]))
+
+
+def test_pool_survives_fork():
+    """A forked child (tpurun's worker model) must not inherit dead
+    native workers — the handle resets and rebuilds lazily."""
+    import os
+
+    if not hasattr(os, "fork"):
+        pytest.skip("no fork on this platform")
+    tbase.get_pool()          # parent pool exists before fork
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:              # child
+        try:
+            src = np.arange(300000, dtype=np.uint8)
+            dst = np.zeros_like(src)
+            tbase.get_pool().memcpy(dst, src).wait()
+            ok = b"1" if np.array_equal(dst, src) else b"0"
+        except Exception:
+            ok = b"0"
+        os.write(w, ok)
+        os._exit(0)
+    os.close(w)
+    got = os.read(r, 1)
+    os.close(r)
+    os.waitpid(pid, 0)
+    assert got == b"1"
+    tbase.shutdown_pool()
 
 
 def test_convertor_wide_pack_matches_narrow():
