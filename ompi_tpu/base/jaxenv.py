@@ -14,6 +14,20 @@ from __future__ import annotations
 import os
 
 
+def pallas_interpret_default() -> bool:
+    """Default ``interpret=`` for single-chip Pallas kernels: interpreter
+    off-TPU, Mosaic on TPU.  ``OTPU_PALLAS_INTERPRET=0/1`` overrides —
+    the AOT compile gate (``tools/pallas_aot.py``) sets 0 so kernels
+    lower through the real Mosaic pipeline against an offline topology
+    even though the process runs a CPU client."""
+    env = os.environ.get("OTPU_PALLAS_INTERPRET", "").strip()
+    if env != "":
+        return env not in ("0", "false", "False")
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def apply_platform_env() -> None:
     plats = os.environ.get("JAX_PLATFORMS", "").strip()
     if not plats:

@@ -30,7 +30,9 @@ Q_TILE = 256
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ompi_tpu.base.jaxenv import pallas_interpret_default
+
+    return pallas_interpret_default()
 
 
 def _block_kernel(scale, q_ref, k_ref, v_ref, m_ref, num_ref, den_ref,
@@ -97,8 +99,11 @@ def _flash_bwd(res, ct):
 flash_block_update.defvjp(_flash_fwd, _flash_bwd)
 
 
-@jax.jit
-def _update_pallas(q, k_blk, v_blk, m, num, den):
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _update_pallas(q, k_blk, v_blk, m, num, den, *, interpret=None):
+    # ``interpret`` is part of the jit cache key: an explicit False (the
+    # AOT Mosaic gate) can never be served a cached interpreter trace,
+    # and vice versa.  None = resolve from the backend at trace time.
     b, h, sq, d = q.shape
     skv = k_blk.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -133,7 +138,7 @@ def _update_pallas(q, k_blk, v_blk, m, num, den):
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec, s_spec, q_spec, s_spec],
         out_specs=(s_spec, q_spec, s_spec),
-        interpret=_interpret(),
+        interpret=_interpret() if interpret is None else interpret,
     )(qf, kf, vf, mf.astype(jnp.float32), nf, df.astype(jnp.float32))
 
     return (mo[..., 0].reshape(b, h, sq).astype(m.dtype),

@@ -50,7 +50,9 @@ def supported_ops() -> tuple:
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ompi_tpu.base.jaxenv import pallas_interpret_default
+
+    return pallas_interpret_default()
 
 
 def _supported_dtype(op_name: str, dtype) -> bool:
@@ -75,9 +77,15 @@ def _combine_kernel(fold, a_ref, b_ref, o_ref):
     o_ref[:] = fold(a_ref[:], b_ref[:])
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def combine2(op_name: str, a, b):
-    """Elementwise ``a (op) b`` on the VPU; shape/dtype of ``a``."""
+@functools.partial(jax.jit, static_argnums=0,
+                   static_argnames=("interpret",))
+def combine2(op_name: str, a, b, *, interpret=None):
+    """Elementwise ``a (op) b`` on the VPU; shape/dtype of ``a``.
+
+    ``interpret`` is a static jit-cache-key ingredient: None resolves
+    from the backend at trace time; an explicit value (the AOT Mosaic
+    gate passes False) always wins and can never be served a cached
+    interpreter trace."""
     fold = _FOLDS[op_name]
     a2, rows = _pad_rows(a.ravel(), ROW_TILE)
     b2, _ = _pad_rows(b.ravel(), ROW_TILE)
@@ -87,7 +95,7 @@ def combine2(op_name: str, a, b):
         functools.partial(_combine_kernel, fold),
         out_shape=jax.ShapeDtypeStruct(a2.shape, a2.dtype),
         grid=grid, in_specs=[spec, spec], out_specs=spec,
-        interpret=_interpret(),
+        interpret=_interpret() if interpret is None else interpret,
     )(a2, b2)
     return out.ravel()[: a.size].reshape(a.shape)
 
@@ -99,9 +107,11 @@ def _stack_kernel(fold, k, x_ref, o_ref):
     o_ref[:] = acc
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def reduce_stack(op_name: str, x):
-    """Reduce ``x[k, ...]`` along axis 0 in one streaming VMEM pass."""
+@functools.partial(jax.jit, static_argnames=("op_name", "interpret"))
+def reduce_stack(op_name: str, x, *, interpret=None):
+    """Reduce ``x[k, ...]`` along axis 0 in one streaming VMEM pass.
+
+    ``interpret`` is a static jit-cache-key ingredient (see combine2)."""
     fold = _FOLDS[op_name]
     k = x.shape[0]
     if k == 1:
@@ -120,7 +130,7 @@ def reduce_stack(op_name: str, x):
         grid=(rows_k // tile,),
         in_specs=[pl.BlockSpec((k, tile, LANES), lambda i: (0, i, 0))],
         out_specs=pl.BlockSpec((tile, LANES), lambda i: (i, 0)),
-        interpret=_interpret(),
+        interpret=_interpret() if interpret is None else interpret,
     )(xp)
     return out.ravel()[:per].reshape(x.shape[1:])
 

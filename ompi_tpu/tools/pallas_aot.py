@@ -200,6 +200,41 @@ def cases(mesh1d, mesh2d):
                              False),
         (_sds((n, n), jnp.int32, mesh1d, P()),
          _sds((n, n, 2048, 1024), f32, mesh1d, P("x")))))
+
+    # -- single-chip hot kernels: the MFU path must be Mosaic-proven
+    # too (flash-attention block update at bench scale + the VPU
+    # reduction kernels behind mca/op).  interpret=False is passed
+    # EXPLICITLY (a static jit-cache-key ingredient) so these lower
+    # through Mosaic regardless of any cached interpreter trace.
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+
+    one = _Mesh(_np.asarray(mesh1d.devices).reshape(-1)[:1], ("one",))
+
+    def flash_args(b, h, sq, skv, d, dt):
+        return (_sds((b, h, sq, d), dt, one, P()),
+                _sds((b, h, skv, d), dt, one, P()),
+                _sds((b, h, skv, d), dt, one, P()),
+                _sds((b, h, sq), jnp.float32, one, P()),
+                _sds((b, h, sq, d), jnp.float32, one, P()),
+                _sds((b, h, sq), jnp.float32, one, P()))
+
+    from ompi_tpu.ops import flash_attention as fa
+    from ompi_tpu.ops import pallas_reduce as pr
+
+    case("flash_attention_bf16_2k", lambda: (
+        fa._update_pallas, flash_args(4, 8, 2048, 2048, 128, bf16),
+        {"interpret": False}))
+    case("flash_attention_f32_small", lambda: (
+        fa._update_pallas, flash_args(1, 2, 256, 512, 128, f32),
+        {"interpret": False}))
+    case("vpu_combine2_sum", lambda: (
+        pr.combine2, ("SUM", _sds((PAY,), f32, one, P()),
+                      _sds((PAY,), f32, one, P())),
+        {"interpret": False}))
+    case("vpu_reduce_stack_max", lambda: (
+        pr.reduce_stack, ("MAX", _sds((8, PAY), f32, one, P())),
+        {"interpret": False}))
     return out
 
 
@@ -213,6 +248,23 @@ def run(topology: str = DEFAULT_TOPOLOGY, only: str | None = None,
         return {"topology": topology, "ok": False,
                 "error": f"{type(e).__name__}: {e}"[:500], "rows": []}
 
+    # single-chip kernels (flash attention, VPU reduce) pick interpret=
+    # from the default backend; force real Mosaic lowering for the scope
+    # of this run only (leaking it would flip every later in-process
+    # Pallas call — e.g. the rest of a pytest session — onto a compiler
+    # the CPU client cannot execute)
+    old_interp = os.environ.get("OTPU_PALLAS_INTERPRET")
+    os.environ["OTPU_PALLAS_INTERPRET"] = "0"
+    try:
+        return _run_cases(topology, mesh1d, mesh2d, only, verbose, t0)
+    finally:
+        if old_interp is None:
+            os.environ.pop("OTPU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["OTPU_PALLAS_INTERPRET"] = old_interp
+
+
+def _run_cases(topology, mesh1d, mesh2d, only, verbose, t0) -> dict:
     rows = []
     for name, build in cases(mesh1d, mesh2d):
         if only and only not in name:
@@ -220,8 +272,10 @@ def run(topology: str = DEFAULT_TOPOLOGY, only: str | None = None,
         row = {"kernel": name, "lowered": False, "compiled": False}
         try:
             ts = time.time()
-            fn, args = build()
-            lowered = fn.lower(*args)
+            built = build()
+            fn, args = built[0], built[1]
+            kwargs = built[2] if len(built) > 2 else {}
+            lowered = fn.lower(*args, **kwargs)
             row["lowered"] = True
             row["lower_s"] = round(time.time() - ts, 2)
             ts = time.time()
