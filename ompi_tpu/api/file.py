@@ -300,6 +300,31 @@ class File:
     def get_view(self) -> tuple:
         return self.disp, self.etype, self.filetype
 
+    def get_byte_offset(self, offset: int) -> int:
+        """``MPI_File_get_byte_offset``: absolute file byte of a
+        view-relative offset (etype units), walking the filetype
+        tiling (``ompi/mpi/c/file_get_byte_offset.c``)."""
+        self._check()
+        from ompi_tpu.mca.io.ompio import view_extents
+
+        start = offset * max(1, self.etype.size)
+        for off, _ln in view_extents(self.disp, self.filetype, start, 1):
+            return off
+        # zero-size etype / empty view: the displacement itself
+        return self.disp + start
+
+    def get_type_extent(self, datatype: Datatype) -> int:
+        """``MPI_File_get_type_extent``: datatype extent in this file's
+        data representation (external32 is size-packed; native keeps
+        the memory extent)."""
+        self._check()
+        rep = getattr(self, "datarep", "native")
+        if rep != "native":
+            if rep in _datareps and _datareps[rep][2] is not None:
+                return int(_datareps[rep][2](datatype))  # extent_fn
+            return datatype.size       # external32: size-packed stream
+        return datatype.extent
+
     # -- explicit-offset I/O ---------------------------------------------
     def write_at(self, offset: int, buf) -> int:
         self._check()
@@ -334,6 +359,17 @@ class File:
         r.result = self.read_at(offset, buf)
         return r
 
+    def iwrite_at_all(self, offset: int, buf) -> Request:
+        """``MPI_File_iwrite_at_all`` (nonblocking collective; eager)."""
+        r = CompletedRequest()
+        r.result = self.write_at_all(offset, buf)
+        return r
+
+    def iread_at_all(self, offset: int, buf) -> Request:
+        r = CompletedRequest()
+        r.result = self.read_at_all(offset, buf)
+        return r
+
     # -- individual-pointer I/O ------------------------------------------
     def _advance(self, buf, n_elems_bytes: int) -> None:
         self._fp += n_elems_bytes // max(1, self.etype.size)
@@ -357,6 +393,29 @@ class File:
         n = self.io_module.write_at_all(self, self._fp, data)
         self._advance(buf, len(data))
         return n
+
+    def iwrite(self, buf) -> Request:
+        """``MPI_File_iwrite`` (individual pointer, eager completion —
+        the pointer advances before return, per MPI nonblocking rules)."""
+        r = CompletedRequest()
+        r.result = self.write(buf)
+        return r
+
+    def iread(self, buf) -> Request:
+        r = CompletedRequest()
+        r.result = self.read(buf)
+        return r
+
+    def iwrite_all(self, buf) -> Request:
+        """``MPI_File_iwrite_all`` (nonblocking collective; eager)."""
+        r = CompletedRequest()
+        r.result = self.write_all(buf)
+        return r
+
+    def iread_all(self, buf) -> Request:
+        r = CompletedRequest()
+        r.result = self.read_all(buf)
+        return r
 
     def read_all(self, buf) -> int:
         self._check()
@@ -500,6 +559,23 @@ class File:
         pos = self._shared_fetch_add(n_et)
         data = self.io_module.read_at(self, pos, nbytes)
         return self._from_stream(data, buf)
+
+    def iwrite_shared(self, buf) -> Request:
+        """``MPI_File_iwrite_shared`` (eager; the shared-pointer
+        fetch-add is the ordering point, same as the blocking form)."""
+        r = CompletedRequest()
+        r.result = self.write_shared(buf)
+        return r
+
+    def iread_shared(self, buf) -> Request:
+        r = CompletedRequest()
+        r.result = self.read_shared(buf)
+        return r
+
+    def get_position_shared(self) -> int:
+        """``MPI_File_get_position_shared``: shared pointer in etypes."""
+        self._check()
+        return self._shared_fetch_add(0)
 
     # -- ordered shared-pointer collectives (MPI_File_read_ordered) ------
     def _ordered_pos(self, nbytes: int) -> int:

@@ -44,6 +44,7 @@ from ompi_tpu.datatype.core import (  # noqa: F401
     hvector,
     indexed,
     hindexed,
+    hindexed_block,
     indexed_block,
     create_struct,
     subarray,

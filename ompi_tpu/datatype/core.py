@@ -330,6 +330,15 @@ def _hindexed(blocklengths, disps, old, combiner, contents):
     return Datatype(segs, lb=lb, ub=ub, combiner=combiner, contents=contents)
 
 
+def hindexed_block(blocklength: int, displacements_bytes: Sequence[int],
+                   old: Datatype) -> Datatype:
+    """``MPI_Type_create_hindexed_block``: equal-length blocks at byte
+    displacements (``ompi/mpi/c/type_create_hindexed_block.c``)."""
+    return _hindexed([blocklength] * len(displacements_bytes),
+                     list(displacements_bytes), old, "hindexed_block",
+                     (blocklength, tuple(displacements_bytes), old))
+
+
 def indexed_block(blocklength: int, displacements: Sequence[int],
                   old: Datatype) -> Datatype:
     return indexed([blocklength] * len(displacements), displacements, old)

@@ -19,6 +19,7 @@ from ompi_tpu.datatype import (
     darray,
     from_numpy_dtype,
     hindexed,
+    hindexed_block,
     indexed,
     indexed_block,
     resized,
@@ -306,3 +307,18 @@ def test_type_attributes():
     assert d2.attr_get(kv_dup)[0]    # the dup's copy survives
     keyval_free(kv_null)
     keyval_free(kv_dup)
+
+
+def test_hindexed_block_matches_hindexed():
+    """MPI_Type_create_hindexed_block == hindexed with equal lengths
+    (``ompi/mpi/c/type_create_hindexed_block.c``)."""
+    import numpy as np
+
+    a = hindexed_block(2, [0, 16], INT32)
+    b = hindexed([2, 2], [0, 16], INT32)
+    assert a.size == b.size and a.extent == b.extent
+    buf = np.arange(8, dtype=np.int32)
+    from ompi_tpu.datatype import pack
+
+    assert pack(buf, 1, a) == pack(buf, 1, b)
+    assert a.combiner == "hindexed_block"

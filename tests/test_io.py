@@ -450,3 +450,54 @@ def test_mp_ordered_collective(tmp_path):
     r = _tpurun(4, [sys.executable, str(script)])
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("ordered io OK") == 4
+
+
+def test_nonblocking_individual_and_shared(tmp_path):
+    """MPI_File_iread/iwrite (+_all/_at_all/_shared) request forms and
+    the byte-offset/type-extent/shared-position accessors."""
+    from ompi_tpu.api import file as fmod
+    from ompi_tpu.datatype import FLOAT32, vector
+
+    path = str(tmp_path / "nb.bin")
+    f = fmod.File.open(None, path, fmod.MODE_CREATE | fmod.MODE_RDWR)
+    data = np.arange(16, dtype=np.int32)
+    r = f.iwrite(data)
+    r.wait()
+    assert r.result == data.nbytes
+    assert f.get_position() == data.nbytes  # etype BYTE: bytes==etypes
+    f.seek(0)
+    out = np.zeros_like(data)
+    f.iread(out).wait()
+    np.testing.assert_array_equal(out, data)
+
+    # nonblocking collectives (single-rank degenerate but full path)
+    f.seek(0)
+    f.iwrite_all(data * 3).wait()
+    f.seek(0)
+    out2 = np.zeros_like(data)
+    f.iread_all(out2).wait()
+    np.testing.assert_array_equal(out2, data * 3)
+    f.iwrite_at_all(0, data).wait()
+    out3 = np.zeros_like(data)
+    f.iread_at_all(0, out3).wait()
+    np.testing.assert_array_equal(out3, data)
+
+    # shared-pointer request forms + get_position_shared
+    assert f.get_position_shared() == 0
+    f.iwrite_shared(data).wait()
+    assert f.get_position_shared() == data.nbytes
+    out4 = np.zeros_like(data)
+    f._shared_reset(0)
+    f.iread_shared(out4).wait()
+    np.testing.assert_array_equal(out4, data)
+
+    # get_byte_offset through a strided view; get_type_extent per datarep
+    ft = vector(2, 1, 2, FLOAT32)         # 4B used, 4B gap, 4B used
+    f.set_view(8, FLOAT32, ft)
+    # etype offset 0 -> disp; offset 1 -> second used f32 (skip the gap)
+    assert f.get_byte_offset(0) == 8
+    assert f.get_byte_offset(1) == 8 + 8
+    # offset 2 -> next tile (extent 12 bytes)
+    assert f.get_byte_offset(2) == 8 + 12
+    assert f.get_type_extent(ft) == ft.extent
+    f.close()
