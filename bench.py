@@ -961,6 +961,9 @@ def _pallas_first_run(devs, mesh, interp: bool) -> dict:
         x2.sum(0))
     chk("allgather",
         pc.all_gather(put(x), mesh, "x", interpret=interp), x, tol=1e-6)
+    chk("allgather_bidi",
+        pc.all_gather(put(x), mesh, "x", interpret=interp,
+                      variant="bidi"), x, tol=1e-6)
     chk("bcast",
         pc.bcast(put(x), mesh, "x", root=1, interpret=interp),
         np.broadcast_to(x[1], x.shape), tol=1e-6)

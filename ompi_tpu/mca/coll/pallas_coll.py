@@ -164,8 +164,11 @@ class PallasCollModule:
             return self._delegate("allgather_array", comm, x)
         from ompi_tpu.ops import pallas_collectives as pc
 
+        # same duplex opt-in as the reduce rings: both ICI directions
+        # carry blocks, ceil((n-1)/2) steps instead of n-1
+        variant = "bidi" if self.bidirectional else "ring"
         return pc.all_gather(x, self.mesh, self.axis,
-                             interpret=self.interpret)
+                             interpret=self.interpret, variant=variant)
 
     def reduce_scatter_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
         x = self._place(comm, x)
@@ -366,9 +369,11 @@ class PallasCollComponent(Component):
                  "kernels (two double-buffered windows this size)")
         self._bidi = self.register_var(
             "bidirectional", vtype=VarType.BOOL, default=False,
-            help="Use the bidirectional ring all-reduce (both ICI "
-                 "directions carry half the payload each step) for "
-                 "fused-size payloads")
+            help="Use the bidirectional (duplex) ring schedules: "
+                 "all-reduce carries half the payload in each ICI "
+                 "direction per step (fused sizes; seg_bidi above the "
+                 "VMEM bound), and allgather ships blocks both ways in "
+                 "ceil((n-1)/2) steps instead of n-1")
         self._wire16 = self.register_var(
             "wire16", vtype=VarType.BOOL, default=False,
             help="Opt-in wire compression for float32 SUM allreduce: "

@@ -222,6 +222,91 @@ def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
     return call
 
 
+def _build_all_gather_bidi(n: int, axis: str, blk_shape, dtype_str: str,
+                           interpret: bool, sub=None):
+    """Bidirectional ring all-gather: every step sends the freshest
+    right-going block right AND the freshest left-going block left, so
+    both directions of each duplex ICI link carry payload and the
+    schedule finishes in ceil((n-1)/2) steps instead of n-1 — the
+    duplex trick of ``_build_all_reduce`` ("bidi") applied to the
+    gather schedule (reference menu analog:
+    ``coll_base_allgather.c`` neighbor-exchange, which also halves the
+    step count by pairing directions).
+
+    Right-going chain at step k ships block (my-k) and lands block
+    (my-1-k) from the left; left-going ships (my+k) and lands
+    (my+1+k).  r_cnt = n//2 right deliveries + l_cnt = n-1-n//2 left
+    deliveries cover the n-1 remote blocks exactly once.  The paired
+    steps run in a fori_loop (constant kernel size in n, like the
+    unidirectional builder); only the at-most-one direction-lopsided
+    tail step (even n: r_cnt = l_cnt + 1) is emitted separately.
+    """
+    jax, jnp, lax, pl, pltpu, cparams, barrier = _ring_kernels(
+        n, axis, interpret)
+    r_cnt = n // 2
+    l_cnt = n - 1 - r_cnt
+    paired = min(r_cnt, l_cnt)
+
+    def kernel(x_ref, out_ref, local_sem, send_r, send_l, recv_r,
+               recv_l):
+        my, dev = _ring_fn(lax, axis, sub)
+        right = dev(lax.rem(my + 1, n))
+        left = dev(lax.rem(my - 1 + n, n))
+        barrier(right, left)
+        cp = pltpu.make_async_copy(x_ref, out_ref.at[my], local_sem)
+        cp.start()
+        cp.wait()
+
+        def rdma_right(k):
+            slot = lax.rem(my - k + n, n)
+            return pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[slot], dst_ref=out_ref.at[slot],
+                send_sem=send_r, recv_sem=recv_r.at[k],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        def step(k, carry):
+            r = rdma_right(k)
+            slot_l = lax.rem(my + k, n)
+            ld = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[slot_l], dst_ref=out_ref.at[slot_l],
+                send_sem=send_l, recv_sem=recv_l.at[k],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            r.start()    # both directions in flight together —
+            ld.start()   # that simultaneity IS the bandwidth win
+            r.wait()
+            ld.wait()
+            return carry
+
+        lax.fori_loop(0, paired, step, 0)
+        if r_cnt > paired:           # even n: one right-only tail step
+            r = rdma_right(paired)
+            r.start()
+            r.wait()
+
+    def call(x):
+        kw = {}
+        cp = cparams(16)
+        if cp is not None:
+            kw["compiler_params"] = cp
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n,) + blk_shape, dtype_str),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA((max(1, r_cnt),)),
+                            pltpu.SemaphoreType.DMA((max(1, l_cnt),))],
+            interpret=interpret,
+            **kw,
+        )(x)
+
+    return call
+
+
 def _rs_phase(lax, pl, pltpu, *, n, my, right, acc_ref, recv_ref,
               send_sem, rs_sems, align: int, fold, stage_ref=None,
               decode=None):
@@ -1321,13 +1406,15 @@ def right_permute(x, mesh, axis: str, interpret: bool = True):
 
 @functools.lru_cache(maxsize=256)
 def _jit_all_gather(mesh, axis: str, blk_shape, dtype_str: str,
-                    interpret: bool):
+                    interpret: bool, variant: str = "ring"):
     jax, jnp, lax, pl, pltpu = _mods()
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
-    inner = _build_all_gather(n, axis, blk_shape, dtype_str, interpret)
+    build = (_build_all_gather_bidi if variant == "bidi"
+             else _build_all_gather)
+    inner = build(n, axis, blk_shape, dtype_str, interpret)
 
     def body(t):                       # t: (1, *S)
         return inner(t[0])             # (n, *S)
@@ -1336,12 +1423,20 @@ def _jit_all_gather(mesh, axis: str, blk_shape, dtype_str: str,
                              out_specs=P(), check_vma=False))
 
 
-def all_gather(x, mesh, axis: str, interpret: bool = True):
-    """(n, *S) sharded -> (n, *S) replicated via the DMA ring."""
-    if mesh.shape[axis] == 1:
+def all_gather(x, mesh, axis: str, interpret: bool = True,
+               variant: str = "ring"):
+    """(n, *S) sharded -> (n, *S) replicated via the DMA ring.
+
+    ``variant="bidi"`` runs the bidirectional schedule (both ICI
+    directions per step, ceil((n-1)/2) steps); n<=2 degenerates to the
+    plain ring (one remote block — nothing to pair)."""
+    n = mesh.shape[axis]
+    if n == 1:
         return x
+    if n <= 2:
+        variant = "ring"
     return _jit_all_gather(mesh, axis, tuple(x.shape[1:]), str(x.dtype),
-                           interpret)(x)
+                           interpret, variant)(x)
 
 
 #: default VMEM window (elements) for the segmented kernels when the

@@ -106,6 +106,10 @@ def cases(mesh1d, mesh2d):
     case("all_gather", lambda: (
         pc._jit_all_gather(mesh1d, "x", (8, 128), "float32", False),
         (ring_arg((8, 128)),)))
+    case("all_gather_bidi", lambda: (
+        pc._jit_all_gather(mesh1d, "x", (8, 128), "float32", False,
+                           "bidi"),
+        (ring_arg((8, 128)),)))
     case("reduce_scatter_fused", lambda: (
         pc._jit_reduce_scatter(mesh1d, "x", (PAY,), "float32", "sum",
                                False, "fused", None),

@@ -39,7 +39,7 @@ def test_all_kernels_aot_compile():
                    "all_reduce_seg", "all_reduce_bidi",
                    "all_reduce_seg_bidi", "all_reduce_max", "all_reduce_wire16", "reduce_scatter_wire16",
                    "all_to_all", "all_to_all_v_ragged", "all_gather_v_ragged", "bcast",
-                   "all_reduce_torus", "matmul_allreduce",
+                   "all_gather_bidi", "all_reduce_torus", "matmul_allreduce",
                    "matmul_reduce_scatter",
                    # single-chip hot kernels (the MFU path)
                    "flash_attention_bf16_2k", "vpu_combine2_sum",
