@@ -265,7 +265,8 @@ class PallasCollModule:
         supported = (coll in ("allreduce", "reduce_scatter")
                      and ring_op is not None
                      and self._supported(template)) or \
-                    (coll == "bcast" and self._size_ok(template))
+                    (coll == "bcast" and self._size_ok(template)) or \
+                    (coll == "allgather" and self._supported(template))
         if not supported:
             return self._delegate("persistent_coll", comm, coll,
                                   template, *args)
@@ -291,6 +292,13 @@ class PallasCollModule:
                                          ring_op,
                                          interpret=self.interpret,
                                          variant=v, seg_elems=s)
+        elif coll == "allgather":
+            # same routing as the one-shot slot (never-diverge contract)
+            variant = "bidi" if self.bidirectional else "ring"
+
+            def fn(x, v=variant):
+                return pc.all_gather(x, self.mesh, self.axis,
+                                     interpret=self.interpret, variant=v)
         else:   # bcast: root baked into the handle, one shared program
             root = int(args[0]) % self.n if args else 0
             seg_elems = max(1, self.seg_bytes // template.dtype.itemsize)

@@ -222,6 +222,7 @@ def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
     return call
 
 
+@functools.lru_cache(maxsize=64)
 def _build_all_gather_bidi(n: int, axis: str, blk_shape, dtype_str: str,
                            interpret: bool, sub=None):
     """Bidirectional ring all-gather: every step sends the freshest

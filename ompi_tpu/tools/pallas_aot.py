@@ -104,7 +104,8 @@ def cases(mesh1d, mesh2d):
         pc._jit_right_permute(mesh1d, "x", (8, 128), "float32", False),
         (ring_arg((8, 128)),)))
     case("all_gather", lambda: (
-        pc._jit_all_gather(mesh1d, "x", (8, 128), "float32", False),
+        pc._jit_all_gather(mesh1d, "x", (8, 128), "float32", False,
+                           "ring"),
         (ring_arg((8, 128)),)))
     case("all_gather_bidi", lambda: (
         pc._jit_all_gather(mesh1d, "x", (8, 128), "float32", False,

@@ -56,8 +56,9 @@ def test_kernel_all_gather_bidi(mesh, shape):
 
 
 def test_kernel_all_gather_bidi_odd_ring():
-    """Odd ring size: r_cnt=n//2 and l_cnt=n-1-n//2 differ — the
-    lopsided tail steps run one direction only."""
+    """Odd ring size: r_cnt == l_cnt — every step is paired and the
+    even-n right-only tail branch is dead (that branch is exercised by
+    the n=8 mesh fixture above)."""
     import jax
     from jax.sharding import Mesh
 
@@ -71,6 +72,25 @@ def test_kernel_all_gather_bidi_odd_ring():
     y = np.asarray(pc.all_gather(jax.device_put(x), m5, "x",
                                  variant="bidi"))
     np.testing.assert_allclose(y, x, rtol=1e-6)
+
+
+def test_component_persistent_allgather(pallas_world):
+    """MPI_Allgather_init analog binds the pallas ring (and the bidi
+    schedule under the duplex flag) — same results as one-shot."""
+    w = pallas_world
+    mod = w.c_coll["persistent_coll"].__self__
+    assert mod.__class__.__name__ == "PallasCollModule"
+    x = np.random.default_rng(19).standard_normal(
+        (8, 16)).astype(np.float32)
+    h = w.c_coll["persistent_coll"](w, "allgather", x)
+    np.testing.assert_allclose(np.asarray(h(x)), x, rtol=1e-6)
+    old = mod.bidirectional
+    mod.bidirectional = True
+    try:
+        hb = w.c_coll["persistent_coll"](w, "allgather", x)
+        np.testing.assert_allclose(np.asarray(hb(x)), x, rtol=1e-6)
+    finally:
+        mod.bidirectional = old
 
 
 def test_component_allgather_bidi_routing(pallas_world):
