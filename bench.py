@@ -985,6 +985,12 @@ def _pallas_first_run(devs, mesh, interp: bool) -> dict:
             pc.all_reduce_torus(put(x.reshape(2, n // 2, -1)), mesh2,
                                 ("x", "y"), interpret=interp),
             x.sum(0))
+        chk("reduce_scatter_torus",
+            pc.reduce_scatter_torus(put(x2), mesh2, ("x", "y"),
+                                    interpret=interp), x2.sum(0))
+        chk("allgather_torus",
+            pc.all_gather_torus(put(x), mesh2, ("x", "y"),
+                                interpret=interp), x, tol=1e-6)
 
     # the fused compute+communicate kernels are part of the evidence
     # set too (pallas_overlap: new collective_ids, real RDMA semantics

@@ -175,7 +175,7 @@ def _build_right_permute(n: int, axis: str, shape, dtype_str: str,
 
 @functools.lru_cache(maxsize=64)
 def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
-                      interpret: bool, sub=None):
+                      interpret: bool, sub=None, cid: int = 2):
     """Ring all-gather: n-1 steps, each forwarding the freshest block to
     the right neighbor (``jax docs distributed`` canonical schedule; the
     reference's ``coll_base_allgather.c`` ring)."""
@@ -204,7 +204,7 @@ def _build_all_gather(n: int, axis: str, blk_shape, dtype_str: str,
 
     def call(x):
         kw = {}
-        cp = cparams(2)
+        cp = cparams(cid)
         if cp is not None:
             kw["compiler_params"] = cp
         return pl.pallas_call(
@@ -501,7 +501,8 @@ def _build_all_reduce_wire16(n: int, axis: str, rows: int,
 @functools.lru_cache(maxsize=64)
 def _build_reduce_scatter(n: int, axis: str, rows: int, dtype_str: str,
                           interpret: bool, op: str = "sum",
-                          sub=None, wire16: bool = False):
+                          sub=None, wire16: bool = False,
+                          cid: int = 4):
     """Ring reduce-scatter: n-1 steps, fold fused into the ring;
     device i ends owning fully-reduced block i (the first half of
     ``coll_base_allreduce.c:341``'s ring, block-owner aligned).
@@ -538,7 +539,7 @@ def _build_reduce_scatter(n: int, axis: str, rows: int, dtype_str: str,
 
     def call(x):  # x: (n, rows, 128) per device -> (rows, 128)
         kw = {}
-        cp = cparams(4)
+        cp = cparams(cid)
         if cp is not None:
             kw["compiler_params"] = cp
         dt = jnp.dtype(dtype_str)
@@ -1823,11 +1824,7 @@ def _jit_all_reduce_torus(mesh, axes, payload_shape, dtype_str: str,
     # arithmetic assumes a0-major linearization, and axes=("y","x") on
     # an ("x","y") mesh would otherwise still sum correctly but walk
     # non-neighbor ICI links
-    devs = np.asarray(mesh.devices)
-    order = tuple(mesh.axis_names.index(a) for a in (a0, a1))
-    devs = np.transpose(devs, order + tuple(
-        i for i in range(devs.ndim) if i not in order))
-    flat_mesh = Mesh(devs.reshape(-1), ("_t",))
+    flat_mesh = _torus_flat_mesh(mesh, a0, a1)
     rs0 = _build_reduce_scatter(n0, "_t", rows0, dtype_str, interpret,
                                 op, sub=(n0, n1, 0))
     ar1 = _build_all_reduce(n1, "_t", rows1, dtype_str, interpret, op,
@@ -1881,6 +1878,135 @@ def all_reduce_torus(x, mesh, axes=("x", "y"), op: str = "sum",
     fn = _jit_all_reduce_torus(mesh, axes, payload_shape,
                                str(x.dtype), op, interpret)
     return fn(x.reshape((n0 * n1,) + payload_shape))
+
+
+def _torus_flat_mesh(mesh, a0, a1):
+    """Flatten the torus into a0-major order (see _jit_all_reduce_torus:
+    the sub-ring arithmetic assumes (i0, i1) <-> i0*n1+i1, and the
+    transpose keeps sub-rings on physical ICI neighbors)."""
+    from jax.sharding import Mesh
+
+    devs = np.asarray(mesh.devices)
+    order = tuple(mesh.axis_names.index(a) for a in (a0, a1))
+    devs = np.transpose(devs, order + tuple(
+        i for i in range(devs.ndim) if i not in order))
+    return Mesh(devs.reshape(-1), ("_t",))
+
+
+@functools.lru_cache(maxsize=32)
+def _jit_reduce_scatter_torus(mesh, axes, payload_shape, dtype_str: str,
+                              op: str, interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    a0, a1 = axes
+    n0, n1 = mesh.shape[a0], mesh.shape[a1]
+    N = n0 * n1
+    blk = int(np.prod(payload_shape)) if payload_shape else 1
+    rb = _rows_for(blk)
+    flat_mesh = _torus_flat_mesh(mesh, a0, a1)
+    # phase 1: scatter-reduce n0 super-blocks (n1 blocks each) down the
+    # columns; phase 2: scatter-reduce the n1 surviving partials along
+    # the row — device (i0, i1) ends with global block i0*n1+i1 fully
+    # reduced.  Block boundaries stay row-aligned because each block is
+    # padded to rb whole rows BEFORE the phase-1 stacking.
+    # distinct collective_ids: two same-id kernels in one program
+    # would share one Mosaic barrier semaphore, and a fast device
+    # entering phase 2 could release a neighbor still at its phase-1
+    # entry barrier (the hazard the _ring_kernels barrier comment
+    # documents) — same discipline as _jit_all_reduce_torus's (4,3,2)
+    rs0 = _build_reduce_scatter(n0, "_t", n1 * rb, dtype_str, interpret,
+                                op, sub=(n0, n1, 0))
+    rs1 = _build_reduce_scatter(n1, "_t", rb, dtype_str, interpret, op,
+                                sub=(n0, n1, 1), cid=17)
+    padded = rb * 128
+
+    def body(t):                       # t: (1, N, *S)
+        r2 = t[0].reshape(N, blk)
+        if padded != blk:
+            r2 = jnp.pad(r2, ((0, 0), (0, padded - blk)),
+                         constant_values=_pad_value(op, dtype_str))
+        p1 = rs0(r2.reshape(n0, n1 * rb, 128))   # (n1*rb, 128)
+        p2 = rs1(p1.reshape(n1, rb, 128))        # (rb, 128)
+        return p2.reshape(-1)[:blk].reshape((1,) + payload_shape)
+
+    return jax.jit(shard_map(body, mesh=flat_mesh, in_specs=P("_t"),
+                             out_specs=P("_t"), check_vma=False))
+
+
+def reduce_scatter_torus(x, mesh, axes=("x", "y"), op: str = "sum",
+                         interpret: bool = True):
+    """(N, N, *S) sharded -> (N, *S) sharded over the torus, N=n0*n1:
+    two scatter-reduce phases (columns then rows), each ring walking
+    physical ICI neighbors of its own torus dimension — the decomposed
+    form of ``all_reduce_torus``'s first phase, for callers that want
+    the scattered result (TP gradient buckets, han-style hierarchies).
+    """
+    axes = tuple(axes)
+    payload_shape = tuple(x.shape[2:])
+    n0, n1 = mesh.shape[axes[0]], mesh.shape[axes[1]]
+    if n0 == 1 or n1 == 1:             # degenerate: plain 1-D ring
+        from jax.sharding import Mesh
+
+        flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
+        return reduce_scatter(
+            x.reshape((n0 * n1, n0 * n1) + payload_shape), flat_mesh,
+            "_t", op, interpret)
+    fn = _jit_reduce_scatter_torus(mesh, axes, payload_shape,
+                                   str(x.dtype), op, interpret)
+    return fn(x.reshape((n0 * n1, n0 * n1) + payload_shape))
+
+
+@functools.lru_cache(maxsize=32)
+def _jit_all_gather_torus(mesh, axes, blk_shape, dtype_str: str,
+                          interpret: bool):
+    jax, jnp, lax, pl, pltpu = _mods()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    a0, a1 = axes
+    n0, n1 = mesh.shape[a0], mesh.shape[a1]
+    N = n0 * n1
+    blk = int(np.prod(blk_shape)) if blk_shape else 1
+    rb = _rows_for(blk)
+    flat_mesh = _torus_flat_mesh(mesh, a0, a1)
+    # phase 1: gather the row's n1 blocks; phase 2: gather the n0
+    # super-blocks down the column — (n0, n1) row-major == flat id
+    # distinct collective_ids per phase (see _jit_reduce_scatter_torus)
+    ag1 = _build_all_gather(n1, "_t", (rb, 128), dtype_str, interpret,
+                            sub=(n0, n1, 1))
+    ag0 = _build_all_gather(n0, "_t", (n1 * rb, 128), dtype_str,
+                            interpret, sub=(n0, n1, 0), cid=18)
+
+    def body(t):                       # t: (1, *S)
+        flat = t[0].reshape(-1)
+        if rb * 128 != blk:
+            flat = jnp.pad(flat, (0, rb * 128 - blk))
+        row = ag1(flat.reshape(rb, 128))          # (n1, rb, 128)
+        full = ag0(row.reshape(n1 * rb, 128))     # (n0, n1*rb, 128)
+        return full.reshape(N, rb * 128)[:, :blk].reshape(
+            (N,) + blk_shape)
+
+    return jax.jit(shard_map(body, mesh=flat_mesh, in_specs=P("_t"),
+                             out_specs=P(), check_vma=False))
+
+
+def all_gather_torus(x, mesh, axes=("x", "y"), interpret: bool = True):
+    """(N, *S) sharded over the torus -> (N, *S) replicated: row rings
+    then column rings, each on its own ICI dimension — (n1-1) + (n0-1)
+    steps instead of the 1-D ring's N-1."""
+    axes = tuple(axes)
+    blk_shape = tuple(x.shape[1:])
+    n0, n1 = mesh.shape[axes[0]], mesh.shape[axes[1]]
+    if n0 == 1 or n1 == 1:
+        from jax.sharding import Mesh
+
+        flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
+        return all_gather(x, flat_mesh, "_t", interpret)
+    fn = _jit_all_gather_torus(mesh, axes, blk_shape, str(x.dtype),
+                               interpret)
+    return fn(x)
 
 
 @functools.lru_cache(maxsize=256)

@@ -166,6 +166,15 @@ def cases(mesh1d, mesh2d):
                                      (n0 * n1 * PAY,), "float32",
                                      "sum", False),
             (_sds((n0 * n1, n0 * n1 * PAY), f32, flat, P("_t")),)))
+        N2 = n0 * n1
+        case("reduce_scatter_torus", lambda: (
+            pc._jit_reduce_scatter_torus(mesh2d, ("x", "y"), (PAY,),
+                                         "float32", "sum", False),
+            (_sds((N2, N2, PAY), f32, flat, P("_t")),)))
+        case("all_gather_torus", lambda: (
+            pc._jit_all_gather_torus(mesh2d, ("x", "y"), (PAY,),
+                                     "float32", False),
+            (_sds((N2, PAY), f32, flat, P("_t")),)))
     m, k_loc, n_out = 256, 256, 256
     case("matmul_allreduce", lambda: (
         po._jit_matmul_allreduce(mesh1d, "x", m, k_loc, n_out,
