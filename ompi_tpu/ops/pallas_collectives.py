@@ -1870,9 +1870,7 @@ def all_reduce_torus(x, mesh, axes=("x", "y"), op: str = "sum",
         # a degenerate torus axis is a plain 1-D ring (a single pod
         # row/column): the zero-sized (n-1, blk) recv scratch of an
         # n=1 sub-ring cannot build
-        from jax.sharding import Mesh
-
-        flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
+        flat_mesh = _torus_flat_mesh(mesh, *axes)
         return all_reduce(x.reshape((n0 * n1,) + payload_shape),
                           flat_mesh, "_t", op, interpret)
     fn = _jit_all_reduce_torus(mesh, axes, payload_shape,
@@ -1947,9 +1945,7 @@ def reduce_scatter_torus(x, mesh, axes=("x", "y"), op: str = "sum",
     payload_shape = tuple(x.shape[2:])
     n0, n1 = mesh.shape[axes[0]], mesh.shape[axes[1]]
     if n0 == 1 or n1 == 1:             # degenerate: plain 1-D ring
-        from jax.sharding import Mesh
-
-        flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
+        flat_mesh = _torus_flat_mesh(mesh, *axes)
         return reduce_scatter(
             x.reshape((n0 * n1, n0 * n1) + payload_shape), flat_mesh,
             "_t", op, interpret)
@@ -2000,9 +1996,7 @@ def all_gather_torus(x, mesh, axes=("x", "y"), interpret: bool = True):
     blk_shape = tuple(x.shape[1:])
     n0, n1 = mesh.shape[axes[0]], mesh.shape[axes[1]]
     if n0 == 1 or n1 == 1:
-        from jax.sharding import Mesh
-
-        flat_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("_t",))
+        flat_mesh = _torus_flat_mesh(mesh, *axes)
         return all_gather(x, flat_mesh, "_t", interpret)
     fn = _jit_all_gather_torus(mesh, axes, blk_shape, str(x.dtype),
                                interpret)

@@ -77,6 +77,8 @@ class _Shmem:
         # first-fit free list of (offset, size) — collective symmetric
         # calls keep it identical on every PE (memheap invariant)
         self.free_list: list[tuple[int, int]] = [(0, heap_bytes)]
+        # (PE_start, logPE_stride, PE_size) -> sub-communicator cache
+        self.active_sets: dict = {}
 
     # -- memheap allocator ----------------------------------------------
     def alloc(self, nbytes: int, align: int = 16) -> int:
@@ -232,6 +234,102 @@ def barrier_all() -> None:
     """``shmem_barrier_all``: quiet + world barrier."""
     quiet()
     _get().world.barrier()
+
+
+def _active_set_comm(pe_start: int, log_pe_stride: int, pe_size: int):
+    """Sub-communicator for a (PE_start, logPE_stride, PE_size) active
+    set — the classic SHMEM subset triple (``shmem_barrier.c``).
+
+    Built with ``Comm.create_group`` (non-collective over the world):
+    ONLY active-set PEs participate, exactly the OpenSHMEM contract —
+    the rest of the job may never call shmem_barrier at all.  Cached
+    per triple (the reference's ``oshmem/proc/proc_group_cache.c``
+    plays the same role)."""
+    ctx = _get()
+    key = (pe_start, log_pe_stride, pe_size)
+    if key not in ctx.active_sets:   # None (non-member) is a valid
+        from ompi_tpu.api.group import Group   # cached value
+
+        stride = 1 << log_pe_stride
+        members = [pe_start + i * stride for i in range(pe_size)]
+        ctx.active_sets[key] = ctx.world.create_group(Group(members))
+    return ctx.active_sets[key]
+
+
+def _is_world_set(pe_start: int, log_pe_stride: int,
+                  pe_size: int) -> bool:
+    return pe_start == 0 and log_pe_stride == 0 and pe_size == n_pes()
+
+
+def barrier(pe_start: int = 0, log_pe_stride: int = 0,
+            pe_size: int = None) -> None:
+    """``shmem_barrier``: quiet + barrier over the active set (only
+    active-set PEs call — Comm.create_group keeps it non-collective
+    over the rest of the job)."""
+    if pe_size is None:
+        pe_size = n_pes()
+    quiet()
+    if _is_world_set(pe_start, log_pe_stride, pe_size):
+        _get().world.barrier()     # no duplicate world comm
+        return
+    comm = _active_set_comm(pe_start, log_pe_stride, pe_size)
+    if comm is not None:
+        comm.barrier()
+
+
+def sync_all() -> None:
+    """``shmem_sync_all``: barrier WITHOUT remote-memory completion
+    (no quiet — PE arrival only)."""
+    _get().world.barrier()
+
+
+def sync(pe_start: int = 0, log_pe_stride: int = 0,
+         pe_size: int = None) -> None:
+    """``shmem_sync``: active-set arrival barrier, no quiet."""
+    if pe_size is None:
+        pe_size = n_pes()
+    if _is_world_set(pe_start, log_pe_stride, pe_size):
+        _get().world.barrier()
+        return
+    comm = _active_set_comm(pe_start, log_pe_stride, pe_size)
+    if comm is not None:
+        comm.barrier()
+
+
+def info_get_version() -> tuple:
+    """``shmem_info_get_version``: OpenSHMEM spec (major, minor)."""
+    return (1, 4)
+
+
+def info_get_name() -> str:
+    """``shmem_info_get_name``: vendor string."""
+    return "ompi_tpu-shmem"
+
+
+def set_cache_inv() -> None:
+    """``shmem_set_cache_inv``: deprecated cache control — a no-op on
+    cache-coherent hardware, exactly as the reference implements it
+    (``oshmem/shmem/c/shmem_set_cache_inv.c``)."""
+
+
+def set_cache_line_inv(addr=None) -> None:
+    """Deprecated; no-op (coherent memory)."""
+
+
+def clear_cache_inv() -> None:
+    """Deprecated; no-op (coherent memory)."""
+
+
+def clear_cache_line_inv(addr=None) -> None:
+    """Deprecated; no-op (coherent memory)."""
+
+
+def udcflush() -> None:
+    """Deprecated; no-op (coherent memory)."""
+
+
+def udcflush_line(addr=None) -> None:
+    """Deprecated; no-op (coherent memory)."""
 
 
 # -- scoll: collectives over the comm layer (scoll/mpi) ------------------

@@ -311,3 +311,44 @@ def test_shmem_global_exit(tmp_path):
     r = _tpurun(3, [sys.executable, str(script)], timeout=60)
     assert "SURVIVED" not in r.stdout, r.stdout + r.stderr
     assert r.returncode != 0
+
+
+def test_shmem_active_set_barrier_sync_info(tmp_path):
+    """shmem_barrier/sync over a (PE_start, logPE_stride, PE_size)
+    active set + the info/version and deprecated cache no-op surface."""
+    script = tmp_path / "aset.py"
+    script.write_text("""
+import numpy as np
+import ompi_tpu.shmem as sh
+
+sh.init()
+me, n = sh.my_pe(), sh.n_pes()
+assert sh.info_get_version()[0] >= 1
+assert "shmem" in sh.info_get_name()
+sh.set_cache_inv(); sh.udcflush(); sh.clear_cache_line_inv(0)
+
+flag = sh.array(4, np.int64)
+flag.local[:] = 0
+# active set = even PEs (stride 2^1): they barrier among themselves
+# while odd PEs only make the collective split calls
+evens = list(range(0, n, 2))
+if me in evens:
+    sh.p(flag, me + 1, me, index=me)
+    sh.barrier(0, 1, len(evens))     # quiet + subset barrier
+    sh.barrier(0, 1, len(evens))     # repeat: cached comm, no re-split
+    # after the subset barrier every even PE sees every even PE's put
+    for pe in evens:
+        got = sh.g(flag, pe, index=pe)
+        assert got == pe + 1, (me, pe, got)
+else:
+    pass   # odd PEs NEVER call: create_group is non-collective over
+           # the world — the OpenSHMEM active-set contract
+sh.sync_all()
+sh.sync(0, 0, n)                     # whole-world active set
+sh.barrier()                         # default = all PEs
+sh.finalize()
+print("aset ok", flush=True)
+""")
+    r = _tpurun(4, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("aset ok") == 4
