@@ -102,3 +102,85 @@ def test_mfu_rows_structure():
         assert r["tflops"] >= 0 and r["model_flops"] > 0
         if r["grade"] == "device":
             assert r["mfu"] is not None and 0 < r["mfu"] <= 1.0, r
+
+
+def test_device_parent_salvages_stalled_child(tmp_path, monkeypatch):
+    """The round-3/4/5 failure mode: the device child streams some rows,
+    then the tunnel freezes it mid-RPC.  The parent must harvest every
+    already-delivered row (including a burst sitting in one pipe chunk),
+    kill the child at the deadline, and report stalled=True."""
+    import subprocess
+    import sys as _sys
+
+    import bench
+
+    fake_child = tmp_path / "fake_child.py"
+    fake_child.write_text("""
+import json, sys, time
+print(json.dumps({"meta": {"ndev": 1, "device_kind": "fake",
+                           "platform": "tpu"}}), flush=True)
+# a burst of rows in ONE write: the parent must not strand buffered lines
+sys.stdout.write(
+    json.dumps({"row": {"coll": "allreduce", "nbytes": 16777216,
+                        "fw_bw_gbs": 5.0, "raw_bw_gbs": 5.5,
+                        "ratio": 0.9}}) + "\\n"
+    + json.dumps({"row": {"coll": "allreduce", "nbytes": 8,
+                          "fw_bw_gbs": 0.1, "raw_bw_gbs": 0.1,
+                          "ratio": 1.0}}) + "\\n"
+    + json.dumps({"mfu": {"metric": "mfu_matmul_bf16", "grade": "device",
+                          "tflops": 100.0, "model_flops": 1,
+                          "lat_us": 1.0, "mfu": 0.5}}) + "\\n")
+sys.stdout.flush()
+time.sleep(600)   # the stall: no 'done', no exit
+""")
+
+    real_popen = subprocess.Popen
+
+    def fake_popen(cmd, **kw):
+        return real_popen([_sys.executable, str(fake_child)], **kw)
+
+    monkeypatch.setenv("OTPU_BENCH_DEVICE_BUDGET_S", "1")
+    monkeypatch.setenv("OTPU_BENCH_PARENT_GRACE_S", "3")
+    import subprocess as subprocess_mod
+
+    monkeypatch.setattr(subprocess_mod, "Popen", fake_popen)
+    import time as _t
+
+    t0 = _t.monotonic()
+    meta, rows, mfu, stalled, raw_only = bench.device_rows_parent(
+        fast=True)
+    elapsed = _t.monotonic() - t0
+    assert meta.get("ndev") == 1
+    assert len(rows) == 2, rows          # the whole burst survived
+    assert rows[0]["nbytes"] == 16777216
+    assert len(mfu) == 1 and mfu[0]["mfu"] == 0.5
+    assert stalled and raw_only is None
+    assert elapsed < 60, "parent failed to enforce its deadline"
+
+
+def test_device_parent_handles_clean_done(tmp_path, monkeypatch):
+    """A child that finishes cleanly yields stalled=False and raw_only
+    pass-through."""
+    import subprocess as subprocess_mod
+    import sys as _sys
+
+    import bench
+
+    fake_child = tmp_path / "fake_child2.py"
+    fake_child.write_text("""
+import json
+print(json.dumps({"meta": {"ndev": 8}}), flush=True)
+print(json.dumps({"raw_only": {"raw_bw_gbs": 7.5, "why": "x"}}),
+      flush=True)
+print(json.dumps({"done": True}), flush=True)
+""")
+    real_popen = subprocess_mod.Popen
+
+    def fake_popen(cmd, **kw):
+        return real_popen([_sys.executable, str(fake_child)], **kw)
+
+    monkeypatch.setattr(subprocess_mod, "Popen", fake_popen)
+    monkeypatch.setenv("OTPU_BENCH_DEVICE_BUDGET_S", "30")
+    meta, rows, mfu, stalled, raw_only = bench.device_rows_parent(
+        fast=True)
+    assert not stalled and rows == [] and raw_only["raw_bw_gbs"] == 7.5
