@@ -35,8 +35,14 @@ def _interpret() -> bool:
     return pallas_interpret_default()
 
 
-def _block_kernel(scale, q_ref, k_ref, v_ref, m_ref, num_ref, den_ref,
-                  mo_ref, numo_ref, deno_ref):
+def _block_kernel(scale, biased, *refs):
+    if biased:
+        bias_ref, q_ref, k_ref, v_ref, m_ref, num_ref, den_ref, \
+            mo_ref, numo_ref, deno_ref = refs
+    else:
+        q_ref, k_ref, v_ref, m_ref, num_ref, den_ref, \
+            mo_ref, numo_ref, deno_ref = refs
+        bias_ref = None
     q = q_ref[0]            # (tq, d)
     k = k_ref[0]            # (skv, d)
     v = v_ref[0]
@@ -47,6 +53,11 @@ def _block_kernel(scale, q_ref, k_ref, v_ref, m_ref, num_ref, den_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale      # (tq, skv)
+    if bias_ref is not None:
+        # additive bias per (q row, kv col): -inf entries mask (causal,
+        # padding), finite entries shift (ALiBi) — fused into the same
+        # VMEM pass
+        s = s + bias_ref[...]
     blk_max = jnp.max(s, axis=-1, keepdims=True)         # (tq, 1)
     new_m = jnp.maximum(m[:, :1], blk_max)               # (tq, 1)
     c = jnp.exp(m[:, :1] - new_m)                        # (tq, 1)
@@ -60,12 +71,15 @@ def _block_kernel(scale, q_ref, k_ref, v_ref, m_ref, num_ref, den_ref,
     mo_ref[0] = new_m * jnp.ones_like(m)
 
 
-def _update_jnp(q, k_blk, v_blk, m, num, den):
+def _update_jnp(q, k_blk, v_blk, m, num, den, bias=None):
     """The same block update in plain jnp — autodiff reference and the
     source of the custom-VJP backward (recompute, flash-style: nothing
-    beyond the step inputs is saved)."""
+    beyond the step inputs is saved).  ``bias`` (sq, skv) is added to
+    the scores (broadcast over batch/heads)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+    if bias is not None:
+        s = s + bias
     new_m = jnp.maximum(m, s.max(axis=-1))
     c = jnp.exp(m - new_m)
     p = jnp.exp(s - new_m[..., None])
@@ -99,8 +113,27 @@ def _flash_bwd(res, ct):
 flash_block_update.defvjp(_flash_fwd, _flash_bwd)
 
 
+@jax.custom_vjp
+def flash_block_update_biased(q, k_blk, v_blk, m, num, den, bias):
+    """Block update with an additive score bias (sq, skv): -inf masks
+    (causal ring attention, padding), finite shifts (ALiBi).  Same
+    fused Pallas forward; reverse recomputes through the jnp twin."""
+    return _update_pallas(q, k_blk, v_blk, m, num, den, bias=bias)
+
+
+def _flash_biased_fwd(q, k_blk, v_blk, m, num, den, bias):
+    return (_update_pallas(q, k_blk, v_blk, m, num, den, bias=bias),
+            (q, k_blk, v_blk, m, num, den, bias))
+
+
+# _flash_bwd handles both residual arities: jax.vjp adapts to the
+# 6- (unbiased) vs 7-element (biased) tuple
+flash_block_update_biased.defvjp(_flash_biased_fwd, _flash_bwd)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _update_pallas(q, k_blk, v_blk, m, num, den, *, interpret=None):
+def _update_pallas(q, k_blk, v_blk, m, num, den, bias=None, *,
+                   interpret=None):
     # ``interpret`` is part of the jit cache key: an explicit False (the
     # AOT Mosaic gate) can never be served a cached interpreter trace,
     # and vice versa.  None = resolve from the backend at trace time.
@@ -128,18 +161,27 @@ def _update_pallas(q, k_blk, v_blk, m, num, den, *, interpret=None):
     kv_spec = pl.BlockSpec((1, skv, d), blk)
     s_spec = pl.BlockSpec((1, tq, lanes), row)
 
+    biased = bias is not None
+    in_specs = [q_spec, kv_spec, kv_spec, s_spec, q_spec, s_spec]
+    operands = [qf, kf, vf, mf.astype(jnp.float32), nf,
+                df.astype(jnp.float32)]
+    if biased:
+        # (sq, skv) shared across (b, h): one q-tile row slice per step
+        in_specs.insert(0, pl.BlockSpec((tq, skv), lambda i, j: (j, 0)))
+        operands.insert(0, bias.astype(jnp.float32))
+
     mo, numo, deno = pl.pallas_call(
-        functools.partial(_block_kernel, scale),
+        functools.partial(_block_kernel, scale, biased),
         out_shape=(
             jax.ShapeDtypeStruct(mf.shape, jnp.float32),
             jax.ShapeDtypeStruct(nf.shape, nf.dtype),
             jax.ShapeDtypeStruct(df.shape, jnp.float32),
         ),
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec, s_spec, q_spec, s_spec],
+        in_specs=in_specs,
         out_specs=(s_spec, q_spec, s_spec),
         interpret=_interpret() if interpret is None else interpret,
-    )(qf, kf, vf, mf.astype(jnp.float32), nf, df.astype(jnp.float32))
+    )(*operands)
 
     return (mo[..., 0].reshape(b, h, sq).astype(m.dtype),
             numo.reshape(num.shape),

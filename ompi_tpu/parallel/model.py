@@ -24,7 +24,8 @@ def _use_flash_default() -> bool:
     return _jax.default_backend() == "tpu"
 
 
-def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None):
+def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None,
+                   causal: bool = False):
     """Flash-style ring attention over the sequence-parallel axis.
 
     q/k/v local: (b, h_local, s_local, hd).  K/V blocks rotate around the
@@ -33,12 +34,21 @@ def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None):
     running-max rescaling, so memory stays O(s_local) regardless of the
     global sequence length — long context is a first-class mesh axis.
 
+    ``causal=True`` applies the autoregressive mask at GLOBAL positions:
+    shard i's queries own rows [i*s_local, (i+1)*s_local); the block
+    visiting at ring step t originated at shard (i-t) mod n, so an
+    additive 0/-inf bias built from the two shard offsets masks exactly
+    the future positions.  Step 0 is the diagonal block (every query
+    row sees at least its own position), which keeps the running max
+    finite before any fully-masked later block arrives.
+
     The per-step block combine (two MXU matmuls + online-softmax rescale)
     is the hot op: on TPU it drops into the fused Pallas kernel
     (``ompi_tpu/ops/flash_attention.py``); the ring structure itself stays
     at the XLA level so the compiler schedules the ICI ppermute.
     """
     hd = q.shape[-1]
+    s_local = q.shape[-2]
     scale = 1.0 / math.sqrt(hd)
     if use_flash is None:
         use_flash = _use_flash_default()
@@ -49,15 +59,32 @@ def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None):
     num0 = q * 0
     den0 = q[..., 0] * 0
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    my = jax.lax.axis_index(axis) if n_shards > 1 else 0
 
-    def body(carry, _):
+    def step_bias(t):
+        # kv block at step t came from shard (my - t) mod n
+        src = jax.lax.rem(my - t + n_shards, n_shards)
+        qpos = my * s_local + jnp.arange(s_local)[:, None]
+        kpos = src * s_local + jnp.arange(s_local)[None, :]
+        return jnp.where(qpos >= kpos, 0.0, -jnp.inf).astype(jnp.float32)
+
+    def body(carry, t):
         k_blk, v_blk, m, num, den = carry
+        bias = step_bias(t) if causal else None
         if use_flash:
-            from ompi_tpu.ops.flash_attention import flash_block_update
+            from ompi_tpu.ops.flash_attention import (
+                flash_block_update, flash_block_update_biased)
 
-            new_m, num, den = flash_block_update(q, k_blk, v_blk, m, num, den)
+            if causal:
+                new_m, num, den = flash_block_update_biased(
+                    q, k_blk, v_blk, m, num, den, bias)
+            else:
+                new_m, num, den = flash_block_update(q, k_blk, v_blk, m,
+                                                     num, den)
         else:
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+            if bias is not None:
+                s = s + bias
             new_m = jnp.maximum(m, s.max(axis=-1))
             c = jnp.exp(m - new_m)
             p = jnp.exp(s - new_m[..., None])
@@ -69,11 +96,12 @@ def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None):
         return (k_blk, v_blk, new_m, num, den), None
 
     (_, _, _, num, den), _ = jax.lax.scan(
-        body, (k, v, m0, num0, den0), None, length=n_shards)
+        body, (k, v, m0, num0, den0), jnp.arange(n_shards))
     return num / den[..., None]
 
 
-def ulysses_attention(q, k, v, axis: str, n_shards: int):
+def ulysses_attention(q, k, v, axis: str, n_shards: int,
+                      causal: bool = False):
     """DeepSpeed-Ulysses sequence parallelism: all-to-all head↔sequence
     reshard instead of the ring's K/V rotation.
 
@@ -88,27 +116,33 @@ def ulysses_attention(q, k, v, axis: str, n_shards: int):
     dispatch-shaped exchange of SURVEY.md §2.6's alltoall row.
     """
     if n_shards == 1:
-        return _full_attention(q, k, v)
+        return _full_attention(q, k, v, causal)
 
     def scatter_heads(t):   # (b, h_l, s_l, hd) -> (b, h_l/n, s, hd)
         return jax.lax.all_to_all(t, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
+    # after the reshard each shard holds the FULL sequence, so the
+    # causal mask is the plain global lower-triangle
     o = _full_attention(scatter_heads(q), scatter_heads(k),
-                        scatter_heads(v))          # (b, h_l/n, s, hd)
+                        scatter_heads(v), causal)  # (b, h_l/n, s, hd)
     # inverse reshard: full-sequence heads -> my seq block, all heads
     return jax.lax.all_to_all(o, axis, split_axis=2, concat_axis=1,
                               tiled=True)
 
 
-def _full_attention(q, k, v):
+def _full_attention(q, k, v, causal: bool = False):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
+    if causal:
+        sq, skv = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(skv)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
     w = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
 
 def attention_block(p, x, *, sp: int, tp: int, n_heads_local: int,
-                    sp_impl: str = "ring"):
+                    sp_impl: str = "ring", causal: bool = False):
     """Sequence-parallel attention with tp-sharded heads; psum output proj.
 
     x local: (b, s_local, d) replicated over tp.  Head projections are
@@ -135,9 +169,11 @@ def attention_block(p, x, *, sp: int, tp: int, n_heads_local: int,
             raise ValueError(
                 f"ulysses needs local heads divisible by sp "
                 f"({n_heads_local} % {sp}); use sp_impl='ring'")
-        o = ulysses_attention(q, k, v, "sp", sp)    # (b, h_l, s_l, hd)
+        o = ulysses_attention(q, k, v, "sp", sp,
+                              causal=causal)        # (b, h_l, s_l, hd)
     else:
-        o = ring_attention(q, k, v, "sp", sp)       # (b, h_l, s_l, hd)
+        o = ring_attention(q, k, v, "sp", sp,
+                           causal=causal)           # (b, h_l, s_l, hd)
     o = o.transpose(0, 2, 1, 3).reshape(b, s_l, -1)  # (b, s_l, h_l*hd)
     o = o @ p["wo"]
     if tp > 1:
@@ -206,9 +242,9 @@ def moe_block(p, x, *, tp: int, n_experts: int, capacity: int):
 
 
 def transformer_block(p, x, *, sp, tp, n_heads_local, n_experts, capacity,
-                      sp_impl: str = "ring"):
+                      sp_impl: str = "ring", causal: bool = False):
     x = attention_block(p, x, sp=sp, tp=tp, n_heads_local=n_heads_local,
-                        sp_impl=sp_impl)
+                        sp_impl=sp_impl, causal=causal)
     x = mlp_block(p, x, tp=tp)
     x = moe_block(p, x, tp=tp, n_experts=n_experts, capacity=capacity)
     return x

@@ -29,6 +29,13 @@ _sp_impl_var = registry.register(
          "K/V rotation, O(s_local) memory) or 'ulysses' (all-to-all "
          "head<->seq reshard, 2 collectives; local heads must divide sp)")
 
+_causal_var = registry.register(
+    "parallel", None, "causal", vtype=VarType.BOOL, default=False,
+    help="Autoregressive (causal) attention masking at GLOBAL sequence "
+         "positions — ring attention builds the per-step block bias "
+         "from the shard offsets; ulysses masks the full sequence "
+         "after its reshard")
+
 
 def model_dims(spec: MeshSpec, layers: int = None) -> dict:
     """``layers`` defaults to one per pipeline stage; override (a
@@ -110,6 +117,7 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
     tp, sp_n, pp = spec.tp, spec.sp, spec.pp
     M, mb, s_l, d = dims["M"], dims["mb"], dims["s_local"], dims["d"]
     sp_impl = str(_sp_impl_var.value)
+    causal = bool(_causal_var.value)
 
     def stage_fn(stage_params, x_mb):
         for i in range(dims["layers_local"]):
@@ -118,7 +126,7 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
                 layer, x_mb, sp=sp_n, tp=tp,
                 n_heads_local=dims["h_local"],
                 n_experts=dims["n_experts"], capacity=dims["capacity"],
-                sp_impl=sp_impl)
+                sp_impl=sp_impl, causal=causal)
         return x_mb
 
     def body(params, x):

@@ -242,6 +242,11 @@ def cases(mesh1d, mesh2d):
     case("flash_attention_f32_small", lambda: (
         fa._update_pallas, flash_args(1, 2, 256, 512, 128, f32),
         {"interpret": False}))
+    case("flash_attention_causal_bias", lambda: (
+        fa._update_pallas,
+        flash_args(4, 8, 2048, 2048, 128, bf16)
+        + (_sds((2048, 2048), jnp.float32, one, P()),),
+        {"interpret": False}))
     case("vpu_combine2_sum", lambda: (
         pr.combine2, ("SUM", _sds((PAY,), f32, one, P()),
                       _sds((PAY,), f32, one, P())),
