@@ -342,6 +342,17 @@ def mfu_rows(sink=None) -> list:
         row("mfu_flash_attention", flops, t_flash,
             "bf16" if on_tpu else "f32",
             extra={"vs_jnp_speedup": round(t_jnp / t_flash, 3)})
+        # causal variant: same kernel + fused additive bias; ~half the
+        # scores are masked so model FLOPs halve (the MXU still runs
+        # the full tiles — mfu reflects achieved useful FLOPs)
+        bias = jnp.where(jnp.arange(sq)[:, None] >= jnp.arange(skv)[None, :],
+                         0.0, -jnp.inf).astype(jnp.float32)
+        flash_c = jax.jit(lambda a: fa.flash_block_update_biased(*a))
+        t_c = _time_fn(flash_c, (q, k, v, m0, num0, den0, bias),
+                       iters=10)
+        row("mfu_flash_attention_causal", flops / 2.0, t_c,
+            "bf16" if on_tpu else "f32",
+            extra={"vs_dense_flash": round(t_flash / t_c, 3)})
     except Exception as exc:
         print(f"mfu: flash attention failed: {exc}", file=sys.stderr)
 
