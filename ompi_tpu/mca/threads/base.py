@@ -96,7 +96,11 @@ def default_workers() -> int:
     var = mca.registry.lookup("otpu_threads_pool_workers")
     if var is not None and int(var.value) > 0:
         return int(var.value)
-    return max(2, min(4, os.cpu_count() or 2))
+    # a single-core host gets ONE worker: pool.size==1 makes every
+    # fan-out site (convertor packs, host reductions) keep its serial
+    # path — measured 1.6x slower through the pool with no second core
+    # to win it back (bench threads_pool_pack_4MB row)
+    return max(1, min(4, os.cpu_count() or 1))
 
 
 def get_pool() -> WorkPool:

@@ -267,6 +267,11 @@ def test_workers_var_controls_size():
     try:
         var.set(2)
         assert tbase.default_workers() == 2
+        var.set(0)
+        import os as _os
+
+        want = max(1, min(4, _os.cpu_count() or 1))
+        assert tbase.default_workers() == want
     finally:
         var.set(old)
         tbase.shutdown_pool()
@@ -274,25 +279,36 @@ def test_workers_var_controls_size():
 
 def test_op_host_reduce_pool_path_matches():
     """Op.reduce_arrays above the fan-out threshold (pool path) must be
-    bit-identical to the plain ufunc path below it."""
+    bit-identical to the plain ufunc path below it (workers forced to 2
+    so a 1-core host still exercises the pool path)."""
     from ompi_tpu.api import op
+    from ompi_tpu.base.mca import registry
 
-    n = op._POOL_REDUCE_MIN // 4 + 31
-    rng = np.random.default_rng(17)
-    a = (rng.random(n) + 1).astype(np.float32)
-    b = (rng.random(n) + 1).astype(np.float32)
-    for o, uf in ((op.SUM, np.add), (op.PROD, np.multiply),
-                  (op.MAX, np.maximum), (op.MIN, np.minimum)):
-        got = o.reduce_arrays(a, b)
-        np.testing.assert_array_equal(got, uf(a, b))
-    # below-threshold small path still exact
-    np.testing.assert_array_equal(
-        op.SUM.reduce_arrays(a[:100], b[:100]), np.add(a[:100], b[:100]))
-    # non-contiguous operands must take the plain path, not corrupt
-    s = a[::2]
-    np.testing.assert_array_equal(
-        op.SUM.reduce_arrays(s, b[: s.size].copy()),
-        np.add(s, b[: s.size]))
+    var = registry.lookup("otpu_threads_pool_workers")
+    old_w = var.value
+    tbase.shutdown_pool()
+    var.set(2)
+    try:
+        n = op._POOL_REDUCE_MIN // 4 + 31
+        rng = np.random.default_rng(17)
+        a = (rng.random(n) + 1).astype(np.float32)
+        b = (rng.random(n) + 1).astype(np.float32)
+        for o, uf in ((op.SUM, np.add), (op.PROD, np.multiply),
+                      (op.MAX, np.maximum), (op.MIN, np.minimum)):
+            got = o.reduce_arrays(a, b)
+            np.testing.assert_array_equal(got, uf(a, b))
+        # below-threshold small path still exact
+        np.testing.assert_array_equal(
+            op.SUM.reduce_arrays(a[:100], b[:100]),
+            np.add(a[:100], b[:100]))
+        # non-contiguous operands must take the plain path, not corrupt
+        s = a[::2]
+        np.testing.assert_array_equal(
+            op.SUM.reduce_arrays(s, b[: s.size].copy()),
+            np.add(s, b[: s.size]))
+    finally:
+        var.set(old_w)
+        tbase.shutdown_pool()
 
 
 def test_pool_survives_fork():
@@ -325,25 +341,36 @@ def test_pool_survives_fork():
 
 def test_convertor_wide_pack_matches_narrow():
     """Above the fan-out threshold the convertor's pack must be
-    byte-identical to the single-thread path."""
+    byte-identical to the single-thread path (workers forced to 2 so a
+    1-core host still exercises the pool path)."""
+    from ompi_tpu.base.mca import registry
+
+    var = registry.lookup("otpu_threads_pool_workers")
+    old_w = var.value
+    tbase.shutdown_pool()
+    var.set(2)
     from ompi_tpu.datatype import convertor as conv_mod
     from ompi_tpu.datatype import core
     from ompi_tpu.datatype.convertor import Convertor
 
-    vec = core.vector(2, 1, 2, core.FLOAT32)  # 4B used, gap, 4B used
-    n = (conv_mod._POOL_PACK_MIN // vec.size) + 77
-    rng = np.random.default_rng(9)
-    buf = rng.random(n * (vec.extent // 4)).astype(np.float32)
-
-    def pack_all():
-        c = Convertor(vec, n, buf)
-        return c.pack().tobytes()
-
-    wide = pack_all()
-    old = conv_mod._POOL_PACK_MIN
-    conv_mod._POOL_PACK_MIN = 1 << 62  # force the narrow path
     try:
-        narrow = pack_all()
+        vec = core.vector(2, 1, 2, core.FLOAT32)  # 4B used, gap, 4B used
+        n = (conv_mod._POOL_PACK_MIN // vec.size) + 77
+        rng = np.random.default_rng(9)
+        buf = rng.random(n * (vec.extent // 4)).astype(np.float32)
+
+        def pack_all():
+            c = Convertor(vec, n, buf)
+            return c.pack().tobytes()
+
+        wide = pack_all()
+        old = conv_mod._POOL_PACK_MIN
+        conv_mod._POOL_PACK_MIN = 1 << 62  # force the narrow path
+        try:
+            narrow = pack_all()
+        finally:
+            conv_mod._POOL_PACK_MIN = old
     finally:
-        conv_mod._POOL_PACK_MIN = old
+        var.set(old_w)
+        tbase.shutdown_pool()
     assert wide == narrow
