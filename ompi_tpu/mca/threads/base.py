@@ -98,8 +98,10 @@ def default_workers() -> int:
         return int(var.value)
     # a single-core host gets ONE worker: pool.size==1 makes every
     # fan-out site (convertor packs, host reductions) keep its serial
-    # path — measured 1.6x slower through the pool with no second core
-    # to win it back (bench threads_pool_pack_4MB row)
+    # path — steady-state the pool is ~neutral there (bench
+    # threads_pool_pack_4MB row: ~0.98x warm), but with no second core
+    # there is nothing to win, and the serial path skips worker
+    # startup and cross-thread traffic entirely
     return max(1, min(4, os.cpu_count() or 1))
 
 
