@@ -1484,13 +1484,26 @@ def _seg_rows(rows: int, seg_elems: int | None) -> tuple[int, int]:
 def _pad_value(op: str, dtype) -> float | int:
     """Neutral element used to pad the flattened payload to n equal ring
     blocks — must not perturb the fold, for any dtype (±inf is not a
-    valid neutral for integers: use the dtype's extrema there)."""
+    valid neutral for integers: use the dtype's extrema there).
+
+    ml_dtypes types (bfloat16, fp8) report numpy kind 'V': treat
+    anything np.finfo understands as floating (ml_dtypes registers its
+    finfo), only genuinely integer kinds go to np.iinfo — the old
+    kind=='f' test sent bf16 to iinfo and max/min bf16 rings raised
+    "Invalid integer data type 'V'" (found by the round-5 randomized
+    kernel sweep)."""
     dtype = np.dtype(dtype)
     if op == "sum":
         return 0
     if op == "prod":
         return 1
-    lim = np.finfo(dtype) if dtype.kind == "f" else np.iinfo(dtype)
+    if dtype.kind in "iu":
+        lim = np.iinfo(dtype)
+    else:
+        import ml_dtypes
+
+        lim = (np.finfo(dtype) if dtype.kind == "f"
+               else ml_dtypes.finfo(dtype))
     return lim.min if op == "max" else lim.max
 
 

@@ -781,6 +781,29 @@ def test_component_wire16_persistent_matches_oneshot(pallas_world):
         mod.wire16 = old
 
 
+@pytest.mark.parametrize("op", ["max", "min"])
+def test_kernel_all_reduce_bf16_extrema_ops(mesh, op):
+    """bfloat16 MAX/MIN rings: the pad neutral must come from
+    ml_dtypes' finfo — numpy reports bf16 as kind 'V' and the old
+    finfo/iinfo split raised \"Invalid integer data type 'V'\"
+    (regression: found by the randomized kernel sweep)."""
+    import jax
+    import ml_dtypes
+
+    from ompi_tpu.ops import pallas_collectives as pc
+
+    x = (np.random.default_rng(41).standard_normal((8, 37)) * 3
+         ).astype(ml_dtypes.bfloat16)
+    ref = {"max": np.max, "min": np.min}[op](x.astype(np.float32), 0)
+    for variant, seg in (("fused", None), ("seg", 16), ("bidi", None),
+                         ("seg_bidi", 16)):
+        got = np.asarray(pc.all_reduce(jax.device_put(x), mesh, "x",
+                                       op, variant=variant,
+                                       seg_elems=seg))
+        np.testing.assert_allclose(got.astype(np.float32), ref,
+                                   atol=0.1)
+
+
 def test_kernel_reduce_scatter_wire16(mesh):
     """Wire-compressed reduce-scatter: bf16 on the wire, f32 folds and
     f32 owner output (no cross-rank rounding needed: each block lives
