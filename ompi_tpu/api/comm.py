@@ -273,6 +273,10 @@ class Comm(AttributeHost):
         return self._coll("alltoall")(self, sendbuf)
 
     def alltoallv(self, sendbufs):
+        """``MPI_Alltoallv``: ``sendbufs[r]`` goes to rank r; returns a
+        list where entry r is rank r's block, typed as
+        ``sendbufs[r].dtype`` (symmetric exchanges — use ``alltoallw``
+        with ``recvtypes`` when pairs exchange different dtypes)."""
         self._check_state()
         return self._coll("alltoallv")(self, sendbufs)
 

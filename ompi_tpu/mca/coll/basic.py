@@ -154,6 +154,12 @@ class BasicCollModule:
         return out
 
     def alltoallv(self, comm, sendbufs):
+        """Received block from rank r is typed as ``sendbufs[r].dtype``
+        — the symmetric-exchange contract every component returns
+        (self_coll/conductor keep types trivially; the wire carries
+        bytes and this view restores them).  Pairs exchanging DIFFERENT
+        dtypes must use ``alltoallw`` with explicit ``recvtypes``, the
+        exact split MPI itself makes (``ompi/mpi/c/alltoallw.c``)."""
         tag = coll_tag(comm)
         reqs = []
         for r in range(comm.size):
@@ -167,7 +173,7 @@ class BasicCollModule:
                 st = comm.probe(source=r, tag=tag)
                 buf = np.empty(st._nbytes, np.uint8)
                 comm.recv(buf, source=r, tag=tag)
-                out[r] = buf
+                out[r] = buf.view(np.asarray(sendbufs[r]).dtype)
         from ompi_tpu.api.request import waitall
 
         waitall(reqs)

@@ -103,3 +103,43 @@ def test_tpurun_failure_teardown(tmp_path):
     r = _tpurun(3, [sys.executable, str(script)], timeout=60)
     assert r.returncode == 3
     assert "terminated with exit code 3" in r.stderr
+
+
+def test_mp_alltoallv_typed_and_alltoallw(tmp_path):
+    """Host alltoallv returns rank r's block typed as sendbufs[r].dtype
+    (regression: remote blocks used to come back as raw uint8 while the
+    self block stayed typed); alltoallw retypes per peer."""
+    script = tmp_path / "a2av.py"
+    script.write_text("""
+import numpy as np
+import ompi_tpu
+
+ompi_tpu.init()
+w = ompi_tpu.COMM_WORLD
+me, n = w.rank, w.size
+rng = np.random.default_rng(5)              # same plan on every rank
+base = rng.standard_normal((n, n, 40))
+cnts = rng.integers(0, 40, (n, n))
+send = [base[me, j, : cnts[me][j]].astype(np.float32) for j in range(n)]
+got = w.alltoallv(send)
+for src in range(n):
+    blk = got[src]
+    assert blk.dtype == np.float32, (src, blk.dtype)
+    assert np.allclose(blk, base[src, me, : cnts[src][me]]
+                       .astype(np.float32)), src
+# w-variant: heterogeneous per-peer dtypes via recvtypes
+send_w = [np.arange(4 + me, dtype=np.int64) if (me + j) % 2 == 0
+          else np.arange(4 + me, dtype=np.float32) for j in range(n)]
+rts = [np.int64 if (j + me) % 2 == 0 else np.float32 for j in range(n)]
+got_w = w.alltoallw(send_w, rts)
+for src in range(n):
+    assert got_w[src].dtype == np.dtype(rts[src]), (src, got_w[src].dtype)
+    assert np.allclose(got_w[src].astype(np.float64),
+                       np.arange(4 + src)), src
+if me == 0:
+    print("a2av typed ok", flush=True)
+ompi_tpu.finalize()
+""")
+    r = _tpurun(3, [sys.executable, str(script)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "a2av typed ok" in r.stdout
