@@ -1,0 +1,88 @@
+"""Randomized host-path shake: collectives + derived datatypes + wildcard
+p2p, same plan on every rank from the shared seed, checked vs numpy."""
+import os
+import sys
+
+import numpy as np
+
+
+import ompi_tpu
+from ompi_tpu.api import op
+from ompi_tpu.datatype import core
+
+seed = int(os.environ["HF_SEED"])
+iters = int(os.environ.get("HF_ITERS", "25"))
+ompi_tpu.init()
+w = ompi_tpu.COMM_WORLD
+me, n = w.rank, w.size
+rng = np.random.default_rng(seed)          # same stream on every rank
+
+for it in range(iters):
+    kind = rng.choice(["allreduce", "bcast", "gather", "alltoallv",
+                       "sendrecv", "vecsend", "reduce", "allgatherv"])
+    sz = int(rng.integers(1, 5000))
+    root = int(rng.integers(0, n))
+    base = rng.standard_normal((n, sz))    # all ranks know all inputs
+    mine = base[me].astype(np.float32)
+    if kind == "allreduce":
+        o = rng.choice([op.SUM, op.MAX, op.MIN])
+        got = np.asarray(w.allreduce(mine, o))
+        ref = {op.SUM: np.sum, op.MAX: np.max, op.MIN: np.min}[o](
+            base.astype(np.float32).astype(np.float64), 0)
+        assert np.allclose(got, ref, atol=1e-3), (it, kind)
+    elif kind == "bcast":
+        buf = mine.copy()
+        out = np.asarray(w.bcast(buf, root=root))
+        assert np.allclose(out, base[root].astype(np.float32)), (it, kind)
+    elif kind == "reduce":
+        got = w.reduce(mine, op.SUM, root=root)
+        if me == root:
+            assert np.allclose(np.asarray(got),
+                               base.astype(np.float32).sum(0),
+                               atol=1e-3), (it, kind)
+    elif kind == "gather":
+        got = w.gather(mine, root=root)
+        if me == root:
+            assert np.allclose(np.vstack(got),
+                               base.astype(np.float32)), (it, kind)
+    elif kind == "allgatherv":
+        cnt = [int(c) for c in rng.integers(0, sz + 1, n)]
+        got = w.allgatherv(mine[:cnt[me]])
+        for r in range(n):
+            g = np.asarray(got[r]).view(np.float32)
+            assert np.allclose(g, base[r, :cnt[r]].astype(np.float32)), \
+                (it, kind, r)
+    elif kind == "alltoallv":
+        cnts = rng.integers(0, 50, (n, n))
+        send = [base[me, :cnts[me][j]].astype(np.float32)
+                for j in range(n)]
+        got = w.alltoallv(send)
+        for src in range(n):
+            assert np.allclose(np.asarray(got[src]),
+                               base[src, :cnts[src][me]]
+                               .astype(np.float32)), (it, kind, src)
+    elif kind == "sendrecv":
+        # ring with wildcard receive
+        dst, src = (me + 1) % n, (me - 1) % n
+        out = np.zeros(sz, np.float32)
+        r = w.irecv(out)
+        w.send(mine, dest=dst, tag=it)
+        st = r.wait()
+        assert np.allclose(out, base[src].astype(np.float32)), (it, kind)
+    elif kind == "vecsend":
+        # strided vector datatype through the pack engine
+        vec = core.vector(2, 1, 2, core.FLOAT32)
+        nel = max(1, sz // 3)
+        buf = base[me, : nel * 3].astype(np.float32).copy()
+        dst, src = (me + 1) % n, (me - 1) % n
+        out = np.zeros(nel * 3, np.float32)
+        r = w.irecv((out, nel, vec))
+        w.send((buf, nel, vec), dest=dst, tag=100 + it)
+        r.wait()
+        idx = (np.arange(nel)[:, None] * 3 + np.array([0, 2])).reshape(-1)
+        assert np.allclose(out[idx],
+                           base[src, : nel * 3].astype(np.float32)[idx]), \
+            (it, kind)
+    w.barrier()
+print(f"rank {me}: {iters} randomized iterations OK", flush=True)
+ompi_tpu.finalize()

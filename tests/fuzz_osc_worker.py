@@ -1,0 +1,80 @@
+"""Randomized RMA fuzz: fence-epoch schedules of put/get/accumulate/
+fetch_and_op against a replicated numpy model.  The same seeded plan is
+generated on every rank; each epoch assigns disjoint target slots per
+origin so the model is deterministic."""
+import os
+import sys
+
+import numpy as np
+
+
+import ompi_tpu
+from ompi_tpu.api import op
+from ompi_tpu.api.win import Win
+
+seed = int(os.environ["OF_SEED"])
+epochs = int(os.environ.get("OF_EPOCHS", "12"))
+ompi_tpu.init()
+w = ompi_tpu.COMM_WORLD
+me, n = w.rank, w.size
+SLOTS = 8 * n                      # per-rank window: one region per origin
+win = Win.create(w, size=SLOTS, dtype=np.float64, name="fuzzwin")
+rng = np.random.default_rng(seed)  # same stream everywhere
+
+model = np.zeros((n, SLOTS))       # model[r] = rank r's window
+win.local[:] = 0.0
+win.fence()
+
+for ep in range(epochs):
+    # every rank draws the SAME full plan: (origin, kind, target, slotbase)
+    plan = []
+    for origin in range(n):
+        kind = rng.choice(["put", "acc", "fao", "get"])
+        target = int(rng.integers(0, n))
+        base = origin * 8           # my region on the target: disjoint
+        vals = rng.standard_normal(4)
+        plan.append((origin, kind, target, base, vals))
+    for origin, kind, target, base, vals in plan:
+        if origin != me:
+            continue
+        if kind == "put":
+            win.put(vals.copy(), target, offset=base)
+        elif kind == "acc":
+            win.accumulate(vals.copy(), target, offset=base, op=op.SUM)
+        elif kind == "fao":
+            win.fetch_and_op(float(vals[0]), target, offset=base,
+                             op=op.SUM)
+        elif kind == "get":
+            got = win.get(4, target, offset=base)
+    # model update (all ranks, deterministically)
+    for origin, kind, target, base, vals in plan:
+        if kind == "put":
+            model[target, base:base + 4] = vals
+        elif kind == "acc":
+            model[target, base:base + 4] += vals
+        elif kind == "fao":
+            model[target, base] += vals[0]
+    win.fence()
+    np.testing.assert_allclose(np.asarray(win.local), model[me],
+                               atol=1e-9), ep
+    # mapped-window puts may land as soon as issued: nobody may open
+    # the next access epoch until every rank finished checking ITS
+    # exposure epoch (MPI separation-of-epochs responsibility)
+    w.barrier()
+# passive target: lock/unlock CAS token ring
+token_home = 0
+win.fence()
+if me == token_home:
+    win.local[SLOTS - 1] = 0.0
+win.fence()
+for _ in range(5):
+    win.lock(token_home)
+    cur = float(win.get(1, token_home, offset=SLOTS - 1)[0])
+    win.put(np.array([cur + 1.0]), token_home, offset=SLOTS - 1)
+    win.unlock(token_home)
+w.barrier()
+if me == token_home:
+    assert win.local[SLOTS - 1] == 5.0 * n, win.local[SLOTS - 1]
+    print("osc fuzz ok", flush=True)
+win.free()
+ompi_tpu.finalize()

@@ -1,0 +1,50 @@
+"""Randomized host-path shakes, CI-pinned seeds.
+
+Two tpurun-driven workers replay seed-deterministic plans on every
+rank and check against replicated numpy models:
+
+- ``fuzz_hostcoll_worker.py``: random collectives (allreduce/bcast/
+  reduce/gather/allgatherv/alltoallv) + wildcard p2p + strided-vector
+  datatype sends — the sweep that found the untyped-alltoallv
+  inconsistency.
+- ``fuzz_osc_worker.py``: fence-epoch RMA schedules (put/accumulate/
+  fetch_and_op/get, disjoint per-origin regions) + a passive-target
+  lock token ring.  Epochs separate with a barrier AFTER each rank
+  checks its exposure epoch (mapped-window puts may land early — MPI
+  makes epoch separation the program's job).
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(worker, n, env_extra, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(n),
+         sys.executable, str(REPO / "tests" / worker)],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env)
+
+
+@pytest.mark.parametrize("seed", [11, 47])
+def test_fuzz_host_collectives(seed):
+    r = _run("fuzz_hostcoll_worker.py", 4,
+             {"HF_SEED": str(seed), "HF_ITERS": "15"})
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
+    assert r.stdout.count("randomized iterations OK") == 4
+
+
+@pytest.mark.parametrize("seed", [5, 31])
+def test_fuzz_osc_epochs(seed):
+    r = _run("fuzz_osc_worker.py", 4,
+             {"OF_SEED": str(seed), "OF_EPOCHS": "8"})
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
+    assert "osc fuzz ok" in r.stdout
