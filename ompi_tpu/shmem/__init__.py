@@ -407,9 +407,10 @@ def alltoall(sym: SymArray) -> np.ndarray:
 # -- strided / nonblocking put-get (shmem_iput/iget, *_nbi) ---------------
 
 def iput(sym: SymArray, value, tst: int, sst: int, count: int,
-         pe: int) -> None:
+         pe: int, index: int = 0) -> None:
     """``shmem_iput``: strided put — element i of ``value`` (stride sst)
-    lands at target index i*tst.
+    lands at target index ``index + i*tst`` (``index`` plays the role of
+    OpenSHMEM's target-pointer arithmetic).
 
     Contiguous targets (tst == 1) go as ONE transfer; true strided
     targets must stay per-element — a bulk read-modify-write of the
@@ -418,20 +419,21 @@ def iput(sym: SymArray, value, tst: int, sst: int, count: int,
     src = np.ascontiguousarray(value, dtype=sym.dtype).reshape(-1)
     strided = src[::sst][:count] if sst > 1 else src[:count]
     if tst == 1:
-        put(sym, strided, pe)
+        put(sym, strided, pe, index=index)
         return
     for i in range(count):
-        p(sym, strided[i], pe, index=i * tst)
+        p(sym, strided[i], pe, index=index + i * tst)
 
 
 def iget(sym: SymArray, tst: int, sst: int, count: int,
-         pe: int) -> np.ndarray:
+         pe: int, index: int = 0) -> np.ndarray:
     """``shmem_iget``: strided get — returns ``count`` elements taken at
-    source stride sst (tst orders the local result).  One bulk get of
-    the covering range + a local stride slice (reads have no gap-clobber
-    hazard, so bulk is safe and ~count× fewer AM round trips)."""
+    source stride sst from base ``index`` (tst orders the local
+    result).  One bulk get of the covering range + a local stride slice
+    (reads have no gap-clobber hazard, so bulk is safe and ~count×
+    fewer AM round trips)."""
     span = (count - 1) * sst + 1
-    block = get(sym, span, pe)
+    block = get(sym, span, pe, index=index)
     return np.ascontiguousarray(block[::sst][:count])
 
 

@@ -1,0 +1,67 @@
+"""Randomized SHMEM shake: seed-deterministic plan of puts/gets/
+atomics/collectives over a symmetric array, checked against a
+replicated numpy model.  Epochs separate with barrier_all (puts are
+remotely visible after the barrier's quiet)."""
+import os
+
+import numpy as np
+
+import ompi_tpu.shmem as sh
+
+seed = int(os.environ["SF_SEED"])
+epochs = int(os.environ.get("SF_EPOCHS", "10"))
+sh.init()
+me, n = sh.my_pe(), sh.n_pes()
+SLOTS = 4 * n
+sym = sh.array(SLOTS, np.float64)
+sym.local[:] = 0.0
+model = np.zeros((n, SLOTS))
+rng = np.random.default_rng(seed)
+sh.barrier_all()
+
+for ep in range(epochs):
+    plan = []
+    for origin in range(n):
+        kind = rng.choice(["put", "add", "inc", "set", "iput"])
+        target = int(rng.integers(0, n))
+        base = origin * 4            # disjoint per-origin region
+        vals = rng.standard_normal(4)
+        plan.append((origin, str(kind), target, base, vals))
+    for origin, kind, target, base, vals in plan:
+        if origin != me:
+            continue
+        if kind == "put":
+            sh.put(sym, vals.copy(), target, index=base)
+        elif kind == "add":
+            sh.atomic_add(sym, float(vals[0]), target, index=base)
+        elif kind == "inc":
+            sh.atomic_inc(sym, target, index=base)
+        elif kind == "set":
+            sh.atomic_set(sym, float(vals[1]), target, index=base + 1)
+        elif kind == "iput":
+            # strided: every other slot of my region
+            sh.iput(sym, vals[:4].copy(), 2, 2, 2, target, index=base)
+    for origin, kind, target, base, vals in plan:
+        if kind == "put":
+            model[target, base:base + 4] = vals
+        elif kind == "add":
+            model[target, base] += vals[0]
+        elif kind == "inc":
+            model[target, base] += 1
+        elif kind == "set":
+            model[target, base + 1] = vals[1]
+        elif kind == "iput":
+            model[target, base] = vals[0]
+            model[target, base + 2] = vals[2]
+    sh.barrier_all()
+    np.testing.assert_allclose(np.asarray(sym.local), model[me],
+                               atol=1e-9)
+    sh.barrier_all()          # epoch separation (see RMA fuzz)
+
+# collectives against the model state (sum_to_all reduces IN PLACE)
+sh.sum_to_all(sym)
+np.testing.assert_allclose(np.asarray(sym.local), model.sum(0),
+                           atol=1e-9)
+if me == 0:
+    print("shmem fuzz ok", flush=True)
+sh.finalize()

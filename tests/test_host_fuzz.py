@@ -48,3 +48,11 @@ def test_fuzz_osc_epochs(seed):
              {"OF_SEED": str(seed), "OF_EPOCHS": "8"})
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
     assert "osc fuzz ok" in r.stdout
+
+
+@pytest.mark.parametrize("seed", [9, 21])
+def test_fuzz_shmem_epochs(seed):
+    r = _run("fuzz_shmem_worker.py", 4,
+             {"SF_SEED": str(seed), "SF_EPOCHS": "8"})
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
+    assert "shmem fuzz ok" in r.stdout
