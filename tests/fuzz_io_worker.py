@@ -1,0 +1,82 @@
+"""Randomized MPI-IO shake: random (disp, etype, filetype) views and
+interleaved individual/collective/shared writes, verified against a
+replicated byte model of the final file."""
+import os
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.api import file as fmod
+from ompi_tpu.datatype import core
+
+seed = int(os.environ["IOF_SEED"])
+iters = int(os.environ.get("IOF_ITERS", "8"))
+path = os.environ["IOF_PATH"]
+ompi_tpu.init()
+w = ompi_tpu.COMM_WORLD
+me, n = w.rank, w.size
+rng = np.random.default_rng(seed)          # same stream on every rank
+
+FSIZE = 1 << 14
+model = np.zeros(FSIZE, np.uint8)          # replicated file model
+f = fmod.File.open(w, path, fmod.MODE_CREATE | fmod.MODE_RDWR)
+f.set_size(FSIZE)
+w.barrier()
+
+def view_extents(disp, ft, start, nbytes):
+    from ompi_tpu.mca.io.ompio import view_extents as ve
+    return ve(disp, ft, start, nbytes)
+
+for it in range(iters):
+    # random view: etype f32; filetype vector or contiguous over f32
+    disp = int(rng.integers(0, 64)) * 4
+    kind = rng.choice(["contig", "vector", "indexed"])
+    if kind == "contig":
+        ft = core.contiguous(int(rng.integers(1, 5)), core.FLOAT32)
+    elif kind == "vector":
+        ft = core.vector(int(rng.integers(1, 4)),
+                         int(rng.integers(1, 3)),
+                         int(rng.integers(2, 5)), core.FLOAT32)
+    else:
+        nb = int(rng.integers(1, 3))
+        disps = sorted(rng.choice(range(0, 8), nb, replace=False))
+        ft = core.indexed([1] * nb, [int(d) for d in disps],
+                          core.FLOAT32)
+    f.set_view(disp, core.FLOAT32, ft)
+    # each rank writes its own block at a rank-disjoint view offset
+    cnt = int(rng.integers(1, 40))
+    vals = rng.standard_normal((n, cnt)).astype(np.float32)
+    off_et = me * 64                      # view-relative etype offset
+    mode = rng.choice(["at_all", "at", "iat"])
+    if mode == "at_all":
+        f.write_at_all(off_et, vals[me])
+    elif mode == "at":
+        f.write_at(off_et, vals[me])
+    else:
+        f.iwrite_at(off_et, vals[me]).wait()
+    # model: every rank applies ALL ranks' writes
+    for r in range(n):
+        data = vals[r].tobytes()
+        pos = 0
+        for foff, ln in view_extents(disp, ft, r * 64 * 4, len(data)):
+            model[foff:foff + ln] = np.frombuffer(
+                data[pos:pos + ln], np.uint8)
+            pos += ln
+    w.barrier()
+    # interleave a readback check from a random rank's region
+    src = int(rng.integers(0, n))
+    out = np.zeros(cnt, np.float32)
+    f.read_at(src * 64, out)
+    assert np.allclose(out, vals[src]), (it, src)
+    w.barrier()
+
+f.sync() if hasattr(f, "sync") else None
+w.barrier()
+f.close()
+if me == 0:
+    real = np.fromfile(path, np.uint8)
+    real = np.pad(real, (0, FSIZE - real.size))
+    assert np.array_equal(real, model), \
+        f"file diverges at {np.nonzero(real != model)[0][:8]}"
+    print("io fuzz ok", flush=True)
+ompi_tpu.finalize()

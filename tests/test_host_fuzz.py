@@ -56,3 +56,12 @@ def test_fuzz_shmem_epochs(seed):
              {"SF_SEED": str(seed), "SF_EPOCHS": "8"})
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
     assert "shmem fuzz ok" in r.stdout
+
+
+@pytest.mark.parametrize("seed", [3, 27])
+def test_fuzz_io_views(seed, tmp_path):
+    r = _run("fuzz_io_worker.py", 4,
+             {"IOF_SEED": str(seed), "IOF_ITERS": "6",
+              "IOF_PATH": str(tmp_path / "fuzz.bin")})
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
+    assert "io fuzz ok" in r.stdout
