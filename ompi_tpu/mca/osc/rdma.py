@@ -104,6 +104,13 @@ class RdmaModule:
             try:
                 seg.data = None     # drop our export before close
                 seg.shm.close()
+            except BufferError:
+                # user still holds views of the mapped data (win.local
+                # escaped) — close is impossible until those die, and
+                # retrying from SharedMemory.__del__ at interpreter
+                # exit would only print "Exception ignored" noise: the
+                # OS reclaims the mapping at process exit either way
+                seg.shm.close = lambda: None
             except Exception:
                 pass
             if seg.owner:
