@@ -36,6 +36,13 @@ _causal_var = registry.register(
          "from the shard offsets; ulysses masks the full sequence "
          "after its reshard")
 
+_remat_var = registry.register(
+    "parallel", None, "remat", vtype=VarType.BOOL, default=False,
+    help="Rematerialize each transformer block in the backward pass "
+         "(jax.checkpoint): activation HBM drops from all layers' "
+         "intermediates to one block's, paying ~1/3 more FLOPs — the "
+         "standard long-context/deep-stack memory lever")
+
 
 def model_dims(spec: MeshSpec, layers: int = None) -> dict:
     """``layers`` defaults to one per pipeline stage; override (a
@@ -119,14 +126,23 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
     sp_impl = str(_sp_impl_var.value)
     causal = bool(_causal_var.value)
 
+    def apply_block(layer, x_mb):
+        return transformer_block(
+            layer, x_mb, sp=sp_n, tp=tp,
+            n_heads_local=dims["h_local"],
+            n_experts=dims["n_experts"], capacity=dims["capacity"],
+            sp_impl=sp_impl, causal=causal)
+
+    if bool(_remat_var.value):
+        # recompute the block in the backward instead of storing its
+        # activations — the jax.checkpoint form of the trade every
+        # deep/long-context stack makes on HBM-bound chips
+        apply_block = jax.checkpoint(apply_block)
+
     def stage_fn(stage_params, x_mb):
         for i in range(dims["layers_local"]):
             layer = jax.tree.map(lambda a: a[i], stage_params)
-            x_mb = transformer_block(
-                layer, x_mb, sp=sp_n, tp=tp,
-                n_heads_local=dims["h_local"],
-                n_experts=dims["n_experts"], capacity=dims["capacity"],
-                sp_impl=sp_impl, causal=causal)
+            x_mb = apply_block(layer, x_mb)
         return x_mb
 
     def body(params, x):

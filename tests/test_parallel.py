@@ -279,3 +279,26 @@ def test_causal_train_step_var():
         assert abs(causal - base) > 1e-6, (causal, base)
     finally:
         var.set(old)
+
+
+def test_remat_var_matches_baseline_loss():
+    """--mca parallel_remat 1 must change only WHERE activations come
+    from (recompute vs store): the loss trajectory is bit-comparable."""
+    import jax
+
+    from ompi_tpu.base.var import registry
+    from ompi_tpu.parallel.dryrun import parse_spec, run_training_step
+
+    var = registry.lookup("otpu_parallel_remat")
+    assert var is not None
+    devs = jax.devices()[:4]
+    spec = parse_spec("dp=2,pp=1,sp=2,tp=1")
+    old = var.value
+    try:
+        var.set(False)
+        base = run_training_step(devs, spec)
+        var.set(True)
+        remat = run_training_step(devs, spec)
+        np.testing.assert_allclose(remat, base, rtol=1e-6)
+    finally:
+        var.set(old)
