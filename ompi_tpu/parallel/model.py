@@ -66,7 +66,10 @@ def ring_attention(q, k, v, axis: str, n_shards: int, use_flash=None,
         src = jax.lax.rem(my - t + n_shards, n_shards)
         qpos = my * s_local + jnp.arange(s_local)[:, None]
         kpos = src * s_local + jnp.arange(s_local)[None, :]
-        return jnp.where(qpos >= kpos, 0.0, -jnp.inf).astype(jnp.float32)
+        # q.dtype (not f32): a wider bias would promote the scan
+        # carry under bfloat16 compute and break lax.scan's
+        # carry-type invariant; the flash kernel upcasts internally
+        return jnp.where(qpos >= kpos, 0.0, -jnp.inf).astype(q.dtype)
 
     def body(carry, t):
         k_blk, v_blk, m, num, den = carry
@@ -211,13 +214,18 @@ def moe_block(p, x, *, tp: int, n_experts: int, capacity: int):
     logits = chunk @ p["wr"]                        # (tc, E)
     probs = jax.nn.softmax(logits, axis=-1)
     eid = jnp.argmax(probs, axis=-1)                # (tc,)
-    oh = jax.nn.one_hot(eid, n_experts, dtype=xf.dtype)          # (tc, E)
+    # routing bookkeeping in f32 ALWAYS: bf16 cumsum cannot count
+    # past 256 exactly, silently colliding capacity slots at
+    # production token counts (compute_dtype must not leak here)
+    oh = jax.nn.one_hot(eid, n_experts, dtype=jnp.float32)       # (tc, E)
     pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh                    # (tc, E)
     keep = oh * (pos < capacity)
     pos_oh = jax.nn.one_hot(
         jnp.clip(pos.astype(jnp.int32), 0, capacity - 1), capacity,
         dtype=xf.dtype)                                          # (tc, E, cap)
-    disp = keep[..., None] * pos_oh                              # (tc, E, cap)
+    # mask back to compute dtype (exact 0/1): the expert einsums
+    # and the residual must stay in compute precision
+    disp = (keep[..., None] * pos_oh).astype(xf.dtype)           # (tc, E, cap)
 
     ex_in = jnp.einsum("tec,td->ecd", disp, chunk)   # (E, cap, d)
     ex_in = ex_in.reshape(tp, e_l, capacity, d)

@@ -43,6 +43,13 @@ _remat_var = registry.register(
          "intermediates to one block's, paying ~1/3 more FLOPs — the "
          "standard long-context/deep-stack memory lever")
 
+_compute_dtype_var = registry.register(
+    "parallel", None, "compute_dtype", vtype=VarType.STRING,
+    default="float32", enum_values={"float32": 0, "bfloat16": 1},
+    help="Block compute precision: bfloat16 runs the MXU at full rate "
+         "and halves activation bytes (params stay float32 storage; "
+         "cast at block entry, loss/grads accumulate in float32)")
+
 
 def model_dims(spec: MeshSpec, layers: int = None) -> dict:
     """``layers`` defaults to one per pipeline stage; override (a
@@ -126,18 +133,31 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
     sp_impl = str(_sp_impl_var.value)
     causal = bool(_causal_var.value)
 
+    compute_dtype = jnp.dtype(str(_compute_dtype_var.value))
+
     def apply_block(layer, x_mb):
-        return transformer_block(
+        if compute_dtype != jnp.float32:
+            # bf16 compute: params cast per block (storage stays f32 —
+            # the master-weights discipline), activations stay bf16
+            # across the stack; the f32 loss/grad path upcasts at exit
+            layer = jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, layer)
+        out = transformer_block(
             layer, x_mb, sp=sp_n, tp=tp,
             n_heads_local=dims["h_local"],
             n_experts=dims["n_experts"], capacity=dims["capacity"],
             sp_impl=sp_impl, causal=causal)
+        return out
 
     if bool(_remat_var.value):
         # recompute the block in the backward instead of storing its
         # activations — the jax.checkpoint form of the trade every
         # deep/long-context stack makes on HBM-bound chips
-        apply_block = jax.checkpoint(apply_block)
+        # prevent_cse=False: apply_block runs inside pipeline_apply's
+        # scan, which already provides the CSE barrier — the default
+        # setting would only add optimization barriers on the hot path
+        apply_block = jax.checkpoint(apply_block, prevent_cse=False)
 
     def stage_fn(stage_params, x_mb):
         for i in range(dims["layers_local"]):
@@ -147,7 +167,9 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
 
     def body(params, x):
         def loss_fn(ps):
-            xmb = x.reshape(M, mb, s_l, d)
+            # activations enter the pipeline in compute_dtype so the
+            # scan carries / ppermute handoffs stay half-width too
+            xmb = x.reshape(M, mb, s_l, d).astype(compute_dtype)
             y = pipeline_apply(stage_fn, ps, xmb, pp=pp,
                                vary_axes=("pp", "tp"))
             # pipeline_apply outputs are zero off the last pp stage, so
@@ -157,7 +179,8 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
             # the psum over ALL axes is both value-correct and provably
             # unvarying — gradients to the other tp shards still flow
             # through the block's internal tp-psum transposes
-            local = 0.5 * jnp.sum(y * y)
+            yf = y.astype(jnp.float32)     # f32 loss accumulation
+            local = 0.5 * jnp.sum(yf * yf)
             local = jnp.where(jax.lax.axis_index("tp") == 0, local, 0.0)
             return jax.lax.psum(local, ("dp", "pp", "sp", "tp"))
 

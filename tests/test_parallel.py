@@ -302,3 +302,42 @@ def test_remat_var_matches_baseline_loss():
         np.testing.assert_allclose(remat, base, rtol=1e-6)
     finally:
         var.set(old)
+
+
+def test_compute_dtype_bf16_descends():
+    """--mca parallel_compute_dtype bfloat16: the composed step still
+    trains (finite loss, close to the f32 program) with half-width
+    activations and per-block param casts — including combined with
+    causal masking and remat (the production stack)."""
+    import jax
+
+    from ompi_tpu.base.var import registry
+    from ompi_tpu.parallel.dryrun import parse_spec, run_training_step
+
+    var = registry.lookup("otpu_parallel_compute_dtype")
+    assert var is not None
+    devs = jax.devices()[:4]
+    spec = parse_spec("dp=2,pp=1,sp=2,tp=1")
+    old = var.value
+    causal = registry.lookup("otpu_parallel_causal")
+    remat = registry.lookup("otpu_parallel_remat")
+    old_c, old_r = causal.value, remat.value
+    try:
+        var.set("float32")
+        base = run_training_step(devs, spec)
+        var.set("bfloat16")
+        lo = run_training_step(devs, spec)
+        assert np.isfinite(lo)
+        # bf16 rounding makes a different (but close) program
+        np.testing.assert_allclose(lo, base, rtol=0.1)
+        # the production combination: bf16 + causal + remat must
+        # compose (regression: the f32 causal bias once promoted the
+        # bf16 scan carry and broke lax.scan's type invariant)
+        causal.set(True)
+        remat.set(True)
+        combo = run_training_step(devs, spec)
+        assert np.isfinite(combo)
+    finally:
+        var.set(old)
+        causal.set(old_c)
+        remat.set(old_r)
