@@ -43,6 +43,19 @@ _remat_var = registry.register(
          "intermediates to one block's, paying ~1/3 more FLOPs — the "
          "standard long-context/deep-stack memory lever")
 
+_zero1_var = registry.register(
+    "parallel", None, "zero1", vtype=VarType.BOOL, default=False,
+    help="ZeRO-1 distributed optimizer: gradients reduce-scatter over "
+         "dp (instead of allreduce), each dp rank updates its 1/dp "
+         "parameter slice + momentum shard, and the updated slices "
+         "rebuild via an exact masked psum — optimizer state memory "
+         "drops by dp")
+
+_momentum_var = registry.register(
+    "parallel", None, "momentum", vtype=VarType.FLOAT, default=0.0,
+    help="SGD momentum for the flagship step (state is dp-sharded "
+         "under parallel_zero1)")
+
 _compute_dtype_var = registry.register(
     "parallel", None, "compute_dtype", vtype=VarType.STRING,
     default="float32", enum_values={"float32": 0, "bfloat16": 1},
@@ -165,7 +178,21 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
             x_mb = apply_block(layer, x_mb)
         return x_mb
 
-    def body(params, x):
+    zero1 = bool(_zero1_var.value)
+    mu = float(_momentum_var.value)
+    if mu and not zero1:
+        raise ValueError(
+            "parallel_momentum is implemented by the ZeRO-1 sharded "
+            "optimizer state — set --mca parallel_zero1 1 with it "
+            "(a silently momentum-free run would corrupt comparisons)")
+    dp = spec.dp
+
+    def body(state, x):
+        if zero1:
+            params, carry_m = state
+        else:
+            params, carry_m = state, None
+
         def loss_fn(ps):
             # activations enter the pipeline in compute_dtype so the
             # scan carries / ppermute handoffs stay half-width too
@@ -185,12 +212,57 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
             return jax.lax.psum(local, ("dp", "pp", "sp", "tp"))
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads = jax.tree.map(
-            lambda g: jax.lax.psum(g, ("dp", "sp")), grads)
+        if not zero1:
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, ("dp", "sp")), grads)
+            if tp > 1:
+                grads["wr"] = jax.lax.psum(grads["wr"], "tp")
+            new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            return new, loss
+        # ZeRO-1: the dp sum rides a reduce-scatter (same bytes as the
+        # allreduce it replaces), each dp rank owns 1/dp of the flat
+        # parameter/momentum state, and the updated slices all-gather
+        # back — the FSDP/ZeRO optimizer-state sharding pattern in
+        # psum_scatter + all_gather form
+        from jax.flatten_util import ravel_pytree
+
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, "sp"), grads)
         if tp > 1:
             grads["wr"] = jax.lax.psum(grads["wr"], "tp")
-        new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-        return new, loss
+        # grads and params share one pytree structure: a single ravel
+        # provides both the flat vector and the shared unravel
+        gflat, unravel = ravel_pytree(grads)
+        total = gflat.shape[0]
+        chunk = -(-total // dp)
+        gpad = jnp.pad(gflat, (0, chunk * dp - total))
+        gsl = jax.lax.psum_scatter(gpad.reshape(dp, chunk), "dp",
+                                   scatter_dimension=0, tiled=False)
+        m = carry_m
+        m_new = mu * m + gsl
+        r = jax.lax.axis_index("dp")
+        # rebuild via masked psum, NOT all_gather: psum's output is
+        # provably dp-INVARIANT under the vma checker (all_gather's
+        # equal-by-construction result still types as varying), so the
+        # replicated param out_specs hold without weakening check_vma
+        contrib = jax.lax.dynamic_update_slice(
+            jnp.zeros((chunk * dp,), gsl.dtype), -lr * m_new,
+            (r * chunk,))
+        delta_flat = jax.lax.psum(contrib, "dp")[:total]
+        dtree = unravel(delta_flat)
+        # leaves REPLICATED over tp (wr): the flat state mixes
+        # tp-sharded leaves, so their delta types tp-varying even
+        # though its value is identical on every tp shard — one exact
+        # masked psum (only shard 0 contributes) restores provable
+        # tp-invariance with zero fp perturbation.  UNCONDITIONAL:
+        # m_spec carries "tp" even at axis size 1
+        tpi = jax.lax.axis_index("tp")
+        for k, sspec in pspecs.items():
+            if "tp" not in tuple(sspec):
+                dtree[k] = jax.lax.psum(
+                    jnp.where(tpi == 0, dtree[k],
+                              jnp.zeros_like(dtree[k])), "tp")
+        new = jax.tree.map(lambda p_, d_: p_ + d_, params, dtree)
+        return (new, m_new), loss
 
     pspecs = param_specs(P)
     # check_vma=True is LOAD-BEARING for correctness, not just a lint:
@@ -198,10 +270,20 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
     # transposes in the pp>=2 backward correct.  With it off the
     # composed step compiles and descends — with silently wrong
     # pipeline gradients (caught by test_pp2_matches_pp1_same_model).
+    if zero1:
+        # momentum shard: one (chunk,) block per (dp, pp, tp) shard of
+        # the flat local parameter vector — a 1-D array sharded over
+        # all three axes (sp replicates: grads are sp-summed first)
+        m_spec = P(("dp", "pp", "tp"))
+        state_specs = ((pspecs, m_spec), P("dp", "sp", None))
+        out_state_specs = ((pspecs, m_spec), P())
+    else:
+        state_specs = (pspecs, P("dp", "sp", None))
+        out_state_specs = (pspecs, P())
     step = jax.jit(shard_map(
         body, mesh=mesh,
-        in_specs=(pspecs, P("dp", "sp", None)),
-        out_specs=(pspecs, P()),
+        in_specs=state_specs,
+        out_specs=out_state_specs,
         check_vma=True))
 
     def place(params, x_np):
@@ -210,6 +292,26 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
         x = jax.device_put(
             np.asarray(x_np, np.float32),
             NamedSharding(mesh, P("dp", "sp", None)))
+        if zero1:
+            # local flat size: each leaf's global shape divided by the
+            # MESH size of every axis its spec shards it over — the
+            # same division shard_map applies, so body's traced
+            # ravel_pytree total always agrees (axis sizes come from
+            # mesh.shape, never a hand-maintained map)
+            sizes = 0
+            for k, v in params.items():
+                shp = list(np.asarray(v).shape)
+                for dim, ax in enumerate(pspecs[k]):
+                    if ax is None:
+                        continue
+                    for a in (ax if isinstance(ax, tuple) else (ax,)):
+                        shp[dim] //= mesh.shape[a]
+                sizes += int(np.prod(shp))
+            chunk = -(-sizes // spec.dp)
+            m0 = np.zeros(chunk * spec.dp * spec.pp * spec.tp,
+                          np.float32)
+            mdev = jax.device_put(m0, NamedSharding(mesh, m_spec))
+            return (p, mdev), x
         return p, x
 
     return step, place
