@@ -43,10 +43,20 @@ def measure(comm, iters: int = 10) -> list:
 
 
 def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="mpisync",
+        description="Clock-offset measurement across ranks (run under "
+                    "tpurun; rank 0 prints one offset/rtt line per peer)")
+    ap.add_argument("--iters", type=int, default=10,
+                    help="ping-pong rounds per peer (min-RTT filter)")
+    args = ap.parse_args(argv)
+
     import ompi_tpu
 
     world = ompi_tpu.init()
-    results = measure(world)
+    results = measure(world, iters=args.iters)
     if world.rank == 0:
         print("rank offset_us rtt_us")
         print("0 0.0 0.0   # reference clock")
