@@ -1,0 +1,88 @@
+"""Algorithm-menu cross-check: every tuned algorithm for every
+collective produces the same answer as numpy, on random payloads —
+the decision ladder may pick any entry, so every entry must agree."""
+import os
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.api import op
+from ompi_tpu.base.var import registry
+from ompi_tpu.mca.coll import algorithms as algs
+
+seed = int(os.environ.get("AF_SEED", "1"))
+ompi_tpu.init()
+w = ompi_tpu.COMM_WORLD
+me, n = w.rank, w.size
+rng = np.random.default_rng(seed)
+
+MENUS = {
+    "allreduce": sorted(algs.ALLREDUCE),
+    "bcast": sorted(algs.BCAST),
+    "reduce": sorted(algs.REDUCE),
+    "allgather": sorted(algs.ALLGATHER),
+    "alltoall": sorted(algs.ALLTOALL),
+    "barrier": sorted(algs.BARRIER),
+    "reduce_scatter": sorted(algs.REDUCE_SCATTER),
+    "gather": sorted(algs.GATHER),
+    "scatter": sorted(algs.SCATTER),
+}
+
+for coll, menu in MENUS.items():
+    var = registry.lookup(f"otpu_coll_tuned_{coll}_algorithm")
+    assert var is not None, coll
+    for alg in menu:
+        sz = int(rng.integers(1, 3000))
+        base = rng.standard_normal((n, sz)).astype(np.float32)
+        mine = base[me].copy()
+        var.set(alg)
+        try:
+            if coll == "allreduce":
+                got = np.asarray(w.allreduce(mine, op.SUM))
+                ref = base.astype(np.float64).sum(0)
+                assert np.allclose(got, ref, atol=1e-3), (coll, alg)
+            elif coll == "bcast":
+                got = np.asarray(w.bcast(mine.copy(), root=1))
+                assert np.allclose(got, base[1]), (coll, alg)
+            elif coll == "reduce":
+                got = w.reduce(mine, op.SUM, root=2 % n)
+                if me == 2 % n:
+                    assert np.allclose(np.asarray(got),
+                                       base.astype(np.float64).sum(0),
+                                       atol=1e-3), (coll, alg)
+            elif coll == "allgather":
+                got = np.vstack([np.asarray(g)
+                                 for g in w.allgather(mine)])
+                assert np.allclose(got, base), (coll, alg)
+            elif coll == "alltoall":
+                blk = sz // n if sz >= n else 1
+                m2 = base[me, : blk * n].reshape(n, blk)
+                got = w.alltoall(m2)
+                for src in range(n):
+                    exp = base[src, : blk * n].reshape(n, blk)[me]
+                    assert np.allclose(np.asarray(got[src]), exp), \
+                        (coll, alg, src)
+            elif coll == "barrier":
+                w.barrier()
+            elif coll == "reduce_scatter":
+                cnt = [sz // n] * n
+                got = w.reduce_scatter(mine[: sum(cnt)], cnt)
+                off = sum(cnt[:me])
+                ref = base[:, : sum(cnt)].astype(np.float64).sum(0)
+                assert np.allclose(np.asarray(got),
+                                   ref[off:off + cnt[me]], atol=1e-3), \
+                    (coll, alg)
+            elif coll == "gather":
+                got = w.gather(mine, root=0)
+                if me == 0:
+                    assert np.allclose(np.vstack(got), base), (coll, alg)
+            elif coll == "scatter":
+                # root passes the (size, ...) stack; non-roots a template
+                sendbuf = base if me == 1 else np.empty_like(base[me])
+                got = np.asarray(w.scatter(sendbuf, root=1))
+                assert np.allclose(got, base[me]), (coll, alg)
+        finally:
+            var.set("")
+        w.barrier()
+print(f"rank {me}: all algorithm menus agree", flush=True)
+ompi_tpu.finalize()

@@ -65,3 +65,13 @@ def test_fuzz_io_views(seed, tmp_path):
               "IOF_PATH": str(tmp_path / "fuzz.bin")})
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
     assert "io fuzz ok" in r.stdout
+
+
+@pytest.mark.parametrize("seed", [7, 19])
+def test_fuzz_algorithm_menus(seed):
+    """Every tuned-menu algorithm for every collective must agree with
+    numpy on random payloads — the decision ladder may pick any entry."""
+    r = _run("fuzz_algs_worker.py", 4, {"AF_SEED": str(seed)},
+             timeout=520)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
+    assert r.stdout.count("menus agree") == 4
