@@ -18,11 +18,21 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+if os.environ.get("OTPU_EXAMPLE_EXECED") != "1":
+    # the platform must be pinned in the BOOT environment — a site boot
+    # hook may write its own JAX_PLATFORMS into os.environ, so a
+    # setdefault cannot detect user intent; re-exec once with a marker
+    # (OTPU_TOUR_PLATFORM=tpu to run on real chips)
+    env = dict(os.environ, OTPU_EXAMPLE_EXECED="1",
+               JAX_PLATFORMS=os.environ.get("OTPU_TOUR_PLATFORM", "cpu"))
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    os.execvpe(sys.executable, [sys.executable,
+                                os.path.abspath(__file__)], env)
 if "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np
 
