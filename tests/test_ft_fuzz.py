@@ -55,9 +55,14 @@ def test_fuzz_agreement_uniformity(seed):
     cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", str(N),
            "--enable-recovery",
            "--mca", "ft_detector", "true",
-           "--mca", "ft_detector_period", "0.2",
-           "--mca", "ft_detector_timeout", "1.5",
-           "--mca", "ft_detector_startup_grace", "2.0",
+           # generous detector envelope: on an oversubscribed 1-core
+           # CI host a healthy rank can stall >1.5s (GC, compile,
+           # sibling tests), and a false-positive death here makes its
+           # agreement report legitimately vanish — that is the
+           # detector working, not the property under test
+           "--mca", "ft_detector_period", "0.3",
+           "--mca", "ft_detector_timeout", "3.0",
+           "--mca", "ft_detector_startup_grace", "4.0",
            sys.executable, str(WORKER)]
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=300,
                        cwd=REPO, env=env)
