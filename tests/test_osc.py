@@ -71,7 +71,7 @@ class TestLocalWindows:
             win.put(np.zeros(1), 0)
 
 
-def _tpurun(n, script, timeout=240):
+def _tpurun(n, script, timeout=420):
     env = dict(os.environ)
     env.pop("OTPU_RANK", None)
     env.pop("OTPU_NPROCS", None)
