@@ -74,7 +74,7 @@ def _time_fn(fn, arg, iters=10, warmup=2):
 class DeviceBench:
     def __init__(self):
         import jax
-        from jax import shard_map
+        from ompi_tpu.base.jaxenv import shard_map
         from jax.sharding import PartitionSpec as P
 
         self.devices = jax.devices()
@@ -304,6 +304,8 @@ def mfu_rows(sink=None) -> list:
         fn, example_args, _ = make_step_and_args(jax.devices()[:1])
         jfn = jax.jit(fn)
         ca = jfn.lower(*example_args).compile().cost_analysis() or {}
+        if isinstance(ca, list):   # pre-0.9 jax: list of per-device dicts
+            ca = ca[0] if ca else {}
         flops = float(ca.get("flops", 0.0))
         t = _time_fn(lambda a: jfn(*a), example_args, iters=10)
         # f32 params, but JAX default matmul precision runs one bf16
@@ -322,6 +324,8 @@ def mfu_rows(sink=None) -> list:
             fnb, args_b, _ = make_step_and_args(jax.devices()[:1])
             jfnb = jax.jit(fnb)
             cab = jfnb.lower(*args_b).compile().cost_analysis() or {}
+            if isinstance(cab, list):
+                cab = cab[0] if cab else {}
             tb = _time_fn(lambda a: jfnb(*a), args_b, iters=10)
             row("mfu_train_step_bf16", float(cab.get("flops", 0.0)), tb,
                 "bf16", extra={"model_scale": scale,
@@ -1179,7 +1183,7 @@ def _ladder_probe(b: "DeviceBench", interp: bool, sizes) -> list:
     # the explicit transport exists for (ops/pallas_overlap.py)
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = b.ndev
@@ -1384,7 +1388,7 @@ def device_child() -> None:
         print(f"framework path unavailable ({why}); reporting raw psum "
               "with vs_baseline=0", file=sys.stderr, flush=True)
         import jax.numpy as jnp
-        from jax import shard_map
+        from ompi_tpu.base.jaxenv import shard_map
         from jax.sharding import PartitionSpec as P
 
         ndev = len(jax.devices())

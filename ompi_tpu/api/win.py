@@ -20,6 +20,7 @@ from ompi_tpu.api import op as op_mod
 from ompi_tpu.api.attributes import AttributeHost
 from ompi_tpu.api.errors import ErrorClass, MpiError
 from ompi_tpu.api.group import Group
+from ompi_tpu.runtime import trace
 
 
 class Win(AttributeHost):
@@ -175,6 +176,18 @@ class Win(AttributeHost):
         if monitoring.enabled():
             monitoring.record_osc(op, nbytes)
 
+    def _epoch(self, name: str, fn, *a):
+        """Run one epoch-synchronization call under an osc trace span
+        (fence / lock / unlock / PSCW / flush — the waits where RMA skew
+        and straggler targets become visible)."""
+        if not trace.enabled:
+            return fn(*a)
+        t0 = trace.now()
+        try:
+            return fn(*a)
+        finally:
+            trace.span(name, "osc", t0, args={"win": self.name})
+
     # -- RMA ops ---------------------------------------------------------
     def put(self, arr, target: int, offset: int = 0,
             region: Optional[int] = None) -> None:
@@ -273,35 +286,47 @@ class Win(AttributeHost):
     def fence(self) -> None:
         """``MPI_Win_fence``: close + open an active-target epoch."""
         self._check()
-        self.module.fence(self)
+        self._epoch("win_fence", self.module.fence, self)
 
     def lock(self, target: int, lock_type: str = LOCK_EXCLUSIVE) -> None:
         self._check()
-        self.module.lock(self, target, lock_type)
+        self._epoch("win_lock", self.module.lock, self, target, lock_type)
 
     def unlock(self, target: int) -> None:
         self._check()
-        self.module.unlock(self, target)
+        self._epoch("win_unlock", self.module.unlock, self, target)
 
     def lock_all(self) -> None:
         self._check()
-        for t in range(self.size):
-            self.module.lock(self, t, self.LOCK_SHARED)
+
+        def _all():
+            for t in range(self.size):
+                self.module.lock(self, t, self.LOCK_SHARED)
+
+        self._epoch("win_lock_all", _all)
 
     def unlock_all(self) -> None:
         self._check()
-        for t in range(self.size):
-            self.module.unlock(self, t)
+
+        def _all():
+            for t in range(self.size):
+                self.module.unlock(self, t)
+
+        self._epoch("win_unlock_all", _all)
 
     def flush(self, target: int) -> None:
         """Complete all outstanding ops this process issued to ``target``."""
         self._check()
-        self.module.flush(self, target)
+        self._epoch("win_flush", self.module.flush, self, target)
 
     def flush_all(self) -> None:
         self._check()
-        for t in range(self.size):
-            self.module.flush(self, t)
+
+        def _all():
+            for t in range(self.size):
+                self.module.flush(self, t)
+
+        self._epoch("win_flush_all", _all)
 
     def flush_local(self, target: int) -> None:
         # origin-local completion; our put/accumulate pack eagerly, so
@@ -314,19 +339,19 @@ class Win(AttributeHost):
     # PSCW generalized active-target (MPI_Win_post/start/complete/wait)
     def post(self, group: Group) -> None:
         self._check()
-        self.module.post(self, group)
+        self._epoch("win_post", self.module.post, self, group)
 
     def start(self, group: Group) -> None:
         self._check()
-        self.module.start(self, group)
+        self._epoch("win_start", self.module.start, self, group)
 
     def complete(self) -> None:
         self._check()
-        self.module.complete(self)
+        self._epoch("win_complete", self.module.complete, self)
 
     def wait(self) -> None:
         self._check()
-        self.module.wait(self)
+        self._epoch("win_wait", self.module.wait, self)
 
     def test(self) -> bool:
         """``MPI_Win_test``: nonblocking ``wait`` — True iff the exposure

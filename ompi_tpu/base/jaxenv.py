@@ -28,6 +28,64 @@ def pallas_interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+_sm_cache = None      # (shard_map callable, checker kwarg name)
+
+
+def _resolve_shard_map():
+    """Locate shard_map and its checker-kwarg spelling ONCE, by
+    signature inspection — not by probing with a thrown TypeError,
+    which would swallow genuine wrap-time TypeErrors from jax."""
+    global _sm_cache
+    if _sm_cache is None:
+        try:
+            from jax import shard_map as sm
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as sm
+        import inspect
+
+        try:
+            params = inspect.signature(sm).parameters
+        except (TypeError, ValueError):
+            params = {}
+        kw = "check_vma" if "check_vma" in params else "check_rep"
+        _sm_cache = (sm, kw)
+    return _sm_cache
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """Version-portable ``shard_map``: jax >= 0.9 exports it at top level
+    with the ``check_vma`` checker flag; earlier releases house it in
+    ``jax.experimental.shard_map`` and spell the flag ``check_rep``.
+    Every shard_map site in the tree goes through here so the jax-version
+    split lives in exactly one place.
+
+    ``check_rep`` stays False downlevel even when check_vma was
+    requested: the old replication checker is a weaker inference that
+    rejects replicated outputs the vma tracker proves (e.g. the train
+    step's psum'd params), so True simply fails to trace.  Known cost:
+    without rep/vma tracking the pp>=2 pipeline backward loses exact
+    gradient equivalence with pp=1 (pipeline.py's documented caveat;
+    ~1e-3 drift on the scan transpose) — acceptable downlevel, fixed by
+    jax >= 0.9."""
+    sm, kw = _resolve_shard_map()
+    checker = {kw: check_vma if kw == "check_vma" else False}
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **checker)
+
+
+def pcast(x, axes, *, to: str = "varying"):
+    """Version-portable ``jax.lax.pcast``: on jax >= 0.9 it marks arrays
+    for the varying-mesh-axes (vma) checker; earlier releases have no vma
+    type system (the replication checker is the old ``check_rep``), so
+    the marker is the identity there."""
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)
+
+
 def apply_platform_env() -> None:
     plats = os.environ.get("JAX_PLATFORMS", "").strip()
     if not plats:

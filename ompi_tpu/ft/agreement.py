@@ -31,10 +31,31 @@ import time
 from typing import Any, Callable, Iterable, Optional
 
 from ompi_tpu.ft import state as ft_state
+from ompi_tpu.runtime import trace
 
 
 class AgreementError(RuntimeError):
     pass
+
+
+def _traced_agree(fn):
+    """Record one agreement instance as an ``ft`` span — decision latency
+    is the FT signal the trace timeline exists to expose (a slow agree is
+    a straggler or a takeover round)."""
+    def wrapper(*a, **kw):
+        if not trace.enabled:
+            return fn(*a, **kw)
+        inst = kw.get("instance", a[1] if len(a) > 1 else None)
+        t0 = trace.now()
+        try:
+            return fn(*a, **kw)
+        finally:
+            trace.span(fn.__name__, "ft", t0, args={"instance": str(inst)})
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    wrapper.__wrapped__ = fn
+    return wrapper
 
 
 def _key(instance: tuple, kind: str) -> str:
@@ -60,6 +81,7 @@ def _setup_instance(rte, instance: tuple, contribution: Any,
     return client
 
 
+@_traced_agree
 def agree_kv(
     rte,
     instance: tuple,
@@ -120,6 +142,7 @@ def agree_kv(
             return got
 
 
+@_traced_agree
 def agree_tree(
     comm,
     instance: tuple,
@@ -507,6 +530,7 @@ def _p2p_tree(participants: list, me: int):
     return parent, children, subtree
 
 
+@_traced_agree
 def agree_p2p(
     comm,
     instance: tuple,

@@ -242,7 +242,14 @@ class Detector:
                             self._departed.add(target)
                         else:
                             from ompi_tpu.ft import propagator
+                            from ompi_tpu.runtime import trace
 
+                            if trace.enabled:
+                                trace.instant(
+                                    "ft_detect", "ft",
+                                    args={"rank": target,
+                                          "silence_ms":
+                                              (now - last_act) * 1e3})
                             propagator.report_failure(
                                 self.rte, target, origin="heartbeat",
                                 client=(self.client if coord_up

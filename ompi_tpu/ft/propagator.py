@@ -49,6 +49,11 @@ def report_failure(rte, world_rank: int, origin: str = "unknown",
         return
     _output.output(_stream, 1, "rank %d detected failed (via %s)",
                    world_rank, origin)
+    from ompi_tpu.runtime import trace
+
+    if trace.enabled:
+        trace.instant("ft_report_failure", "ft",
+                      args={"rank": world_rank, "origin": origin})
     ft_state.mark_failed(world_rank)
     if client is not NO_EVENT:
         try:
@@ -203,6 +208,12 @@ class EventPoller:
             if not ft_state.is_failed(rank):
                 _output.output(_stream, 1, "rank %d failed (event from %s)",
                                rank, payload.get("origin"))
+                from ompi_tpu.runtime import trace
+
+                if trace.enabled:
+                    trace.instant("ft_event_delivered", "ft",
+                                  args={"rank": rank,
+                                        "origin": payload.get("origin")})
                 ft_state.mark_failed(rank)
         elif name == "comm_revoked":
             ft_state.mark_revoked(int(payload["cid"]),

@@ -65,6 +65,12 @@ def comm_select(comm) -> None:
     from ompi_tpu.runtime import monitoring
 
     monitoring.wrap_coll_table(comm)
+    # coll/trace interposition (span + log2-size latency histogram per
+    # slot — host and device entry points alike).  Installed always;
+    # the wrapper's disabled path is one flag check.
+    from ompi_tpu.runtime import trace
+
+    trace.wrap_coll_table(comm)
 
 
 from ompi_tpu.base.output import register_help as _rh

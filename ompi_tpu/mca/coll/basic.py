@@ -16,6 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from ompi_tpu.api import op as op_mod
+from ompi_tpu.api.errors import ErrorClass, MpiError
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
 
@@ -173,7 +174,17 @@ class BasicCollModule:
                 st = comm.probe(source=r, tag=tag)
                 buf = np.empty(st._nbytes, np.uint8)
                 comm.recv(buf, source=r, tag=tag)
-                out[r] = buf.view(np.asarray(sendbufs[r]).dtype)
+                dt = np.asarray(sendbufs[r]).dtype
+                if buf.nbytes % max(1, dt.itemsize):
+                    raise MpiError(
+                        ErrorClass.ERR_TYPE,
+                        f"alltoallv: peer {r} sent {buf.nbytes} bytes, "
+                        f"not a multiple of this rank's send dtype {dt} "
+                        f"(itemsize {dt.itemsize}) — alltoallv's contract "
+                        "is a symmetric dtype per pair; use alltoallw "
+                        "with explicit recvtypes for asymmetric-dtype "
+                        "exchanges")
+                out[r] = buf.view(dt)
         from ompi_tpu.api.request import waitall
 
         waitall(reqs)

@@ -32,13 +32,28 @@ from ompi_tpu.api.errors import ErrorClass, MpiError
 from ompi_tpu.api.request import CompletedRequest
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
-from ompi_tpu.runtime import spc
+from ompi_tpu.runtime import spc, trace
 
 
 def _ar_key(x, op):
     """Allreduce program-cache key — the hot-path inline form of
     ``_keyfor("allreduce", ...)``; the two MUST stay in sync."""
     return ("allreduce", op.name, x.shape, x.dtype)
+
+
+def _traced_dispatch(fn, coll: str, nbytes: int):
+    """Wrap a compiled program so its XLA *dispatch* (the async launch,
+    not device completion — the stream is the progress engine) appears as
+    a ``device`` span.  Only installed while tracing is enabled, so the
+    steady-state cache hit stays probe + SPC bump + dispatch."""
+    def dispatch(*a):
+        t0 = trace.now()
+        try:
+            return fn(*a)
+        finally:
+            trace.span(f"xla_{coll}", "device", t0,
+                       args={"nbytes": int(nbytes)})
+    return dispatch
 
 
 class PersistentColl:
@@ -59,12 +74,18 @@ class PersistentColl:
 
     def __call__(self, x):
         self._bump(self._nbytes)
+        if trace.enabled:
+            return _traced_dispatch(self.fn, self.coll, self._nbytes)(x)
         return self.fn(x)
 
     def start(self, x):
         spc.bump_device(self._nbytes)
         r = CompletedRequest()
-        r.result = self.fn(x)
+        if trace.enabled:
+            r.result = _traced_dispatch(self.fn, self.coll,
+                                        self._nbytes)(x)
+        else:
+            r.result = self.fn(x)
         return r
 
     def free(self) -> None:
@@ -134,6 +155,8 @@ class XlaCollModule:
         if entry is None:
             return None
         spc.bump_device(entry[1])
+        if trace.enabled:
+            return _traced_dispatch(entry[0], key[0], entry[1])
         return entry[0]
 
     def _get(self, comm, key, x, builder, inner_n: bool = False):
@@ -156,6 +179,8 @@ class XlaCollModule:
                     self._cache[key] = entry
         fn, nbytes = entry
         spc.bump_device(nbytes)
+        if trace.enabled:
+            return _traced_dispatch(fn, key[0], nbytes), x
         return fn, x
 
     def _shard_map(self, fn, in_specs, out_specs, check_vma: bool = False):
@@ -163,7 +188,8 @@ class XlaCollModule:
         # gather+fold) are replicated in ways jax 0.9's static varying-mesh-
         # axes checker cannot infer; correctness is covered by tests/test_coll.
         import jax
-        from jax import shard_map
+
+        from ompi_tpu.base.jaxenv import shard_map
 
         return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=check_vma))

@@ -44,6 +44,29 @@ from ompi_tpu.api.errors import ErrorClass, MpiError
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
 from ompi_tpu.mca.coll.basic import coll_tag
+from ompi_tpu.runtime import trace
+
+
+def _traced_io(name: str, nbytes_of=len):
+    """Decorator: run one fbtl/fcoll I/O entry point under an ``io``
+    trace span.  ``nbytes_of`` sizes the payload from the last
+    positional arg (``len`` for data buffers, ``int`` for byte counts);
+    the disabled path is the usual single flag check."""
+    def deco(fn):
+        def wrapper(self, file, offset, x):
+            if not trace.enabled:
+                return fn(self, file, offset, x)
+            t0 = trace.now()
+            try:
+                return fn(self, file, offset, x)
+            finally:
+                trace.span(name, "io", t0,
+                           args={"nbytes": int(nbytes_of(x))})
+        wrapper.__name__ = fn.__name__.lstrip("_")
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__wrapped__ = fn
+        return wrapper
+    return deco
 
 
 def view_extents(disp: int, filetype, start_byte: int, nbytes: int):
@@ -114,6 +137,7 @@ class OmpioModule:
         os.fsync(file.fd)
 
     # -- fbtl layer: individual I/O --------------------------------------
+    @_traced_io("io_write_at")
     def write_at(self, file, offset: int, data: bytes) -> int:
         """offset in etype units relative to the view; returns bytes."""
         start = offset * file.etype.size
@@ -124,6 +148,7 @@ class OmpioModule:
             pos += ln
         return pos
 
+    @_traced_io("io_read_at", nbytes_of=int)
     def read_at(self, file, offset: int, nbytes: int) -> bytes:
         start = offset * file.etype.size
         chunks = []
@@ -245,6 +270,7 @@ class OmpioModule:
             yield ai, pos, take
             pos += take
 
+    @_traced_io("io_write_at_all")
     def write_at_all(self, file, offset: int, data: bytes) -> int:
         comm = file.comm
         if comm is None or comm.size == 1:
@@ -300,6 +326,7 @@ class OmpioModule:
         os.pwrite(file.fd, bytes(buf), lo)
         return hi - lo
 
+    @_traced_io("io_read_at_all", nbytes_of=int)
     def read_at_all(self, file, offset: int, nbytes: int) -> bytes:
         comm = file.comm
         if comm is None or comm.size == 1:

@@ -108,9 +108,18 @@ class RdmaModule:
                 # user still holds views of the mapped data (win.local
                 # escaped) — close is impossible until those die, and
                 # retrying from SharedMemory.__del__ at interpreter
-                # exit would only print "Exception ignored" noise: the
-                # OS reclaims the mapping at process exit either way
-                seg.shm.close = lambda: None
+                # exit would only print "Exception ignored" noise.
+                # Swallow ONLY the BufferError on later attempts (not
+                # close itself): if the views die first, the __del__
+                # retry still releases the fd/mapping instead of
+                # leaking it until process exit.
+                def _close_quietly(_orig=seg.shm.close):
+                    try:
+                        _orig()
+                    except BufferError:
+                        pass
+
+                seg.shm.close = _close_quietly
             except Exception:
                 pass
             if seg.owner:

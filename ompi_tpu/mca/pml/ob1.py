@@ -28,7 +28,7 @@ from ompi_tpu.base.var import VarType
 from ompi_tpu.datatype import Convertor
 from ompi_tpu.mca.bml import Bml
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, Frag
-from ompi_tpu.runtime import peruse, spc
+from ompi_tpu.runtime import peruse, spc, trace
 
 
 class SendRequest(Request):
@@ -202,6 +202,14 @@ class Ob1Pml:
         and cannot observe the match)."""
         spc.record("isend")
         req = SendRequest(self, comm, buf, dest, tag)
+        if trace.enabled:
+            # span closes at request completion, whichever protocol leg
+            # (eager inline, RNDV ACK, RGET done/pull) completes it
+            _t0 = trace.now()
+            req.on_complete(lambda r, _t0=_t0: trace.span(
+                "send", "pml", _t0,
+                args={"nbytes": r.nbytes, "dest": r.dest, "tag": r.tag,
+                      "cid": r.comm.cid}))
         dst_world = (comm.remote_group if comm.is_inter
                      else comm.group).world_rank(dest)
         src_world = comm.world_rank(comm.rank)
@@ -354,6 +362,12 @@ class Ob1Pml:
     def irecv(self, comm, buf, source: int, tag: int) -> Request:
         spc.record("irecv")
         req = RecvRequest(self, comm, buf, source, tag)
+        if trace.enabled:
+            _t0 = trace.now()
+            req.on_complete(lambda r, _t0=_t0: trace.span(
+                "recv", "pml", _t0,
+                args={"nbytes": r.received, "source": r.status.source,
+                      "tag": r.tag, "cid": r.comm.cid}))
         dst_world = comm.world_rank(comm.rank)
         key = (comm.cid, dst_world)
         if peruse.active():

@@ -82,8 +82,42 @@ class ThreadsComponent(mca.Component):
         raise NotImplementedError  # pragma: no cover - interface
 
 
+class InlineSerialPool(WorkPool):
+    """Threadless fallback handed out after the permanent (finalize)
+    ``shutdown_pool``: no new native/OS worker threads may be spawned
+    past teardown — the basic jobs execute inline on the caller's
+    thread.  ``size == 1`` / ``parallel_pack = False`` keep every
+    fan-out site (op host reductions, convertor packs) on its serial
+    path, so pack/unpack are never reached and inherit the base
+    NotImplementedError."""
+
+    size = 1
+    parallel_pack = False
+
+    def memcpy(self, dst: np.ndarray, src: np.ndarray) -> Work:
+        if dst.nbytes != src.nbytes:
+            raise ValueError("memcpy size mismatch")
+        if not (dst.flags.c_contiguous and src.flags.c_contiguous):
+            raise ValueError("pool jobs need C-contiguous arrays")
+        dst.reshape(-1).view(np.uint8)[:] = src.reshape(-1).view(np.uint8)
+        return CompletedWork()
+
+    def reduce(self, op: str, acc: np.ndarray, src: np.ndarray) -> Work:
+        ufunc = {"sum": np.add, "prod": np.multiply,
+                 "max": np.maximum, "min": np.minimum}.get(op)
+        if (ufunc is None or acc.shape != src.shape
+                or src.dtype != acc.dtype):
+            raise ValueError(f"unsupported reduce: {op}")
+        if not acc.flags.c_contiguous:
+            raise ValueError("pool jobs need C-contiguous arrays")
+        a = acc.reshape(-1)
+        ufunc(a, src.reshape(-1), out=a)
+        return CompletedWork()
+
+
 _pool: Optional[WorkPool] = None
 _pool_lock = threading.Lock()
+_shut_down = False
 
 
 def framework() -> mca.Framework:
@@ -106,9 +140,18 @@ def default_workers() -> int:
 
 
 def get_pool() -> WorkPool:
-    """Process-global pool from the selected component (lazy)."""
+    """Process-global pool from the selected component (lazy).
+
+    After the permanent (finalize) ``shutdown_pool`` callers get an
+    inline-serial pool: a host reduction or pack racing finalize must
+    not respawn native worker threads the runtime just joined — the
+    lazy recreation here used to do exactly that.  A plain
+    ``shutdown_pool()`` keeps the lazy rebuild: bench and tests use it
+    to reconfigure the worker count."""
     global _pool
     with _pool_lock:
+        if _shut_down:
+            return InlineSerialPool()
         if _pool is None:
             comp = framework().select()
             if comp is None:  # python component always opens; belt+braces
@@ -117,12 +160,23 @@ def get_pool() -> WorkPool:
         return _pool
 
 
-def shutdown_pool() -> None:
-    global _pool
+def shutdown_pool(permanent: bool = False) -> None:
+    """Close the pool.  ``permanent=True`` (runtime finalize) also bars
+    lazy recreation until :func:`reopen_pool` — the next re-init."""
+    global _pool, _shut_down
     with _pool_lock:
+        if permanent:
+            _shut_down = True
         if _pool is not None:
             _pool.close()
             _pool = None
+
+
+def reopen_pool() -> None:
+    """Re-arm lazy pool creation (runtime re-init after a finalize)."""
+    global _shut_down
+    with _pool_lock:
+        _shut_down = False
 
 
 def _reset_after_fork() -> None:
@@ -130,9 +184,10 @@ def _reset_after_fork() -> None:
     # child rebuilds lazily) and renew the lock in case the parent held
     # it mid-fork.  The reference's substrate has the same rule — OS
     # threads are per-process (opal/mca/threads).
-    global _pool, _pool_lock
+    global _pool, _pool_lock, _shut_down
     _pool_lock = threading.Lock()
     _pool = None
+    _shut_down = False
 
 
 import os as _os  # noqa: E402  (registration must follow the handler)

@@ -1387,7 +1387,7 @@ def _build_bcast(n: int, axis: str, nseg: int, srows: int,
 def _jit_right_permute(mesh, axis: str, payload_shape, dtype_str: str,
                        interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -1410,7 +1410,7 @@ def right_permute(x, mesh, axis: str, interpret: bool = True):
 def _jit_all_gather(mesh, axis: str, blk_shape, dtype_str: str,
                     interpret: bool, variant: str = "ring"):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -1512,7 +1512,7 @@ def _jit_reduce_scatter(mesh, axis: str, payload_shape, dtype_str: str,
                         op: str, interpret: bool, variant: str,
                         seg_elems):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -1571,7 +1571,7 @@ def reduce_scatter_sum(x, mesh, axis: str, interpret: bool = True):
 def _jit_all_reduce(mesh, axis: str, payload_shape, dtype_str: str,
                     op: str, interpret: bool, variant: str, seg_elems):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -1662,7 +1662,7 @@ def all_reduce_sum(x, mesh, axis: str, interpret: bool = True):
 def _jit_all_to_all(mesh, axis: str, blk_shape, dtype_str: str,
                     interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -1696,7 +1696,7 @@ def all_to_all(x, mesh, axis: str, interpret: bool = True):
 def _jit_all_gather_v(mesh, axis: str, max_rows: int, width: int,
                       chunk: int, dtype_str: str, interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -1754,7 +1754,7 @@ def all_gather_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
 def _jit_all_to_all_v(mesh, axis: str, max_rows: int, width: int,
                       chunk: int, dtype_str: str, interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -1821,7 +1821,7 @@ def all_to_all_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
 def _jit_all_reduce_torus(mesh, axes, payload_shape, dtype_str: str,
                           op: str, interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     a0, a1 = axes
@@ -1908,7 +1908,7 @@ def _torus_flat_mesh(mesh, a0, a1):
 def _jit_reduce_scatter_torus(mesh, axes, payload_shape, dtype_str: str,
                               op: str, interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     a0, a1 = axes
@@ -1971,7 +1971,7 @@ def reduce_scatter_torus(x, mesh, axes=("x", "y"), op: str = "sum",
 def _jit_all_gather_torus(mesh, axes, blk_shape, dtype_str: str,
                           interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     a0, a1 = axes
@@ -2020,7 +2020,7 @@ def all_gather_torus(x, mesh, axes=("x", "y"), interpret: bool = True):
 def _jit_bcast(mesh, axis: str, payload_shape, dtype_str: str,
                interpret: bool, seg_elems: int):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]

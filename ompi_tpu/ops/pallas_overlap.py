@@ -162,7 +162,7 @@ def _jit_matmul_reduce_scatter(mesh, axis: str, m: int, k_loc: int,
                                n_out: int, dtype_str: str,
                                interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -201,7 +201,7 @@ def matmul_reduce_scatter(a, b, mesh, axis: str,
 def _jit_matmul_allreduce(mesh, axis: str, m: int, k_loc: int,
                           n_out: int, dtype_str: str, interpret: bool):
     jax, jnp, lax, pl, pltpu = _mods()
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]

@@ -137,7 +137,7 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ompi_tpu.base.jaxenv import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     dims = model_dims(spec, layers)

@@ -217,8 +217,12 @@ class CoordServer:
                     except Exception as exc:
                         _send_frame(conn, {"ok": False, "error": str(exc)})
                 elif op == "ping":
+                    # "time" is the server's wall clock: ranks estimate
+                    # their offset to it (min-RTT, mpisync estimator) so
+                    # per-rank trace timelines share one timebase
                     _send_frame(conn, {"ok": True, "nprocs": self.nprocs,
-                                       "aborted": self._aborted})
+                                       "aborted": self._aborted,
+                                       "time": time.time()})
                 else:
                     _send_frame(conn, {"ok": False, "error": f"bad op {op}"})
         except (ConnectionError, OSError):
@@ -265,6 +269,12 @@ class CoordServer:
     @property
     def aborted(self) -> Optional[int]:
         return self._aborted
+
+    def collect(self, key: str) -> dict:
+        """{rank: value} of every KV entry published under ``key`` — the
+        launcher-side gather of per-rank payloads (trace timelines)."""
+        with self._kv_cond:
+            return {r: v for (r, k), v in self._kv.items() if k == key}
 
     def close(self) -> None:
         """Full stop: the listener AND every live client connection.
@@ -369,6 +379,11 @@ class CoordClient:
         if events:
             self._event_since = events[-1][0]
         return events
+
+    def server_time(self) -> float:
+        """The coord server's wall clock (one ping round-trip) — feed
+        into ``mpisync.estimate_offset`` for clock alignment."""
+        return float(self._rpc(op="ping")["time"])
 
     def abort(self, code: int = 1) -> None:
         self._rpc(op="abort", code=code)

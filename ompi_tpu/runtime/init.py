@@ -124,6 +124,18 @@ def init(devices=None, rte=None, argv: Optional[list] = None):
 
         spc.init()
 
+        # otpu-trace (span ring buffer + latency-histogram pvars); the
+        # enable cvar was applied at registration from env/file and again
+        # from the CLI parse above
+        from ompi_tpu.runtime import trace
+
+        trace.init()
+
+        # a re-init after a prior finalize may use the work pool again
+        from ompi_tpu.mca.threads import base as _threads_reopen
+
+        _threads_reopen.reopen_pool()
+
         # record the initializing thread (MPI_Is_thread_main anchor —
         # overrides any earlier library register() from a worker thread)
         from ompi_tpu.runtime import interlib
@@ -295,6 +307,14 @@ def finalize() -> None:
             from ompi_tpu.ft import propagator as _ft_prop
 
             _ft_prop.stop()
+            # trace export needs the coord client (KV publish + clock
+            # offset), so it runs before rte.finalize tears it down
+            from ompi_tpu.runtime import trace as _trace
+
+            try:
+                _trace.finalize_export(_rte)
+            except Exception:
+                pass   # observability must never break finalize
             # release per-comm coll resources (shared segments etc.) for
             # the built-in comms the user never frees — the reference
             # destroys WORLD/SELF in ompi_mpi_finalize the same way
@@ -309,7 +329,7 @@ def finalize() -> None:
                 _rte.finalize()
             from ompi_tpu.mca.threads import base as _threads_base
 
-            _threads_base.shutdown_pool()
+            _threads_base.shutdown_pool(permanent=True)
             mca.close_all()
         finally:
             from ompi_tpu.runtime import progress

@@ -1,0 +1,459 @@
+"""otpu-trace — always-on span tracing with per-rank ring buffers.
+
+The missing *timeline* layer of the observability stack: SPC counts
+(`runtime/spc.py`), monitoring sums per peer (`runtime/monitoring.py`),
+PERUSE sees queue internals (`runtime/peruse.py`) — none of them record
+WHEN a collective started and ended on each rank, so collective skew,
+straggler ranks, and FT detection latency were invisible.  This module
+records spans (name, category, t_start/t_end ns, args) and instant
+events into a fixed-size per-rank ring buffer, plus log2-size-binned
+latency histograms per collective exported as MPI_T pvars.
+
+Hot-path discipline is peruse.py's: every instrumentation site is
+guarded by the single module flag ``enabled`` — the disabled cost is one
+attribute load + branch.  The enabled record path is lock-light: slot
+allocation is one ``itertools.count`` bump (atomic in CPython), the ring
+overwrites oldest entries, and only the histogram update takes a lock
+(it is exact, the way SPC's relaxed counters are not).
+
+At finalize each rank exports a Chrome trace-event JSON file
+(``otpu_trace_dir`` cvar) and publishes the payload into the
+CoordServer KV space so the launcher (``tools/tpurun.py``) can gather
+every rank's timeline, align clocks with the mpisync offset estimator,
+and emit one merged timeline plus a skew report.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from ompi_tpu.base.var import PvarClass, VarType, registry
+
+#: THE fast-path guard (peruse._active discipline): instrumentation
+#: sites read this module attribute and branch — nothing else happens
+#: while tracing is disabled.
+enabled = False
+
+_ring: Optional[list] = None
+_ring_n = 0
+_slot = itertools.count()
+
+#: wall/monotonic anchor pair: spans carry perf_counter_ns timestamps
+#: (monotonic, ns resolution); export maps them onto the wall clock via
+#: this pair so cross-rank merge has a common (pre-offset) timebase.
+_anchor_wall_ns = time.time_ns()
+_anchor_mono_ns = time.perf_counter_ns()
+
+# histogram state: (coll, log2 bin) -> [count, sum_ns, min_ns, max_ns,
+# count_pvar, sum_pvar]; exact under _hist_lock (enabled path only)
+_hist: dict = {}
+_hist_lock = threading.Lock()
+
+_events_pvar = None
+_KV_KEY = "otpu_trace"
+_DEFAULT_DIR = "otpu-trace"
+
+
+def _set_enabled(value: bool) -> None:
+    global enabled, _ring, _ring_n
+    if value:
+        want = max(1024, int(_buf_var.value or 65536))
+        if _ring is None or want != _ring_n:
+            # honor a buffer_events change across a disable/re-enable
+            # cycle; the resize starts a fresh (empty) ring
+            _ring_n = want
+            _ring = [None] * want
+    enabled = bool(value)
+
+
+# buffer/dir register first: registering the enable var applies its
+# env/file value immediately, and the on_set hook sizes the ring
+_dir_var = registry.register(
+    "trace", None, "dir", vtype=VarType.STRING, default="",
+    help="Directory for per-rank Chrome trace JSON written at finalize "
+         f"(empty: '{_DEFAULT_DIR}' when tracing is enabled)")
+_buf_var = registry.register(
+    "trace", None, "buffer_events", vtype=VarType.INT, default=65536,
+    help="Ring buffer capacity in events; the ring overwrites oldest "
+         "entries, so a trace always holds the run's tail")
+_enable_var = registry.register(
+    "trace", None, "enable", vtype=VarType.BOOL, default=False,
+    help="Record span/instant events (pml, coll host+device, osc epochs, "
+         "MPI-IO, FT) into the per-rank trace ring buffer and export "
+         "Chrome trace JSON at finalize; disabled cost is one flag check",
+    on_set=_set_enabled)
+
+
+def init() -> None:
+    """Register the tracer's own pvars (called from runtime init; safe
+    to call repeatedly)."""
+    global _events_pvar
+    _events_pvar = registry.register_pvar(
+        "trace", None, "events_recorded", pclass=PvarClass.COUNTER,
+        help="Total trace events recorded (ring may have overwritten "
+             "the oldest: capacity is otpu_trace_buffer_events)")
+    _events_pvar.on_read = \
+        lambda: _events_pvar.set(float(recorded_count()))
+
+
+def recorded_count() -> int:
+    """Total events ever recorded: the highest slot index still in the
+    ring, +1.  Slot allocation is the one atomic counter (itertools
+    .count), so this needs no second — racy — accumulator; overwritten
+    events can only have LOWER indices than the survivors."""
+    if _ring is None:
+        return 0
+    return max((e[-1] for e in _ring if e is not None), default=-1) + 1
+
+
+def now() -> int:
+    """Span start timestamp (perf_counter_ns)."""
+    return time.perf_counter_ns()
+
+
+def span(name: str, cat: str, t_start: int, t_end: Optional[int] = None,
+         args: Optional[dict] = None) -> None:
+    """Record one complete span.  Callers capture ``t_start = trace.now()``
+    inside their own ``if trace.enabled`` guard."""
+    if not enabled:
+        return
+    if t_end is None:
+        t_end = time.perf_counter_ns()
+    i = next(_slot)
+    _ring[i % _ring_n] = ("X", name, cat, t_start, t_end - t_start,
+                          threading.get_ident(), args, i)
+
+
+def instant(name: str, cat: str, args: Optional[dict] = None) -> None:
+    """Record one instant event (FT detection, propagation, delivery)."""
+    if not enabled:
+        return
+    i = next(_slot)
+    _ring[i % _ring_n] = ("i", name, cat, time.perf_counter_ns(), 0,
+                          threading.get_ident(), args, i)
+
+
+# -- log2-size-binned latency histograms --------------------------------
+
+def _bin_label(b: int) -> str:
+    """Human label of log2 bin ``b`` (its lower bound): 0, 1b..512b,
+    1k..512k, 1m.."""
+    if b == 0:
+        return "0"
+    lo = 1 << (b - 1)
+    if lo < (1 << 10):
+        return f"{lo}b"
+    if lo < (1 << 20):
+        return f"{lo >> 10}k"
+    if lo < (1 << 30):
+        return f"{lo >> 20}m"
+    return f"{lo >> 30}g"
+
+
+def hist_record(coll: str, nbytes: int, dur_ns: int) -> None:
+    """Fold one collective invocation into its (coll, log2 size) bin and
+    the bin's MPI_T pvars (lazily registered on first hit so the pvar
+    namespace only carries bins the run actually touched)."""
+    b = int(nbytes).bit_length()
+    key = (coll, b)
+    with _hist_lock:
+        cell = _hist.get(key)
+        if cell is None:
+            label = _bin_label(b)
+            cnt = registry.register_pvar(
+                "trace", "hist", f"{coll}_{label}_count",
+                pclass=PvarClass.COUNTER,
+                help=f"{coll} invocations in the [{label}, next-bin) "
+                     "payload size bin")
+            tot = registry.register_pvar(
+                "trace", "hist", f"{coll}_{label}_sum_us",
+                pclass=PvarClass.AGGREGATE,
+                help=f"Summed {coll} latency (us) in the [{label}, "
+                     "next-bin) payload size bin")
+            cell = _hist[key] = [0, 0, dur_ns, dur_ns, cnt, tot]
+        cell[0] += 1
+        cell[1] += dur_ns
+        cell[2] = min(cell[2], dur_ns)
+        cell[3] = max(cell[3], dur_ns)
+        cell[4].add_relaxed(1)
+        cell[5].add_relaxed(dur_ns / 1000.0)
+
+
+def histograms() -> dict:
+    """{(coll, bin_label): (count, sum_us, min_us, max_us)} snapshot."""
+    with _hist_lock:
+        return {
+            (coll, _bin_label(b)): (c[0], c[1] / 1000.0, c[2] / 1000.0,
+                                    c[3] / 1000.0)
+            for (coll, b), c in _hist.items()
+        }
+
+
+# -- per-comm coll table interposition ----------------------------------
+
+#: collectives whose first argument carries the payload (superset of
+#: monitoring's set: the device *_array entry points are sized too)
+_SIZED_COLLS = {
+    "bcast", "allreduce", "reduce", "allgather", "allgatherv", "alltoall",
+    "reduce_scatter", "reduce_scatter_block", "gather", "gatherv",
+    "scatter", "scan", "exscan",
+    "ibcast", "iallreduce", "ireduce", "iallgather", "ialltoall",
+    "igather", "iscatter", "ireduce_scatter", "iscan", "iexscan",
+    "allreduce_array", "bcast_array", "allgather_array",
+    "allgatherv_array", "reduce_scatter_array", "alltoall_array",
+    "alltoallv_array", "ppermute_array", "psum_scatter_array",
+    "reduce_array", "gather_array", "scatter_array", "scan_array",
+    "exscan_array",
+}
+
+
+def wrap_coll_table(comm) -> None:
+    """coll/trace interposition: wrap every selected c_coll slot with a
+    span + histogram recorder.  Installed unconditionally at comm_select
+    (tracing can be switched on mid-run through MPI_T); the wrapper's
+    disabled path is one flag check, verified by test_perf_guard."""
+
+    def make(name, fn):
+        def traced(comm_arg, *args, **kw):
+            if not enabled:
+                return fn(comm_arg, *args, **kw)
+            # .nbytes is an attribute on both numpy and jax arrays — no
+            # np.asarray here, which would pull a device buffer to host
+            nbytes = 0
+            if name in _SIZED_COLLS and args:
+                nbytes = getattr(args[0], "nbytes", 0) or 0
+            t0 = time.perf_counter_ns()
+            try:
+                return fn(comm_arg, *args, **kw)
+            finally:
+                t1 = time.perf_counter_ns()
+                span(name, "coll", t0, t1,
+                     args={"nbytes": int(nbytes), "cid": comm_arg.cid})
+                hist_record(name, int(nbytes), t1 - t0)
+
+        # carry the inner slot's marker attributes (__sync_wrapped__,
+        # __monitored__, ...) — interposition layers and tests probe the
+        # outermost callable for them
+        traced.__dict__.update(getattr(fn, "__dict__", {}))
+        traced.__traced__ = True
+        traced.__wrapped__ = fn
+        traced.__self__ = getattr(fn, "__self__", None)
+        return traced
+
+    for name, fn in list(comm.c_coll.items()):
+        if not getattr(fn, "__traced__", False):
+            comm.c_coll[name] = make(name, fn)
+
+
+# -- export --------------------------------------------------------------
+
+def _wall_us(t_ns: int) -> float:
+    return (_anchor_wall_ns + (t_ns - _anchor_mono_ns)) / 1000.0
+
+
+def chrome_events() -> list:
+    """Ring contents as Chrome trace-event dicts (ts/dur in wall-clock
+    microseconds), oldest first."""
+    if _ring is None:
+        return []
+    events = [e for e in _ring if e is not None]
+    events.sort(key=lambda e: e[3])
+    out = []
+    for ph, name, cat, t0, dur, tid, eargs, _slot_i in events:
+        ev = {"ph": ph, "name": name, "cat": cat,
+              "ts": _wall_us(t0), "tid": tid}
+        if ph == "X":
+            ev["dur"] = dur / 1000.0
+        if eargs:
+            ev["args"] = eargs
+        out.append(ev)
+    return out
+
+
+def chrome_payload(rank: int, clock_offset_us: float = 0.0,
+                   extra_meta: Optional[dict] = None) -> dict:
+    """Full per-rank Chrome trace JSON object (events + metadata)."""
+    import socket
+
+    recorded = recorded_count()
+    events = chrome_events()
+    for ev in events:
+        ev["pid"] = rank
+    meta = {
+        "rank": rank,
+        "host": socket.gethostname(),
+        "pid_os": os.getpid(),
+        "clock_offset_us": clock_offset_us,
+        "events_recorded": recorded,
+        "events_overwritten": max(0, int(recorded) - len(events)),
+        "trace_dir": str(_dir_var.value or _DEFAULT_DIR),
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    return {"traceEvents": events, "metadata": meta}
+
+
+def _estimate_coord_offset(client) -> float:
+    """This rank's wall clock MINUS the coord server's clock, in us
+    (the sign convention ``merge_timelines``/``skew_report`` consume:
+    ``ts - offset`` lands every rank on the coord timebase), via the
+    mpisync min-RTT estimator.  ``estimate_offset`` reports the peer's
+    clock minus ours, hence the negation."""
+    from ompi_tpu.tools.mpisync import estimate_offset
+
+    off_s, _rtt = estimate_offset(client.server_time, iters=5)
+    return -off_s * 1e6
+
+
+def finalize_export(rte) -> None:
+    """Called from runtime finalize (while the coord client is still
+    alive): write this rank's Chrome trace JSON and publish the payload
+    into the CoordServer KV space for the launcher-side merge."""
+    if not enabled or _ring is None:
+        return
+    rank = int(getattr(rte, "my_world_rank", 0) or 0)
+    client = getattr(rte, "client", None)
+    offset_us = 0.0
+    if client is not None:
+        try:
+            offset_us = _estimate_coord_offset(client)
+        except Exception:
+            offset_us = 0.0
+    payload = chrome_payload(rank, clock_offset_us=offset_us)
+    tdir = payload["metadata"]["trace_dir"]
+    encoded = json.dumps(payload)   # one encode serves file AND publish
+    try:
+        os.makedirs(tdir, exist_ok=True)
+        with open(os.path.join(tdir, f"trace_rank{rank}.json"), "w") as f:
+            f.write(encoded)
+    except OSError:
+        pass   # unwritable dir must not break finalize
+    if client is not None:
+        try:
+            client.put(rank, _KV_KEY, encoded)
+        except Exception:
+            pass   # coord gone: the per-rank file still exists
+
+
+# -- launcher-side merge (used by tools/tpurun.py) -----------------------
+
+def merge_timelines(payloads: list) -> list:
+    """Merge per-rank Chrome payloads into one clock-aligned event list:
+    each rank's timestamps are shifted by its measured offset to the
+    coord clock, pid is the world rank."""
+    merged = []
+    for p in payloads:
+        meta = p.get("metadata", {})
+        off_us = float(meta.get("clock_offset_us", 0.0))
+        rank = int(meta.get("rank", 0))
+        for ev in p.get("traceEvents", []):
+            e = dict(ev)
+            e["ts"] = float(e["ts"]) - off_us
+            e["pid"] = rank
+            merged.append(e)
+    merged.sort(key=lambda e: e["ts"])
+    return merged
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def skew_report(payloads: list) -> str:
+    """Cross-rank skew analysis of the collective spans: per
+    (collective, communicator) the arrival spread (start-time skew of
+    matched rounds), the most-often-slowest rank, and p50/p99 latency
+    by log2 size bin.
+
+    Rounds are matched per (name, cid) by occurrence index FROM THE
+    TAIL: the ring overwrites oldest events, so when ranks lost unequal
+    prefixes only the newest min-count occurrences still line up across
+    ranks.  Grouping by cid keeps a sub-communicator's collectives from
+    being index-matched against another comm's rounds."""
+    per_rank: dict = {}       # rank -> (name, cid) -> [(ts, dur, nbytes)]
+    overwritten = 0
+    for p in payloads:
+        meta = p.get("metadata", {})
+        rank = int(meta.get("rank", 0))
+        off_us = float(meta.get("clock_offset_us", 0.0))
+        overwritten += int(meta.get("events_overwritten", 0) or 0)
+        by_key = per_rank.setdefault(rank, {})
+        for ev in p.get("traceEvents", []):
+            if ev.get("cat") != "coll" or ev.get("ph") != "X":
+                continue
+            eargs = ev.get("args") or {}
+            key = (ev["name"], eargs.get("cid"))
+            by_key.setdefault(key, []).append(
+                (float(ev["ts"]) - off_us, float(ev.get("dur", 0.0)),
+                 int(eargs.get("nbytes", 0))))
+    ranks = sorted(per_rank)
+    keys = sorted({k for d in per_rank.values() for k in d},
+                  key=lambda k: (k[0], str(k[1])))
+    lines = [f"otpu-trace skew report — {len(ranks)} ranks "
+             f"({', '.join(str(r) for r in ranks)})"]
+    if overwritten:
+        lines.append(
+            f"note: {overwritten} events overwritten across ranks (ring "
+            "capacity otpu_trace_buffer_events); rounds are tail-aligned")
+    lines += ["",
+              "collective          cid  rounds  spread_mean_us  "
+              "spread_max_us  slowest_rank"]
+    bin_lat: dict = {}           # (name, bin_label) -> [dur...]
+    for key in keys:
+        name, cid = key
+        seqs = {r: per_rank[r].get(key, []) for r in ranks}
+        rounds = min((len(s) for s in seqs.values()), default=0)
+        # tail-align: the ring keeps the newest events on every rank
+        tails = {r: seqs[r][len(seqs[r]) - rounds:] for r in ranks}
+        spreads, slow_count = [], {}
+        for k in range(rounds):
+            starts = {r: tails[r][k][0] for r in ranks}
+            durs = {r: tails[r][k][1] for r in ranks}
+            spreads.append(max(starts.values()) - min(starts.values()))
+            slowest = max(durs, key=durs.get)
+            slow_count[slowest] = slow_count.get(slowest, 0) + 1
+        for r in ranks:
+            for _ts, dur, nbytes in tails[r] if rounds else seqs[r]:
+                label = _bin_label(int(nbytes).bit_length())
+                bin_lat.setdefault((name, label), []).append(dur)
+        cid_s = "-" if cid is None else str(cid)
+        if rounds:
+            slowest_rank = max(slow_count, key=slow_count.get)
+            lines.append(
+                f"{name:<18}  {cid_s:>3}  {rounds:>6}"
+                f"  {sum(spreads)/len(spreads):>14.1f}"
+                f"  {max(spreads):>13.1f}  {slowest_rank:>12}"
+                f"  ({slow_count[slowest_rank]}/{rounds} rounds)")
+        else:
+            # unmatched across ranks (some rank never ran it): note only
+            total = sum(len(s) for s in seqs.values())
+            lines.append(f"{name:<18}  {cid_s:>3}  {0:>6}  "
+                         f"{'-':>14}  {'-':>13}  {'-':>12}  "
+                         f"({total} unmatched spans)")
+    lines += ["", "latency by log2 payload-size bin:",
+              "collective          bin      n     p50_us     p99_us"]
+    for (name, label), durs in sorted(bin_lat.items()):
+        durs.sort()
+        lines.append(
+            f"{name:<18}  {label:>5}  {len(durs):>5}  "
+            f"{_percentile(durs, 0.50):>9.1f}  {_percentile(durs, 0.99):>9.1f}")
+    return "\n".join(lines) + "\n"
+
+
+def reset_for_testing() -> None:
+    """Drop all tracer state and re-arm from the cvar (tests only)."""
+    global _ring, _ring_n, _slot, enabled
+    with _hist_lock:
+        _hist.clear()
+    _ring = None
+    _ring_n = 0
+    _slot = itertools.count()
+    enabled = False
+    _set_enabled(bool(_enable_var.value))

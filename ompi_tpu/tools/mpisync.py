@@ -16,22 +16,39 @@ import time
 import numpy as np
 
 
+def estimate_offset(exchange, iters: int = 10) -> tuple:
+    """Generic min-RTT clock-offset estimator (the mpigclock filter).
+
+    ``exchange()`` performs one round-trip and returns the peer's wall
+    timestamp; the peer's offset is ``theirs - (t_send + rtt/2)`` taken
+    at the round with the smallest RTT.  Returns ``(offset_s, rtt_s)``.
+    Shared with the trace exporter, which aligns every rank to the coord
+    server's clock through ``CoordClient.server_time``.
+    """
+    best_rtt, best_off = float("inf"), 0.0
+    for _ in range(iters):
+        t0 = time.time()
+        theirs = exchange()
+        t1 = time.time()
+        rtt = t1 - t0
+        if rtt < best_rtt:     # min-RTT filter, like the tool
+            best_rtt = rtt
+            best_off = float(theirs) - (t0 + rtt / 2)
+    return best_off, best_rtt
+
+
 def measure(comm, iters: int = 10) -> list:
     """Rank 0 returns [(rank, offset_s, rtt_s)] for every peer."""
     results = []
     if comm.rank == 0:
         for peer in range(1, comm.size):
-            best_rtt, best_off = float("inf"), 0.0
-            for _ in range(iters):
-                t0 = time.time()
-                comm.send(np.array([t0]), peer, tag=91)
+            def exchange(peer=peer):
+                comm.send(np.array([time.time()]), peer, tag=91)
                 buf = np.zeros(1)
                 comm.recv(buf, peer, tag=92)
-                t1 = time.time()
-                rtt = t1 - t0
-                if rtt < best_rtt:     # min-RTT filter, like the tool
-                    best_rtt = rtt
-                    best_off = float(buf[0]) - (t0 + rtt / 2)
+                return float(buf[0])
+
+            best_off, best_rtt = estimate_offset(exchange, iters)
             results.append((peer, best_off, best_rtt))
     else:
         for _ in range(iters):

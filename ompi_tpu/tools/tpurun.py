@@ -129,6 +129,47 @@ def _monitor(procs_list, rank_of, *, enable_recovery: bool, label: str,
     return exit_code
 
 
+def _merge_traces(server) -> None:
+    """otpu-trace gather: ranks publish their Chrome trace payloads into
+    the CoordServer KV space at finalize; the head aligns their clocks
+    (each payload carries the rank's measured offset to the coord clock,
+    the mpisync min-RTT estimate) and writes one merged timeline plus a
+    text skew report next to the per-rank files."""
+    import json
+
+    raw = server.collect("otpu_trace")
+    if not raw:
+        return
+    from ompi_tpu.runtime import trace
+
+    payloads = []
+    for rank in sorted(raw):
+        try:
+            payloads.append(json.loads(raw[rank]))
+        except (TypeError, ValueError):
+            print(f"tpurun: rank {rank} published an unreadable trace",
+                  file=sys.stderr)
+    if not payloads:
+        return
+    tdir = payloads[0].get("metadata", {}).get("trace_dir", "otpu-trace")
+    try:
+        os.makedirs(tdir, exist_ok=True)
+        merged_path = os.path.join(tdir, "trace_merged.json")
+        with open(merged_path, "w") as f:
+            json.dump({"traceEvents": trace.merge_timelines(payloads),
+                       "metadata": {"ranks": sorted(raw),
+                                    "clock": "coord-server"}}, f)
+        report_path = os.path.join(tdir, "trace_skew.txt")
+        report = trace.skew_report(payloads)
+        with open(report_path, "w") as f:
+            f.write(report)
+    except OSError as exc:
+        print(f"tpurun: cannot write merged trace: {exc}", file=sys.stderr)
+        return
+    print(f"tpurun: merged timeline of {len(payloads)} ranks -> "
+          f"{merged_path}; skew report -> {report_path}", file=sys.stderr)
+
+
 def _teardown(procs_list, pumps, exit_code: int) -> None:
     """Shared job teardown: kill survivors on failure (mpirun's
     kill-job-on-abort), drain cleanly on success, join the pumps."""
@@ -451,6 +492,7 @@ def main(argv=None) -> int:
         on_fail=publish_failed,
         abort_check=lambda: server.aborted)
     _teardown(procs, pumps, exit_code)
+    _merge_traces(server)
     server.close()
     if exit_code:
         print(f"tpurun: job terminated with exit code {exit_code}",

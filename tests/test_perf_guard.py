@@ -68,3 +68,76 @@ def test_allreduce_per_byte_cost_stays_linear(tmp_path):
     # that a loaded single-core CI host doesn't flake them
     assert big_t < 0.30, f"4MB allreduce took {big_t * 1e3:.0f}ms"
     assert small_t < 0.032, f"256KB allreduce took {small_t * 1e3:.1f}ms"
+
+
+_TRACE_PIN_SCRIPT = textwrap.dedent("""
+    import json, time
+    import numpy as np, ompi_tpu
+    from ompi_tpu.api import op as op_mod
+    from ompi_tpu.runtime import trace
+
+    w = ompi_tpu.init()
+    # conductor-world stacked layout: one 1KB row per hosted rank
+    x = np.ones((w.size, 256), np.float32)
+    wrapped = w.c_coll["allreduce"]          # trace wrapper (outermost)
+    inner = wrapped
+    while hasattr(inner, "__wrapped__"):
+        inner = inner.__wrapped__
+
+    def one(fn, n=2000):
+        for _ in range(100):
+            fn(w, x, op_mod.SUM)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn(w, x, op_mod.SUM)
+        return (time.perf_counter() - t0) / n
+
+    # paired, interleaved reps: host-load drift hits both callables in
+    # the same window instead of biasing whichever ran second
+    t_wrapped = t_direct = float("inf")
+    for rep in range(6):
+        if rep % 2:
+            a, b = one(inner), one(wrapped)
+        else:
+            b, a = one(wrapped), one(inner)
+        t_direct = min(t_direct, a)
+        t_wrapped = min(t_wrapped, b)
+    print("TRACEPIN " + json.dumps(
+        [t_wrapped, t_direct, trace.recorded_count(), len(trace.histograms())]))
+    ompi_tpu.finalize()
+""")
+
+
+def test_tracing_disabled_overhead_is_one_flag_check(tmp_path):
+    """The otpu-trace coll-table wrapper is installed unconditionally at
+    comm_select; with tracing disabled (the default) its cost on the
+    allreduce hot path must be one flag check — pinned as (a) zero
+    events/histograms recorded and (b) per-call overhead vs the
+    unwrapped slot within scheduling noise of the seed."""
+    script = tmp_path / "trace_pin.py"
+    script.write_text(_TRACE_PIN_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = next(ln for ln in r.stdout.splitlines() if "TRACEPIN" in ln)
+    t_wrapped, t_direct, recorded, hists = json.loads(
+        line.split("TRACEPIN ", 1)[1])
+    # the disabled path must not have recorded anything at all
+    assert recorded == 0, f"{recorded} events recorded while disabled"
+    assert hists == 0, f"{hists} histogram bins touched while disabled"
+    # the measured disabled-path cost is ~0.5us (flag check + argument
+    # forwarding).  The bound is absolute-or-relative: 4us of fixed
+    # headroom, widened to 30% of the direct call on hosts where the
+    # baseline itself is tens of us (scheduler noise scales with call
+    # time on the loaded 1-core CI VM).  Gross per-call work creeping
+    # into the disabled path still trips it, and the zero-records
+    # asserts above catch any accidental recording regardless of
+    # timing.
+    overhead = t_wrapped - t_direct
+    assert overhead < max(4e-6, 0.3 * t_direct), (
+        f"tracing-disabled wrapper costs {overhead * 1e9:.0f}ns/call "
+        f"(wrapped {t_wrapped * 1e6:.2f}us vs direct "
+        f"{t_direct * 1e6:.2f}us)")

@@ -1,0 +1,216 @@
+"""otpu-trace: disabled-path no-op, span/histogram correctness under
+concurrency, Chrome-JSON schema validity, and the tpurun gather/merge +
+skew report on a real multiprocess run."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.base.var import registry
+from ompi_tpu.runtime import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tracer():
+    """Enabled tracer with clean state; disabled + reset afterwards."""
+    registry.set("otpu_trace_enable", True)
+    trace.reset_for_testing()
+    yield trace
+    registry.set("otpu_trace_enable", False)
+    trace.reset_for_testing()
+
+
+class _FakeComm:
+    cid = 42
+
+    def __init__(self):
+        self.c_coll = {}
+
+
+def test_disabled_path_records_nothing():
+    registry.set("otpu_trace_enable", False)
+    trace.reset_for_testing()
+    before = trace.recorded_count()
+    trace.span("x", "coll", trace.now())
+    trace.instant("y", "ft")
+    assert trace.recorded_count() == before
+    assert trace.enabled is False
+
+    # the coll-table wrapper passes straight through and records nothing
+    comm = _FakeComm()
+    comm.c_coll["allreduce"] = lambda c, x: x * 2
+    trace.wrap_coll_table(comm)
+    out = comm.c_coll["allreduce"](comm, np.ones(4))
+    assert np.all(out == 2)
+    assert trace.histograms() == {}
+    assert trace.recorded_count() == 0
+
+
+def test_wrapper_records_span_and_histogram(tracer):
+    comm = _FakeComm()
+    comm.c_coll["allreduce"] = lambda c, x: x + 1
+    trace.wrap_coll_table(comm)
+    # double-wrap guard: wrapping again must not stack another layer
+    wrapped = comm.c_coll["allreduce"]
+    trace.wrap_coll_table(comm)
+    assert comm.c_coll["allreduce"] is wrapped
+
+    x = np.ones(1 << 12, np.float32)          # 16384 B -> "16k" bin
+    for _ in range(5):
+        comm.c_coll["allreduce"](comm, x)
+    hists = trace.histograms()
+    assert ("allreduce", "16k") in hists
+    count, sum_us, min_us, max_us = hists[("allreduce", "16k")]
+    assert count == 5
+    assert 0 <= min_us <= max_us
+    assert sum_us >= 5 * min_us
+    # the same data is live through the MPI_T pvar surface
+    pvs = {p.name: p for p in registry.all_pvars()}
+    assert pvs["otpu_trace_hist_allreduce_16k_count"].read() == 5
+    assert pvs["otpu_trace_hist_allreduce_16k_sum_us"].read() > 0
+    # spans landed in the ring with the comm's cid
+    spans = [e for e in trace.chrome_events() if e["name"] == "allreduce"]
+    assert len(spans) == 5
+    assert all(e["args"]["cid"] == 42 for e in spans)
+
+
+def test_concurrent_recording_is_consistent(tracer):
+    per_thread, nthreads = 500, 4
+
+    def worker(i):
+        for k in range(per_thread):
+            t0 = trace.now()
+            trace.span(f"op{i}", "coll", t0)
+            trace.hist_record("allreduce", 1024, 1000)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # histogram updates are exact (locked)
+    assert trace.histograms()[("allreduce", "1k")][0] == \
+        per_thread * nthreads
+    # every span got its own ring slot (atomic slot counter)
+    assert trace.recorded_count() == per_thread * nthreads
+    events = trace.chrome_events()
+    assert len(events) == per_thread * nthreads
+
+
+def test_ring_overwrites_oldest(tracer):
+    n = trace._ring_n
+    for i in range(n + 100):
+        trace.span(f"s{i}", "coll", trace.now())
+    events = trace.chrome_events()
+    assert len(events) == n
+    payload = trace.chrome_payload(0)
+    assert payload["metadata"]["events_overwritten"] == 100
+
+
+def test_chrome_json_schema(tracer):
+    t0 = trace.now()
+    trace.span("allreduce", "coll", t0, args={"nbytes": 64})
+    trace.instant("ft_detect", "ft", args={"rank": 1})
+    payload = trace.chrome_payload(3, clock_offset_us=12.5)
+    # must survive a JSON round-trip (what finalize writes to disk)
+    payload = json.loads(json.dumps(payload))
+    assert set(payload) == {"traceEvents", "metadata"}
+    meta = payload["metadata"]
+    assert meta["rank"] == 3
+    assert meta["clock_offset_us"] == 12.5
+    evs = payload["traceEvents"]
+    assert len(evs) == 2
+    for ev in evs:
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["ts"], float)
+        assert ev["pid"] == 3
+        assert isinstance(ev["tid"], int)
+        assert ev["name"] and ev["cat"]
+    x = [e for e in evs if e["ph"] == "X"][0]
+    assert x["dur"] >= 0
+    # events come out oldest-first
+    assert evs[0]["ts"] <= evs[1]["ts"]
+
+
+def _payload(rank, offset_us, spans):
+    return {
+        "traceEvents": [
+            {"ph": "X", "name": name, "cat": "coll", "ts": ts,
+             "dur": dur, "pid": rank, "tid": 1,
+             "args": {"nbytes": nbytes}}
+            for name, ts, dur, nbytes in spans],
+        "metadata": {"rank": rank, "clock_offset_us": offset_us},
+    }
+
+
+def test_merge_aligns_clocks_and_skew_names_slowest():
+    # rank 1's clock runs 1000us ahead of the coord clock; after merge
+    # both ranks' allreduces line up at ts=100
+    p0 = _payload(0, 0.0, [("allreduce", 100.0, 50.0, 1024)])
+    p1 = _payload(1, 1000.0, [("allreduce", 1100.0, 400.0, 1024)])
+    merged = trace.merge_timelines([p0, p1])
+    assert [e["ts"] for e in merged] == [100.0, 100.0]
+    assert sorted(e["pid"] for e in merged) == [0, 1]
+
+    report = trace.skew_report([p0, p1])
+    assert "allreduce" in report
+    # rank 1's 400us invocation is the straggler (columns: name cid
+    # rounds spread_mean spread_max slowest_rank)
+    line = next(ln for ln in report.splitlines()
+                if ln.startswith("allreduce"))
+    assert line.split()[5] == "1"
+    assert "p50_us" in report and "1k" in report
+
+
+def test_tpurun_trace_gather_merge_and_skew(tmp_path):
+    """4-rank end-to-end: per-rank Chrome JSON, merged timeline, skew
+    report — the full gather path through the CoordServer."""
+    script = tmp_path / "traced.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np, ompi_tpu, time
+        w = ompi_tpu.init()
+        for _ in range(4):
+            w.allreduce(np.ones(4096, np.float32))
+        if w.rank == w.size - 1:
+            time.sleep(0.02)          # deliberate straggler
+        w.barrier()
+        ompi_tpu.finalize()
+    """))
+    tdir = tmp_path / "traces"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "4",
+         "--mca", "trace_enable", "1", "--mca", "trace_dir", str(tdir),
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # per-rank Chrome traces
+    for rank in range(4):
+        p = json.load(open(tdir / f"trace_rank{rank}.json"))
+        assert p["metadata"]["rank"] == rank
+        colls = [e for e in p["traceEvents"] if e["cat"] == "coll"]
+        assert any(e["name"] == "allreduce" for e in colls)
+        assert all(e["pid"] == rank for e in p["traceEvents"])
+
+    # merged timeline: all four pids, time-sorted
+    merged = json.load(open(tdir / "trace_merged.json"))
+    evs = merged["traceEvents"]
+    assert sorted({e["pid"] for e in evs}) == [0, 1, 2, 3]
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+    # skew report names a slowest rank per collective
+    report = (tdir / "trace_skew.txt").read_text()
+    assert "allreduce" in report and "slowest_rank" in report
+    line = next(ln for ln in report.splitlines()
+                if ln.startswith("allreduce"))
+    assert int(line.split()[5]) in (0, 1, 2, 3)
