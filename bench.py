@@ -581,6 +581,87 @@ def host_rget_points() -> list:
     return rows
 
 
+_PART_PP = """
+import json, statistics, sys, time
+import numpy as np
+import ompi_tpu
+
+w = ompi_tpu.init()
+out = []
+for nbytes, parts in ((65536, 4), (1 << 20, 4), (1 << 20, 16)):
+    n = nbytes // 8
+    x = np.ones(n, np.float64)
+    y = np.empty(n, np.float64)
+    if w.rank == 0:
+        s = w.psend_init(x, parts, dest=1, tag=5)
+        r = w.precv_init(y, parts, source=1, tag=6)
+    else:
+        r = w.precv_init(y, parts, source=0, tag=5)
+        s = w.psend_init(x, parts, dest=0, tag=6)
+    def once():
+        if w.rank == 0:
+            s.start()
+            for p in range(parts):
+                s.pready(p)
+            s.wait()
+            r.start(); r.wait()
+        else:
+            r.start(); r.wait()
+            s.start()
+            for p in range(parts):
+                s.pready(p)
+            s.wait()
+    for _ in range(3):
+        once()
+    iters = 20 if nbytes <= 65536 else 8
+    lat = []
+    for _ in range(iters):
+        w.barrier()
+        t0 = time.perf_counter()
+        once()
+        lat.append(time.perf_counter() - t0)
+    out.append((nbytes, parts, statistics.median(lat)))
+if w.rank == 0:
+    print("PART_PP " + json.dumps(out))
+ompi_tpu.finalize()
+"""
+
+
+def host_part_points() -> list:
+    """MPI-4 partitioned ping-pong (mca/part/persist over pml/sm):
+    message size x partition count, full round trip per iteration.  The
+    partitions-vs-latency delta is the per-Pready framing cost; the
+    same size at 4 vs 16 partitions bounds the aggregation overhead."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_PART_PP)
+        script = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "2",
+             sys.executable, script],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if "PART_PP" in ln), None)
+        if proc.returncode or line is None:
+            print(f"partitioned pingpong bench failed "
+                  f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}",
+                  file=sys.stderr)
+            return [{"coll": "part_pingpong", "ok": False}]
+        pts = _json.loads(line.split("PART_PP ", 1)[1])
+        # round trip moves nbytes each way: bandwidth = 2*nbytes/t
+        return [{"coll": f"part_pingpong_{parts}p", "nbytes": nb,
+                 "fw_lat_us": round(t * 1e6, 1),
+                 "fw_bw_gbs": round(2 * nb / t / 1e9, 4)}
+                for nb, parts, t in pts]
+    finally:
+        os.unlink(script)
+
+
 _STAGING_OSU = """
 import json, statistics, sys, time
 import numpy as np
@@ -878,6 +959,10 @@ def host_rows() -> list:
         rows.extend(host_rget_points())
     except Exception as exc:
         print(f"rget bench failed: {exc}", file=sys.stderr)
+    try:
+        rows.extend(host_part_points())
+    except Exception as exc:
+        print(f"partitioned pingpong bench failed: {exc}", file=sys.stderr)
     try:
         rows.extend(host_staging_points())
     except Exception as exc:

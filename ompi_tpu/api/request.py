@@ -156,6 +156,29 @@ class Request:
     def free(self) -> None:
         self.state = RequestState.INACTIVE
 
+    # -- MPI-4 partitioned communication (``mca/part``) ------------------
+    # The partitioned request classes (part/persist PsendRequest /
+    # PrecvRequest, the coll pcoll request) override the relevant side;
+    # on any other request these calls are erroneous and must say so
+    # loudly instead of silently accepting.
+    def pready(self, partition) -> None:
+        raise MpiError(ErrorClass.ERR_REQUEST,
+                       "Pready on a non-partitioned request")
+
+    def pready_range(self, partition_low: int, partition_high: int) -> None:
+        """``MPI_Pready_range``: inclusive [low, high] like the standard."""
+        for p in range(int(partition_low), int(partition_high) + 1):
+            self.pready(p)
+
+    def pready_list(self, partitions) -> None:
+        """``MPI_Pready_list``."""
+        for p in partitions:
+            self.pready(p)
+
+    def parrived(self, partition) -> bool:
+        raise MpiError(ErrorClass.ERR_REQUEST,
+                       "Parrived on a non-partitioned request")
+
     def _raise_if_error(self) -> None:
         if self.error is not None:
             raise self.error

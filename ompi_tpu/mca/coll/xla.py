@@ -601,6 +601,15 @@ class XlaCollModule:
         fn, nbytes = self._cache[self._keyfor(coll, template, *args)]
         return PersistentColl(fn, coll, nbytes)
 
+    def partitioned_coll(self, comm, coll: str, buckets, *args):
+        """Device side of the partitioned persistent collective (MPI-4
+        ``Pallreduce_init`` analog, ``api/comm.py pallreduce_init``):
+        bind one pre-compiled program PER BUCKET so each ``Pready``
+        costs one SPC bump + one async XLA dispatch — bucket i's
+        reduction overlaps whatever is still computing bucket i+1."""
+        return [self.persistent_coll(comm, coll, b, *args)
+                for b in buckets]
+
     def _keyfor(self, coll: str, x, *args):
         """Single source of truth for program-cache keys (used by the
         *_array methods and persistent_coll alike).  Kept closure-free:

@@ -51,6 +51,15 @@ _zero1_var = registry.register(
          "rebuild via an exact masked psum — optimizer state memory "
          "drops by dp")
 
+_bucket_var = registry.register(
+    "parallel", None, "bucket_overlap", vtype=VarType.BOOL, default=False,
+    help="Bucketed dp-gradient sync (the mca/part Pready schedule "
+         "expressed in-jit): one psum per local-layer bucket issued "
+         "late-layer-first instead of one whole-tree psum, so XLA can "
+         "overlap each bucket's allreduce with work on other buckets — "
+         "bit-identical parameters to the single-psum path "
+         "(parallel/dryrun.py run_bucket_overlap_check pins it)")
+
 _momentum_var = registry.register(
     "parallel", None, "momentum", vtype=VarType.FLOAT, default=0.0,
     help="SGD momentum for the flagship step (state is dp-sharded "
@@ -185,7 +194,23 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
             "parallel_momentum is implemented by the ZeRO-1 sharded "
             "optimizer state — set --mca parallel_zero1 1 with it "
             "(a silently momentum-free run would corrupt comparisons)")
+    bucket_overlap = bool(_bucket_var.value)
+    if bucket_overlap and zero1:
+        raise ValueError(
+            "parallel_bucket_overlap buckets the dp ALLREDUCE; ZeRO-1 "
+            "already reduce-scatters the dp sum — the combination is "
+            "unsupported (a silent fallback would corrupt comparisons)")
     dp = spec.dp
+
+    def bucketed_dp_sync(g):
+        """Per-local-layer psum buckets, LATE layer first — the Pready
+        release order of a backward pass (the last layer's gradient is
+        finished first).  Elementwise psum over the same replica set
+        makes each bucket bit-identical to its slice of the whole-leaf
+        psum; jnp.stack restores the leaf."""
+        parts = [jax.lax.psum(g[i], ("dp", "sp"))
+                 for i in range(g.shape[0] - 1, -1, -1)]
+        return jnp.stack(parts[::-1], axis=0)
 
     def body(state, x):
         if zero1:
@@ -213,8 +238,9 @@ def build_train_step(mesh, spec: MeshSpec, lr: float = 1e-4,
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         if not zero1:
-            grads = jax.tree.map(
-                lambda g: jax.lax.psum(g, ("dp", "sp")), grads)
+            sync = bucketed_dp_sync if bucket_overlap else \
+                (lambda g: jax.lax.psum(g, ("dp", "sp")))
+            grads = jax.tree.map(sync, grads)
             if tp > 1:
                 grads["wr"] = jax.lax.psum(grads["wr"], "tp")
             new = jax.tree.map(lambda p, g: p - lr * g, params, grads)

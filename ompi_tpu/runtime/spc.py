@@ -12,6 +12,7 @@ _COUNTERS = (
     "bytes_sent", "bytes_received", "bytes_packed", "bytes_unpacked",
     "unexpected_msgs", "out_of_sequence_msgs", "matched_msgs",
     "rget_msgs", "striped_msgs",
+    "part_pready", "part_parrived", "part_msgs", "part_bytes",
     "device_collectives", "device_bytes",
 )
 

@@ -17,16 +17,23 @@ from __future__ import annotations
 import argparse
 import sys
 
-# the static component universe (the autogen.pl role: every framework the
-# build knows about, discovered via import so registration side-effects run)
-_FRAMEWORK_NAMES = ("pml", "bml", "btl", "coll", "osc", "io", "topo",
-                    "accelerator", "threads")
+def _framework_names() -> list:
+    """Every subpackage of ``ompi_tpu.mca`` is a framework (the
+    autogen.pl role) — scanned dynamically, not hand-listed: a static
+    tuple silently skipped any framework added after it was written
+    (mca/part, with its single default component, never showed up)."""
+    import pkgutil
+
+    import ompi_tpu.mca as mca_pkg
+
+    return sorted(info.name for info in pkgutil.iter_modules(mca_pkg.__path__)
+                  if info.ispkg)
 
 
 def _discover_all():
     from ompi_tpu.base import mca
 
-    for name in _FRAMEWORK_NAMES:
+    for name in _framework_names():
         fw = mca.framework(name, "")
         fw.discover()
         # register vars without requiring a full runtime init

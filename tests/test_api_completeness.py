@@ -328,6 +328,41 @@ def test_neighbor_v_variants_multiprocess(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_partitioned_communication(world):
+    """MPI-4 partitioned p2p (Psend_init/Precv_init/Pready/Pready_range/
+    Pready_list/Parrived — mca/part/persist); full coverage in
+    test_part.py."""
+    a, b = world.as_rank(0), world.as_rank(1)
+    x = np.arange(24.0)
+    y = np.zeros(24)
+    s = a.psend_init(x, 6, dest=1, tag=21)
+    r = b.precv_init(y, 4, source=0, tag=21)   # mismatched counts
+    from ompi_tpu.api.request import start_all
+
+    start_all([s, r])
+    s.pready(5)
+    s.pready_range(0, 1)
+    assert not r.parrived(2)
+    s.pready_list([3, 2, 4])
+    s.wait()
+    r.wait()
+    np.testing.assert_array_equal(y, x)
+    assert all(r.parrived(p) for p in range(4))
+
+
+def test_partitioned_collective_init(world):
+    """Pallreduce_init analog: bucketed persistent allreduce released
+    bucket-by-bucket with Pready."""
+    n = world.size
+    buckets = [np.full((n, 2), float(i), np.float64) for i in range(1, 4)]
+    req = world.pallreduce_init(buckets)
+    req.start()
+    req.pready_list([2, 0, 1])
+    req.wait()
+    for i, got in enumerate(req.result):
+        np.testing.assert_allclose(np.asarray(got), (i + 1) * n)
+
+
 def test_host_persistent_collective_and_ext_queries(tmp_path):
     """mpiext analogs: pcollreq on the host path (restartable persistent
     collective), MPIX_Get_affinity, MPIX_Query_cuda_support."""

@@ -11,6 +11,9 @@ reference's hardware-proven transport contract
 """
 import json
 import os
+import subprocess
+import sys
+import tempfile
 
 import pytest
 
@@ -18,15 +21,77 @@ pytestmark = pytest.mark.skipif(
     os.environ.get("OTPU_SKIP_AOT", "") not in ("", "0"),
     reason="AOT gate disabled by OTPU_SKIP_AOT")
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scrubbed_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p) or REPO
+    return env
+
+
+def _run_aot_subprocess() -> dict:
+    """Run the AOT gate in bounded subprocesses with a scrubbed env,
+    like bench.py's ``_pallas_aot_gate``: libtpu's PJRT plugin init can
+    hang on a site boot hook's pinned accelerator tunnel
+    (answer-then-stall mode), and an in-process call then stalls the
+    WHOLE tier-1 suite past its timeout — every test file sorting after
+    this one never runs.  A cheap probe pays for the stall detection
+    (the hang point is topology construction, not compilation), so a
+    dead tunnel costs ~90s and a skip; with a live plugin the full gate
+    runs with a compile-sized budget.  A real lowering failure still
+    fails loudly from the result file."""
+    env = _scrubbed_env()
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "from ompi_tpu.tools.pallas_aot import build_meshes; "
+             "build_meshes()"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+    except subprocess.TimeoutExpired:
+        pytest.skip("pallas AOT gate stalled building the offline "
+                    "topology (accelerator plugin unresponsive) — "
+                    "compile contract not measurable")
+    if probe.returncode:
+        pytest.skip("offline AOT topology unavailable: "
+                    f"{probe.stderr.strip().splitlines()[-1:]!r}")
+    out = os.path.join(tempfile.mkdtemp(prefix="otpu_aot_"),
+                       "pallas_aot.json")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.pallas_aot",
+             "--out", out],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=240)
+    except subprocess.TimeoutExpired:
+        # on a healthy host the 31 compiles finish well inside this; a
+        # degraded plugin can also stall MID-compile, and tier-1's
+        # overall budget cannot absorb an unbounded wait
+        pytest.skip("pallas AOT gate exceeded its tier-1 budget "
+                    "(degraded accelerator plugin) — compile contract "
+                    "not measurable")
+    if proc.returncode not in (0, 1) or not os.path.exists(out):
+        raise RuntimeError(
+            f"pallas_aot gate crashed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-1500:]}")
+    with open(out) as f:
+        return json.load(f)
+
 
 def test_all_kernels_aot_compile():
     try:
         import libtpu  # noqa: F401
     except ImportError:
         pytest.skip("libtpu not installed — no offline Mosaic compiler")
-    from ompi_tpu.tools import pallas_aot
 
-    res = pallas_aot.run(verbose=False)
+    res = _run_aot_subprocess()
+    if not res.get("rows") and res.get("error"):
+        # the gate never reached compilation (offline topology/plugin
+        # unavailable) — an environment outage, not a lowering failure
+        pytest.skip(f"AOT topology unavailable: {res['error'][:160]}")
     bad = [r for r in res["rows"] if not r.get("compiled")]
     assert res["rows"], "AOT produced no kernel rows"
     assert not bad, (
