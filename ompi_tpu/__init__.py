@@ -39,6 +39,7 @@ _API = {
     "COMM_SELF": "ompi_tpu.runtime.init",
     "Comm": "ompi_tpu.api.comm",
     "Group": "ompi_tpu.api.group",
+    "Session": "ompi_tpu.api.session",
     "Request": "ompi_tpu.api.request",
     "Datatype": "ompi_tpu.datatype",
     "Op": "ompi_tpu.api.op",

@@ -93,6 +93,30 @@ def live_comms() -> list:
     return sorted(_live_comms or [], key=lambda c: (c.cid, c.epoch))
 
 
+#: per-(members, tag) invocation counters for the sessions-model CID
+#: bootstrap: create_from_group is collective over the group, so every
+#: member's N-th call with the same (members, tag) pairs up — the count
+#: keys successive agreements apart without any pre-existing channel
+_group_cid_seq: dict = {}
+_group_cid_lock = threading.Lock()
+
+
+def _agree_group_cid(client, group, tag: str) -> int:
+    """Coord-assisted CID agreement for parent-less construction: first
+    member through publishes a bridge-range CID (globally unique, so no
+    per-member freeness confirmation is needed) via atomic
+    put-if-absent; every member adopts the winner."""
+    base = (tuple(group.world_ranks), str(tag))
+    with _group_cid_lock:
+        seq = _group_cid_seq.get(base, 0)
+        _group_cid_seq[base] = seq + 1
+    from ompi_tpu import dpm
+
+    proposed = dpm._new_bridge_cid(client)
+    key = f"__group_cid__:{base!r}:{seq}"
+    return int(client.put_new(-1, key, proposed))
+
+
 class Comm(AttributeHost):
     _cid_lock = threading.Lock()
 
@@ -807,6 +831,105 @@ class Comm(AttributeHost):
                 return agreed
             floor = agreed + 1
 
+    # -- sessions-model construction (MPI-4, ``ompi/communicator``
+    # ``ompi_comm_create_from_group`` / ``ompi_intercomm_create_from_groups``)
+    @classmethod
+    def create_from_group(cls, group: Group, tag: str = "",
+                          info: Optional[Info] = None,
+                          errhandler=None, name: str = "") -> Optional["Comm"]:
+        """``MPI_Comm_create_from_group``: a communicator from a bare
+        group — NO parent communicator, NO MPI_Init required; the active
+        instance (opened by a Session or by world init) supplies the pml
+        and the CID machinery.  Collective over the group's members;
+        ``tag`` disambiguates concurrent creations from overlapping
+        groups (MPI-4's string tag).
+
+        CID path: the classic agreement needs a communicator to run
+        over, which is exactly what doesn't exist yet — the reference
+        solves the bootstrap with a PMIx-assisted exchange; here the
+        coord service plays PMIx: the first member through publishes a
+        CID drawn from the globally-unique bridge range under an
+        atomic put-if-absent keyed by (members, tag, invocation), and
+        everyone adopts the winner.  Single-process instances (device
+        world / singleton) allocate locally.
+        """
+        from ompi_tpu import instance as inst_mod
+        from ompi_tpu.runtime import init as rt
+
+        inst = inst_mod.current()
+        if inst is None:
+            raise MpiError(
+                ErrorClass.ERR_SESSION,
+                "no active instance: open a Session (Session.init) or "
+                "call init() before create_from_group")
+        rte = inst.rte
+        if not rte.is_device_world and \
+                group.rank_of(rte.my_world_rank) < 0:
+            return None   # not a member (the conductor hosts every rank)
+        client = getattr(rte, "client", None)
+        if client is None or rte.is_device_world:
+            cid = rt.next_local_cid()
+        else:
+            cid = _agree_group_cid(client, group, tag)
+            rt.reserve_cid(cid)
+        newcomm = cls(group, cid, rte,
+                      name=name or f"from_group~{tag or cid}")
+        if info is not None:
+            newcomm.info = info.dup()
+        if errhandler is not None:
+            newcomm.errhandler = errhandler
+        cls._wire_new_comm(newcomm, inst.pml)
+        return newcomm
+
+    @classmethod
+    def create_intercomm_from_groups(cls, local_group: Group,
+                                     local_leader: int,
+                                     remote_group: Group,
+                                     remote_leader: int, tag: str = "",
+                                     info: Optional[Info] = None,
+                                     errhandler=None) -> Optional["Comm"]:
+        """``MPI_Intercomm_create_from_groups``: an intercommunicator
+        from two disjoint groups with no parent and no bridge comm.
+        The local intracomm (the collective channel every intercomm
+        carries) is built first via :meth:`create_from_group`; the
+        bridge CID is agreed through the coord service under a key both
+        sides derive identically from the UNION of the groups + tag."""
+        from ompi_tpu import instance as inst_mod
+        from ompi_tpu.runtime import init as rt
+
+        inst = inst_mod.current()
+        if inst is None:
+            raise MpiError(
+                ErrorClass.ERR_SESSION,
+                "no active instance: open a Session (Session.init) or "
+                "call init() before create_intercomm_from_groups")
+        rte = inst.rte
+        overlap = set(local_group.world_ranks) & \
+            set(remote_group.world_ranks)
+        if overlap:
+            raise MpiError(ErrorClass.ERR_GROUP,
+                           f"groups overlap on ranks {sorted(overlap)}")
+        local = cls.create_from_group(local_group, tag=f"{tag}//local",
+                                      info=info)
+        if local is None:
+            return None
+        client = getattr(rte, "client", None)
+        if client is None or rte.is_device_world:
+            cid = rt.next_local_cid()
+        else:
+            union = Group(sorted(set(local_group.world_ranks)
+                                 | set(remote_group.world_ranks)))
+            cid = _agree_group_cid(client, union, f"{tag}//inter")
+            rt.reserve_cid(cid)
+        inter = cls(local_group, cid, rte,
+                    name=f"from_groups~{tag or cid}",
+                    remote_group=remote_group)
+        if errhandler is not None:
+            inter.errhandler = errhandler
+        inter.local_comm = local
+        local._finish_create(inter)
+        return inter
+
     # comm_compare results (``mpi.h`` MPI_IDENT family)
     IDENT = 0
     CONGRUENT = 1
@@ -968,15 +1091,21 @@ class Comm(AttributeHost):
                 return agreed
             floor = agreed + 1
 
-    def _finish_create(self, newcomm: "Comm") -> None:
+    @staticmethod
+    def _wire_new_comm(newcomm: "Comm", pml) -> None:
+        """The one post-construction wiring sequence every new comm gets
+        (parented or sessions-model alike): pml attach + coll selection."""
         from ompi_tpu.mca.coll.base import comm_select
 
-        newcomm.pml = self.pml
-        if newcomm.pml is not None:
-            add = getattr(newcomm.pml, "add_comm", None)
+        newcomm.pml = pml
+        if pml is not None:
+            add = getattr(pml, "add_comm", None)
             if add is not None:
                 add(newcomm)
         comm_select(newcomm)
+
+    def _finish_create(self, newcomm: "Comm") -> None:
+        Comm._wire_new_comm(newcomm, self.pml)
 
     def topo_test(self) -> str:
         """``MPI_Topo_test``: "cart" | "graph" | "dist_graph" |

@@ -51,6 +51,7 @@ class ErrorClass(enum.IntEnum):
     ERR_PORT = 37
     ERR_SERVICE = 38
     ERR_NAME = 39
+    ERR_SESSION = 40
     # ULFM fault-tolerance classes
     ERR_PROC_FAILED = 75
     ERR_PROC_FAILED_PENDING = 76

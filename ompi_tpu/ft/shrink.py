@@ -65,6 +65,18 @@ def shrink(comm):
                    name=f"{comm.name}~shrink", epoch=comm.epoch + 1,
                    parent=comm)
     comm._finish_create(newcomm)
+    # dynamic pset: publish the agreed surviving set under a stable name
+    # so the recovery loop (or a fresh session) can rebuild from it by
+    # name — Group_from_session_pset + Comm_create_from_group instead of
+    # threading the survivor list through application state.  Every
+    # survivor publishes the same agreed value; the write is idempotent.
+    client = getattr(comm.rte, "client", None)
+    if client is not None:
+        try:
+            client.pset_publish(f"mpi://shrunk/{cid}", survivors,
+                                source="dynamic")
+        except Exception:
+            pass   # coord gone: shrink itself already succeeded
     return newcomm
 
 

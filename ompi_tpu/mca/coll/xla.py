@@ -648,6 +648,190 @@ class XlaCollModule:
         self.device_barrier(comm)
 
 
+class XlaMpCollModule:
+    """coll/xla for the MULTI-PROCESS device world: the communicator's
+    ranks are processes of a ``jax.distributed``-booted job, and one
+    compiled program spans every member's devices (the cross-process
+    collectives VERDICT round 5 named as the PMIx-shaped hole).
+
+    Data model (multi-controller SPMD — the inverse of the conductor
+    model's stacked rows): every member calls the same collective with
+    ITS OWN local contribution, no leading rank axis.  The module builds
+    a global array whose leading axis is the comm-rank axis — row i
+    lives on member i's devices, replicated across that member's local
+    shards — and dispatches a jitted ``shard_map`` over a (members ×
+    local-devices) mesh that every member executes.  Results of
+    allreduce/bcast/allgather are replicated (fully addressable on
+    every member); reduce_scatter returns the rank-sharded global array
+    (my block is my addressable shard).
+
+    Same hot-path discipline as :class:`XlaCollModule`: compiled
+    programs cached per (coll, op/root, shape, dtype); a hit is one
+    dict probe + relaxed SPC bump + the per-call row placement + the
+    XLA dispatch.
+    """
+
+    def __init__(self, comm, rte, axis_name: str = "mpi") -> None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        procs = [rte.device_world_process(w)
+                 for w in comm.group.world_ranks]
+        by_proc: dict = {}
+        for d in rte.global_devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        rows = [by_proc[p] for p in procs]   # KeyError -> not selectable
+        width = min(len(r) for r in rows)
+        if width < 1 or any(len(r) != width for r in rows):
+            raise MpiError(ErrorClass.ERR_UNSUPPORTED_OPERATION,
+                           "uneven per-process device counts")
+        self.n = len(procs)
+        self.axis = axis_name
+        self.mesh = Mesh(np.array([r[:width] for r in rows]),
+                         (axis_name, "device"))
+        self._P = P
+        self._row_sharding = NamedSharding(self.mesh, P(axis_name))
+        self._cache: dict = {}
+        self._lock = threading.Lock()
+
+    # -- helpers ---------------------------------------------------------
+    def make_world_array(self, local):
+        """Global (n, *S) array from this member's local contribution:
+        my row on my devices (replicated across local shards), every
+        other row on its owner's devices."""
+        import jax
+
+        arr = np.asarray(local)
+        return jax.make_array_from_process_local_data(
+            self._row_sharding, arr[None], (self.n,) + arr.shape)
+
+    def _shard_map(self, fn, in_specs, out_specs):
+        import jax
+
+        from ompi_tpu.base.jaxenv import shard_map
+
+        return jax.jit(shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+    def _reduce_body(self, op: op_mod.Op):
+        import jax
+
+        ax = self.axis
+        if op.jax_reduce == "psum":
+            return lambda t: jax.lax.psum(t, ax)
+        if op.jax_reduce == "pmax":
+            return lambda t: jax.lax.pmax(t, ax)
+        if op.jax_reduce == "pmin":
+            return lambda t: jax.lax.pmin(t, ax)
+
+        def body(t):
+            gathered = jax.lax.all_gather(t, ax)      # (n, *S)
+            fold = op_mod.jax_fold(op, t.dtype)
+            acc = gathered[0]
+            for i in range(1, self.n):
+                acc = fold(gathered[i], acc)
+            return acc
+
+        return body
+
+    def _get(self, key, builder):
+        entry = self._cache.get(key)
+        if entry is None:
+            with self._lock:
+                entry = self._cache.get(key)
+                if entry is None:
+                    entry = self._cache[key] = builder()
+        return entry
+
+    # -- collective slots ------------------------------------------------
+    def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        xg = self.make_world_array(x)
+        P = self._P
+        fn = self._get(
+            ("allreduce", op.name, xg.shape, str(xg.dtype)),
+            lambda: self._shard_map(
+                lambda t: self._reduce_body(op)(t[0]),
+                P(self.axis), P()))
+        spc.bump_device(xg.nbytes)
+        return fn(xg)
+
+    def bcast_array(self, comm, x, root: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        xg = self.make_world_array(x)
+        P = self._P
+        ax = self.axis
+
+        def body(t):   # mask + psum: one ring phase, replicated result
+            contrib = jnp.where(jax.lax.axis_index(ax) == root,
+                                t[0], jnp.zeros_like(t[0]))
+            return jax.lax.psum(contrib, ax)
+
+        fn = self._get(
+            ("bcast", int(root), xg.shape, str(xg.dtype)),
+            lambda: self._shard_map(body, P(ax), P()))
+        spc.bump_device(xg.nbytes)
+        return fn(xg)
+
+    def allgather_array(self, comm, x):
+        import jax
+
+        xg = self.make_world_array(x)
+        P = self._P
+        fn = self._get(
+            ("allgather", xg.shape, str(xg.dtype)),
+            lambda: self._shard_map(
+                lambda t: jax.lax.all_gather(t[0], self.axis),
+                P(self.axis), P()))
+        spc.bump_device(xg.nbytes)
+        return fn(xg)
+
+    def reduce_scatter_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        """Each member contributes (n, *S); the result is the global
+        (n, *S) array sharded over members — my reduced block is my
+        addressable shard."""
+        import jax
+
+        arr = np.asarray(x)
+        if arr.ndim < 1 or arr.shape[0] != self.n:
+            raise MpiError(
+                ErrorClass.ERR_BUFFER,
+                f"reduce_scatter needs a leading rank axis {self.n}, "
+                f"got shape {arr.shape}")
+        xg = self.make_world_array(arr)     # (n, n, *S)
+        P = self._P
+
+        if op.jax_reduce == "psum":
+            def body(t):
+                return jax.lax.psum_scatter(
+                    t[0], self.axis, scatter_dimension=0,
+                    tiled=False)[None]
+        else:
+            reduce_body = self._reduce_body(op)
+
+            def body(t):
+                full = reduce_body(t[0])
+                i = jax.lax.axis_index(self.axis)
+                return jax.lax.dynamic_index_in_dim(full, i, 0)
+
+        fn = self._get(
+            ("reduce_scatter", op.name, xg.shape, str(xg.dtype)),
+            lambda: self._shard_map(body, P(self.axis), P(self.axis)))
+        spc.bump_device(xg.nbytes)
+        return fn(xg)
+
+    def psum_scatter_array(self, comm, x):
+        return self.reduce_scatter_array(comm, x, op_mod.SUM)
+
+    def device_barrier(self, comm) -> None:
+        import jax
+
+        tok = self.allreduce_array(
+            comm, np.zeros(1, np.float32), op_mod.SUM)
+        jax.block_until_ready(tok)
+
+
 class XlaCollComponent(Component):
     name = "xla"
     priority = 90
@@ -670,8 +854,22 @@ class XlaCollComponent(Component):
 
     def comm_query(self, comm):
         rte = comm.rte
-        if rte is None or not rte.is_device_world:
+        if rte is None:
             return None
+        if not rte.is_device_world:
+            # multi-process device world: comm ranks are processes of a
+            # jax.distributed-booted job — select the cross-process
+            # module (host colls keep their own slots; this only fills
+            # the *_array entry points)
+            if not getattr(rte, "device_world_booted", False):
+                return None
+            if comm.is_inter:
+                return None
+            try:
+                module = XlaMpCollModule(comm, rte, self._axis.value)
+            except Exception:
+                return None
+            return self._prio.value, module
         try:
             devices = [rte.device_of(r) for r in comm.group.world_ranks]
         except Exception:

@@ -93,6 +93,34 @@ def run_bucket_overlap_check(devices, spec=None) -> None:
           "bit-identical")
 
 
+def run_mp_training_step(spec_text: str = "") -> float:
+    """Multi-process dryrun body: one flagship train step over the
+    GLOBAL device mesh of a ``tpurun --device-world`` job.
+
+    Runs inside each rank: ``init()`` boots the instance, whose
+    device-world wire-up ran ``jax.distributed.initialize`` (coordinator
+    address from the coord service), so ``jax.devices()`` spans every
+    process — the train step's psums cross real process boundaries.
+    """
+    import jax
+
+    import ompi_tpu
+
+    w = ompi_tpu.init()
+    rte = w.rte
+    if not getattr(rte, "device_world_booted", False):
+        raise RuntimeError(
+            "device world did not boot (launch with tpurun --device-world)")
+    if jax.process_count() < 2:
+        raise RuntimeError(
+            f"expected a multi-process device world, got "
+            f"{jax.process_count()} process(es)")
+    loss = _one_descending_step(
+        jax.devices(), parse_spec(spec_text) if spec_text else None)
+    ompi_tpu.finalize()
+    return loss
+
+
 def _one_descending_step(devices, spec) -> float:
     import jax
 

@@ -58,6 +58,11 @@ class CoordServer:
         self._event_cond = threading.Condition()
         self._aborted: Optional[int] = None
         self._failed: set[int] = set()
+        # process-set registry (MPI-4 psets; the PMIx_Get PMIX_PSET_NAMES
+        # role): name -> {"members": [ranks], "source": str}.  The
+        # launcher publishes mpi://WORLD / per-host / user sets at job
+        # start; spawn and failure events update dynamic sets.
+        self._psets: dict[str, dict] = {}
         self._fence_expect: dict[str, tuple] = {}
         self._fence_done: set[str] = set()
         self._next_rank = nprocs          # global rank allocator (dpm spawn)
@@ -212,10 +217,28 @@ class CoordServer:
                         self._spawn_handler(
                             req["cmd"], ranks, job,
                             req.get("env") or {})
+                        # dynamic pset: the new job is addressable by
+                        # name before it builds any communicator
+                        self.publish_pset(f"mpi://job/{job}", ranks,
+                                          source="spawn")
                         _send_frame(conn, {"ok": True, "ranks": ranks,
                                            "job": job})
                     except Exception as exc:
                         _send_frame(conn, {"ok": False, "error": str(exc)})
+                elif op == "pset_pub":
+                    self.publish_pset(req["name"], req["members"],
+                                      req.get("source", "user"))
+                    _send_frame(conn, {"ok": True})
+                elif op == "pset_list":
+                    with self._kv_cond:
+                        rows = [{"name": n, "size": len(e["members"]),
+                                 "source": e["source"]}
+                                for n, e in sorted(self._psets.items())]
+                    _send_frame(conn, {"ok": True, "psets": rows})
+                elif op == "pset_get":
+                    with self._kv_cond:
+                        entry = self._psets.get(req["name"])
+                    _send_frame(conn, {"ok": True, "pset": entry})
                 elif op == "ping":
                     # "time" is the server's wall clock: ranks estimate
                     # their offset to it (min-RTT, mpisync estimator) so
@@ -252,15 +275,41 @@ class CoordServer:
         ``fn(cmd, global_ranks, job_id, extra_env)``."""
         self._spawn_handler = fn
 
+    def publish_pset(self, name: str, members, source: str = "launcher") -> None:
+        """(Re)publish a named process set — launcher-side at job start,
+        server-side for dynamic sets (spawn/failure)."""
+        with self._kv_cond:
+            self._psets[str(name)] = {
+                "members": [int(m) for m in members],
+                "source": str(source)}
+            self._kv_cond.notify_all()
+
+    def kv_put(self, rank: int, key: str, value: Any) -> None:
+        """Launcher-side KV injection (e.g. the jax coordinator address
+        ranks fetch before their first backend touch)."""
+        with self._kv_cond:
+            self._kv[(rank, key)] = value
+            self._kv_cond.notify_all()
+
     def publish(self, name: str, payload: Any) -> None:
         """Server-side event injection (launcher-detected failures)."""
         if name == "proc_failed":
             with self._fence_cond:
                 self._failed.add(int(payload["rank"]))
+                failed_now = set(self._failed)
                 # a pending fence may now be satisfiable by the survivors
                 for fid in list(self._fence_ranks):
                     if self._fence_ranks[fid] and self._fence_satisfied(fid):
                         self._complete_fence(fid)
+            # dynamic pset: the named surviving set the ULFM recovery
+            # loop rebuilds from (world minus every known failure)
+            with self._kv_cond:
+                world = self._psets.get("mpi://WORLD", {}).get(
+                    "members", list(range(self.nprocs)))
+            self.publish_pset(
+                "mpi://surviving",
+                [r for r in world if r not in failed_now],
+                source="dynamic")
         with self._event_cond:
             self._event_seq += 1
             self._events.append((self._event_seq, name, payload))
@@ -341,6 +390,19 @@ class CoordClient:
             timeout: float = 60.0) -> Any:
         return self._rpc(op="get", rank=rank, key=key, wait=wait,
                          timeout=timeout)["value"]
+
+    def pset_publish(self, name: str, members, source: str = "user") -> None:
+        """Publish/replace a named process set (dynamic psets)."""
+        self._rpc(op="pset_pub", name=name, members=[int(m) for m in members],
+                  source=source)
+
+    def pset_list(self) -> list:
+        """[{name, size, source}] of every advertised process set."""
+        return self._rpc(op="pset_list")["psets"]
+
+    def pset_get(self, name: str) -> Optional[dict]:
+        """{members, source} of a named pset, or None when unknown."""
+        return self._rpc(op="pset_get", name=name)["pset"]
 
     def spawn(self, cmd: list, n: int, env: Optional[dict] = None) -> tuple:
         """Ask the launcher to start ``n`` new ranks; returns

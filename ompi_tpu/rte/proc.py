@@ -20,6 +20,18 @@ from ompi_tpu.rte.coord import CoordClient
 class ProcRte(Rte):
     is_device_world = False
 
+    #: multi-process device world (set by the instance layer when it
+    #: boots jax.distributed): the global device list spans every
+    #: process of the job, local_devices are this process's shards
+    device_world_booted = False
+    global_devices = None
+    local_devices = None
+
+    def device_world_process(self, world_rank: int) -> int:
+        """jax process index of a world rank — the ``process_id`` map
+        used at ``jax.distributed.initialize`` (job-local position)."""
+        return self.job_ranks.index(int(world_rank))
+
     def __init__(self) -> None:
         self.my_world_rank = int(os.environ["OTPU_RANK"])
         self.world_size = int(os.environ["OTPU_NPROCS"])

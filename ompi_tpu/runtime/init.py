@@ -1,8 +1,19 @@
 """Init/finalize state machine (``ompi/runtime/ompi_mpi_init.c:391`` flow).
 
-Order mirrors the reference: base/var init → RTE init (PMIx equivalent) →
-pml selection → modex fence → world/self construction → per-comm coll
-selection (``ompi_mpi_init.c:449-962``).
+World-model ``MPI_Init`` is now "acquire the default instance": the
+RTE boot (base/var init → RTE/PMIx wire-up → pml selection → modex
+fence) lives in :mod:`ompi_tpu.instance` and is shared, refcounted, with
+MPI-4 Sessions — N open sessions plus world init boot the runtime ONCE,
+and the last release finalizes it (``ompi_mpi_instance_init`` /
+``_finalize`` in OMPI 5.x).  This module owns what remains world-model
+specific: WORLD/SELF construction, per-comm coll selection
+(``ompi_mpi_init.c:449-962``), the FT event poller, and the CID space.
+
+Because the instance refcount — not this state machine — now gates the
+real teardown, ``MPI_Init`` after ``MPI_Finalize`` is legal (the MPI-4
+relaxation): finalize returns the state machine to ground when its
+reference is dropped, and the next init boots (or re-joins) the
+instance fresh.
 """
 from __future__ import annotations
 
@@ -12,9 +23,8 @@ import sys
 import threading
 from typing import Optional
 
-from ompi_tpu.base import mca
 from ompi_tpu.base.containers import Bitmap
-from ompi_tpu.base.var import VarType, mark_runtime_initialized, registry
+from ompi_tpu.base.var import VarType, registry
 
 
 class State(enum.IntEnum):
@@ -32,6 +42,7 @@ _self = None
 _rte = None
 _cid_map = Bitmap(64)
 _cid_lock = threading.Lock()
+_atexit_armed = False
 
 
 def initialized() -> bool:
@@ -94,154 +105,116 @@ def retire_cid(cid: int) -> None:
     # the bit simply stays set; the function records intent at call sites
 
 
+def clear_cid_space() -> None:
+    """Reset the CID bitmap — called by the instance layer at LAST
+    release (the CID space is instance-scoped: session-built comms and
+    world comms share it, so neither may clear it alone)."""
+    with _cid_lock:
+        _cid_map.clear_all()
+
+
 # -- init / finalize ----------------------------------------------------
 
 def init(devices=None, rte=None, argv: Optional[list] = None):
     """Initialize the runtime; idempotent (returns COMM_WORLD)."""
-    global _state, _world, _self, _rte
+    global _state, _world, _self, _rte, _atexit_armed
     with _lock:
         if _state is State.INIT_COMPLETED:
             return _world
-        if _state is State.FINALIZE_STARTED or _state is State.FINALIZE_COMPLETED:
-            raise RuntimeError("cannot re-init after finalize")
+        if _state is State.FINALIZE_STARTED:
+            raise RuntimeError("cannot init while finalize is running")
+        # FINALIZE_COMPLETED falls through: MPI-4 allows init → finalize
+        # → init (the instance layer decides whether a real re-boot is
+        # needed or an open session kept the runtime alive)
         _state = State.INIT_STARTED
 
-        if argv:
-            registry.parse_cli(argv)
+        from ompi_tpu import instance as inst_mod
 
-        # RTE wire-up (ompi_mpi_init.c:516 → PMIx_Init equivalent)
-        from ompi_tpu.rte import base as rte_base
+        inst = inst_mod.acquire(argv=argv, devices=devices, rte=rte)
+        try:
+            return _build_world(inst)
+        except BaseException:
+            # failed world construction must not leak the instance
+            # reference (a later retry would double-acquire and the
+            # matching finalize could then never reach teardown)
+            inst_mod.release()
+            _world = _self = _rte = None
+            _state = State.NOT_INITIALIZED
+            raise
 
-        if rte is not None:
-            _rte = rte
-        elif devices is not None:
-            _rte = rte_base.DeviceWorldRte(devices)
-        else:
-            _rte = rte_base.detect()
 
-        # SPC counters
-        from ompi_tpu.runtime import spc
+def _build_world(inst):
+    """World-model construction on an acquired instance (the body of
+    ``init()`` after the boot; caller holds ``_lock``)."""
+    global _state, _world, _self, _rte, _atexit_armed
+    _rte = inst.rte
+    pml_module = inst.pml
 
-        spc.init()
+    # world/self communicators (ompi_mpi_init.c:779)
+    from ompi_tpu.api.comm import Comm
+    from ompi_tpu.api.group import Group
 
-        # otpu-trace (span ring buffer + latency-histogram pvars); the
-        # enable cvar was applied at registration from env/file and again
-        # from the CLI parse above
-        from ompi_tpu.runtime import trace
+    # a dpm-spawned job's COMM_WORLD is its own rank set (global ranks
+    # allocated by the coord server), not 0..size-1
+    world_group = Group(getattr(_rte, "job_ranks",
+                                range(_rte.world_size)))
+    _world = Comm(world_group, cid=0, rte=_rte, name="COMM_WORLD")
+    reserve_cid(0)
+    my = _rte.my_world_rank
+    _self = Comm(Group([my]), cid=1, rte=_rte, name="COMM_SELF")
+    reserve_cid(1)
+    _world.pml = pml_module
+    _self.pml = pml_module
+    pml_module.add_comm(_world)
+    pml_module.add_comm(_self)
 
-        trace.init()
+    # eager add_procs: build every peer's endpoint list NOW, while the
+    # modex is guaranteed reachable (the reference does this at
+    # ompi_mpi_init.c:833 — BML endpoint lists are an init product,
+    # not a first-send side effect; the FT detector's p2p carrier
+    # depends on endpoints surviving a later coord death)
+    inner = pml_module
+    while inner is not None and not hasattr(inner, "bml"):
+        inner = getattr(inner, "_inner", None)
+    bml = getattr(inner, "bml", None) if inner is not None else None
+    if bml is not None and not _rte.is_device_world:
+        for wr in _world.group.world_ranks:
+            if wr != _rte.my_world_rank:
+                try:
+                    bml.add_proc(wr)
+                except Exception:
+                    pass   # peer reachable lazily or not at all
 
-        # a re-init after a prior finalize may use the work pool again
-        from ompi_tpu.mca.threads import base as _threads_reopen
+    # per-comm coll selection (ompi_mpi_init.c:956,962)
+    from ompi_tpu.mca.coll.base import comm_select
 
-        _threads_reopen.reopen_pool()
+    comm_select(_world)
+    comm_select(_self)
 
-        # record the initializing thread (MPI_Is_thread_main anchor —
-        # overrides any earlier library register() from a worker thread)
-        from ompi_tpu.runtime import interlib
+    # ULFM FT runtime: event poller + optional heartbeat ring
+    # (PMIX_ERR_PROC_ABORTED handler registration, ompi_mpi_init.c:400-402)
+    _ft_enable = registry.register(
+        "ft", None, "enable", vtype=VarType.BOOL, default=True,
+        help="Start the FT event poller (failure/revocation delivery)")
+    _ft_detector = registry.register(
+        "ft", None, "detector", vtype=VarType.BOOL, default=False,
+        help="Start the heartbeat ring failure detector")
+    if not _rte.is_device_world and getattr(_rte, "client", None) is not None:
+        if _ft_enable.value:
+            from ompi_tpu.ft import propagator
 
-        interlib.note_main_thread(force=True)
+            propagator.start(_rte, with_detector=bool(_ft_detector.value))
 
-        # CPU binding + topology modex (hwloc analog; the reference does
-        # binding in PRRTE pre-exec, we do it first thing in init)
-        import os as _os
+    # hook framework: post-init interposition (hook/comm_method dump)
+    from ompi_tpu.mca.hook import run_hooks
 
-        from ompi_tpu.base import hwloc
+    run_hooks("init", _world)
 
-        if _os.environ.get("OTPU_BIND_POLICY") == "core" and \
-                hasattr(_rte, "my_world_rank"):
-            local_n = int(_os.environ.get("OTPU_LOCAL_NRANKS", "1"))
-            cpus = hwloc.compute_binding(
-                _rte.my_world_rank % max(1, local_n), max(1, local_n))
-            hwloc.bind_self(cpus)
-        if hasattr(_rte, "modex_put"):
-            topo = hwloc.host_topology(refresh=True)
-            _rte.modex_put("cpus", list(topo.cpus_allowed))
-
-        # pml selection (ompi_mpi_init.c:630)
-        pml_fw = mca.framework("pml", "point-to-point messaging layer")
-        pml_comp = pml_fw.select()
-        if pml_comp is None:
-            raise RuntimeError("no pml component available")
-        pml_module = pml_comp.get_module(_rte)
-
-        # pml/monitoring interposition (per-peer traffic matrices)
-        from ompi_tpu.runtime import monitoring
-
-        pml_module = monitoring.maybe_wrap_pml(pml_module)
-
-        # vprotocol/pessimist interposition (message-event logging)
-        from ompi_tpu.mca.pml import vprotocol
-
-        pml_module = vprotocol.maybe_wrap_pml(pml_module, _rte)
-
-        # modex exchange of endpoints (ompi_mpi_init.c:682-701)
-        _rte.fence()
-
-        # world/self communicators (ompi_mpi_init.c:779)
-        from ompi_tpu.api.comm import Comm
-        from ompi_tpu.api.group import Group
-
-        # a dpm-spawned job's COMM_WORLD is its own rank set (global ranks
-        # allocated by the coord server), not 0..size-1
-        world_group = Group(getattr(_rte, "job_ranks",
-                                    range(_rte.world_size)))
-        _world = Comm(world_group, cid=0, rte=_rte, name="COMM_WORLD")
-        reserve_cid(0)
-        my = _rte.my_world_rank
-        _self = Comm(Group([my]), cid=1, rte=_rte, name="COMM_SELF")
-        reserve_cid(1)
-        _world.pml = pml_module
-        _self.pml = pml_module
-        pml_module.add_comm(_world)
-        pml_module.add_comm(_self)
-
-        # eager add_procs: build every peer's endpoint list NOW, while the
-        # modex is guaranteed reachable (the reference does this at
-        # ompi_mpi_init.c:833 — BML endpoint lists are an init product,
-        # not a first-send side effect; the FT detector's p2p carrier
-        # depends on endpoints surviving a later coord death)
-        inner = pml_module
-        while inner is not None and not hasattr(inner, "bml"):
-            inner = getattr(inner, "_inner", None)
-        bml = getattr(inner, "bml", None) if inner is not None else None
-        if bml is not None and not _rte.is_device_world:
-            for wr in _world.group.world_ranks:
-                if wr != _rte.my_world_rank:
-                    try:
-                        bml.add_proc(wr)
-                    except Exception:
-                        pass   # peer reachable lazily or not at all
-
-        # per-comm coll selection (ompi_mpi_init.c:956,962)
-        from ompi_tpu.mca.coll.base import comm_select
-
-        comm_select(_world)
-        comm_select(_self)
-
-        # ULFM FT runtime: event poller + optional heartbeat ring
-        # (PMIX_ERR_PROC_ABORTED handler registration, ompi_mpi_init.c:400-402)
-        _ft_enable = registry.register(
-            "ft", None, "enable", vtype=VarType.BOOL, default=True,
-            help="Start the FT event poller (failure/revocation delivery)")
-        _ft_detector = registry.register(
-            "ft", None, "detector", vtype=VarType.BOOL, default=False,
-            help="Start the heartbeat ring failure detector")
-        if not _rte.is_device_world and getattr(_rte, "client", None) is not None:
-            if _ft_enable.value:
-                from ompi_tpu.ft import propagator
-
-                propagator.start(_rte, with_detector=bool(_ft_detector.value))
-
-        # hook framework: post-init interposition (hook/comm_method dump)
-        from ompi_tpu.mca.hook import run_hooks
-
-        run_hooks("init", _world)
-
-        mark_runtime_initialized(True)
-        _state = State.INIT_COMPLETED
+    _state = State.INIT_COMPLETED
+    if not _atexit_armed:
+        _atexit_armed = True
         atexit.register(_atexit_finalize)
-        return _world
+    return _world
 
 
 def comm_world():
@@ -291,54 +264,32 @@ def finalize() -> None:
         if interlib.registrations() > 0:
             return
         _state = State.FINALIZE_STARTED
+        from ompi_tpu import instance as inst_mod
+
         try:
-            # pre-teardown synchronisation (ompi_mpi_finalize's barrier):
-            # a fast-exiting rank must not unlink shared segments a slower
-            # peer is still attaching during ITS init.  fence_final is
-            # one-shot + failure-aware and rides a dedicated short-timeout
-            # connection, so a peer that exited without fencing costs a
-            # bounded wait and cannot desync the shared client.
-            fence_final = getattr(_rte, "fence_final", None)
-            if fence_final is not None:
-                try:
-                    fence_final()
-                except Exception:
-                    pass   # coord gone / timeout: peers are exiting too
+            # pre-teardown synchronisation (ompi_mpi_finalize's barrier)
+            # BEFORE any shared-segment release, but only when dropping
+            # our reference will actually tear the runtime down — with a
+            # session still open, the process (and its segments) lives on
+            # and the real fence runs at the session's last release.
+            inst = inst_mod.current()
+            if inst is not None and inst_mod.refcount() <= 1:
+                inst._fence_final()
             from ompi_tpu.ft import propagator as _ft_prop
 
             _ft_prop.stop()
-            # trace export needs the coord client (KV publish + clock
-            # offset), so it runs before rte.finalize tears it down
-            from ompi_tpu.runtime import trace as _trace
-
-            try:
-                _trace.finalize_export(_rte)
-            except Exception:
-                pass   # observability must never break finalize
             # release per-comm coll resources (shared segments etc.) for
             # the built-in comms the user never frees — the reference
             # destroys WORLD/SELF in ompi_mpi_finalize the same way
             for c in (_world, _self):
                 if c is not None and not getattr(c, "freed", False):
                     c.release_coll_modules()
-            if _world is not None and _world.pml is not None:
-                fin = getattr(_world.pml, "finalize", None)
-                if fin is not None:
-                    fin()
-            if _rte is not None:
-                _rte.finalize()
-            from ompi_tpu.mca.threads import base as _threads_base
-
-            _threads_base.shutdown_pool(permanent=True)
-            mca.close_all()
+            # drop the world's instance reference; the LAST release runs
+            # the real teardown (trace export, pml finalize, rte
+            # finalize, thread pools, mca close, CID clear)
+            inst_mod.release()
         finally:
-            from ompi_tpu.runtime import progress
-
-            progress.reset_for_testing()
-            mark_runtime_initialized(False)
             _world = _self = _rte = None
-            with _cid_lock:
-                _cid_map.clear_all()
             _state = State.FINALIZE_COMPLETED
 
 
@@ -356,6 +307,11 @@ def reset_for_testing() -> None:
 
     interlib.reset_for_testing()
     finalize()
+    # drain session references a test may have leaked — the instance
+    # must not survive into the next test's boot
+    from ompi_tpu import instance as inst_mod
+
+    inst_mod.reset_for_testing()
     from ompi_tpu.ft import state as _ft_state
 
     _ft_state.reset_for_testing()

@@ -53,6 +53,36 @@ def _fmt(key: str, value, parsable: bool) -> str:
     return f"{key + ':':>40} {value}"
 
 
+def _pset_rows() -> list:
+    """(name, size, source) of every process set this process can see.
+
+    Inside a tpurun job (``OTPU_COORD`` set) the coord service is asked
+    for its advertised registry — the same source sessions resolve
+    against; standalone, only the MPI-4 builtins exist.  ``mpi://SELF``
+    is always client-resolved (its membership is per-process)."""
+    import os
+
+    rows = []
+    nprocs = int(os.environ.get("OTPU_NPROCS", "1") or 1)
+    coord = os.environ.get("OTPU_COORD")
+    if coord:
+        try:
+            from ompi_tpu.rte.coord import CoordClient
+
+            c = CoordClient(timeout=5.0)
+            try:
+                rows = [(r["name"], int(r["size"]), r["source"])
+                        for r in c.pset_list()]
+            finally:
+                c.close()
+        except Exception:
+            rows = [("mpi://WORLD", nprocs, "builtin (coord unreachable)")]
+    else:
+        rows = [("mpi://WORLD", nprocs, "builtin")]
+    rows.append(("mpi://SELF", 1, "builtin"))
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="otpu_info",
@@ -65,6 +95,11 @@ def main(argv=None) -> int:
                     help="Machine-readable colon-separated output")
     ap.add_argument("--pvars", action="store_true",
                     help="Show performance variables (MPI_T pvar analog)")
+    ap.add_argument("--psets", action="store_true",
+                    help="Show the process sets the coordination service "
+                         "advertises (name, size, membership source) — "
+                         "the MPI-4 pset registry sessions resolve "
+                         "against; standalone shows the builtins")
     ap.add_argument("--topo", action="store_true",
                     help="Show host + device topology (hwloc analog; "
                          "lstopo-lite)")
@@ -129,6 +164,11 @@ def main(argv=None) -> int:
 
         for line in hwloc.summary().splitlines():
             out.append(_fmt("topo", line.strip(), p))
+
+    if args.all or args.psets:
+        for pname, size, source in _pset_rows():
+            out.append(_fmt(f"pset {pname}",
+                            f"size {size} (source {source})", p))
 
     if args.all or args.pvars:
         for pv in registry.all_pvars():

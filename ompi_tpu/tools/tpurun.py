@@ -80,6 +80,51 @@ def _is_local_host(host: str) -> bool:
             or host == socket.getfqdn())
 
 
+def _parse_pset(spec: str, nprocs: int) -> tuple:
+    """``--pset NAME:RANKS`` → (name, [ranks]); RANKS is a comma list
+    with ranges, e.g. ``workers:0,2-3``."""
+    name, sep, ranks_s = spec.partition(":")
+    if not sep or not name or not ranks_s:
+        raise SystemExit(f"tpurun: bad --pset {spec!r} "
+                         "(expected NAME:RANKS, e.g. workers:0,2-3)")
+    ranks: list = []
+    for tok in ranks_s.split(","):
+        a, dash, b = tok.partition("-")
+        try:
+            lo = int(a)
+            hi = int(b) if dash else lo
+        except ValueError:
+            raise SystemExit(f"tpurun: bad rank token {tok!r} in "
+                             f"--pset {spec!r}")
+        if hi < lo:
+            raise SystemExit(f"tpurun: reversed range {tok!r} in "
+                             f"--pset {spec!r}")
+        ranks.extend(range(lo, hi + 1))
+    bad = [r for r in ranks if not 0 <= r < nprocs]
+    if bad or len(set(ranks)) != len(ranks):
+        raise SystemExit(f"tpurun: --pset {spec!r} ranks invalid for a "
+                         f"{nprocs}-rank job")
+    return name, ranks
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    """A currently-free TCP port for the jax.distributed coordinator
+    (bind-and-release; the window until rank 0 binds it is tiny and a
+    collision fails loudly at initialize).  When the coordinator will
+    live on a REMOTE host (rank 0 not local) the probe can only sample
+    the head's port space — best effort, same as mpirun's static port
+    ranges."""
+    s = socket.socket()
+    try:
+        try:
+            s.bind((host if host != "0.0.0.0" else "", 0))
+        except OSError:
+            s.bind(("", 0))    # remote rank-0 host: probe locally
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
 def _monitor(procs_list, rank_of, *, enable_recovery: bool, label: str,
              on_fail=None, abort_check=None) -> int:
     """ONE monitor loop for head and child launchers (they must never
@@ -208,6 +253,15 @@ def _child_main(args, cmd) -> int:
     if not args.with_tpu:
         env_base.pop("PALLAS_AXON_POOL_IPS", None)
         env_base["JAX_PLATFORMS"] = "cpu"
+    if args.device_world:
+        # flags, not env, carry this over a launch agent (ssh forwards
+        # no environment); the coordinator address rides the coord KV
+        env_base["OTPU_DEVICE_WORLD"] = "1"
+        if args.local_devices > 0:
+            env_base["XLA_FLAGS"] = (
+                env_base.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.local_devices}").strip()
     for name, value in args.mca:
         env_base["OTPU_MCA_" + name.removeprefix("otpu_")] = value
 
@@ -313,6 +367,25 @@ def main(argv=None) -> int:
                     help="ULFM mode: a dying rank is reported as a "
                          "proc_failed event instead of tearing down the job "
                          "(mpirun --enable-recovery)")
+    ap.add_argument("--pset", action="append", default=[],
+                    metavar="NAME:RANKS",
+                    help="Publish a user process set (MPI-4 pset) under "
+                         "NAME with the given ranks (comma list with "
+                         "ranges: 'workers:0,2-3'); sessions resolve it "
+                         "via Session.group_from_pset")
+    ap.add_argument("--device-world", action="store_true",
+                    dest="device_world",
+                    help="Boot a multi-process device world: every rank "
+                         "initializes jax.distributed (coordinator "
+                         "address published through the coord service, "
+                         "process_id from the rank map) so the global "
+                         "device mesh — and coll/xla collectives — span "
+                         "processes")
+    ap.add_argument("--local-devices", type=int, default=0,
+                    dest="local_devices", metavar="K",
+                    help="With --device-world on the CPU backend: give "
+                         "each rank K virtual devices "
+                         "(xla_force_host_platform_device_count)")
     ap.add_argument("--with-tpu", action="store_true",
                     help="Keep accelerator boot hooks active in ranks. By "
                          "default ranks run the host path (ProcRte) and the "
@@ -352,6 +425,43 @@ def main(argv=None) -> int:
         server = CoordServer(args.nprocs, port=args.coord_port)
         host, port = server.addr
 
+    # process-set registry (MPI-4 psets, served to sessions by the coord
+    # service): the builtin world set, one set per node the rank map
+    # names, and any user sets.  mpi://SELF stays client-resolved (its
+    # membership is per-process).
+    server.publish_pset("mpi://WORLD", range(args.nprocs),
+                        source="builtin")
+    node_ranks: dict = {}
+    for rank in range(args.nprocs):
+        if rank_groups is not None:
+            node = next(h for (h, _), rr in zip(hosts, rank_groups)
+                        if rank in rr)
+        elif args.fake_nodes > 0:
+            node = f"node{rank * args.fake_nodes // args.nprocs}"
+        else:
+            node = socket.gethostname()
+        node_ranks.setdefault(node, []).append(rank)
+    for node, ranks_on in node_ranks.items():
+        server.publish_pset(f"mpi://host/{node}", ranks_on, source="host")
+    for spec_s in args.pset:
+        pname, pranks = _parse_pset(spec_s, args.nprocs)
+        server.publish_pset(pname, pranks, source="user")
+
+    if args.device_world:
+        # jax.distributed coordinator lives INSIDE rank 0's process;
+        # advertise the address where rank 0 will actually run.  When
+        # rank 0 is on the head but OTHER hosts are remote, loopback
+        # would be unreachable for them — reuse the coord service's
+        # already-routable advertised host in that case.
+        jax_host = host
+        if rank_groups is not None and args.launch_agent != "local":
+            r0_host = next(h for (h, _), rr in zip(hosts, rank_groups)
+                           if 0 in rr)
+            if not _is_local_host(r0_host):
+                jax_host = r0_host
+        server.kv_put(-1, "__jax_coord__",
+                      f"{jax_host}:{_free_port(jax_host)}")
+
     env_base = dict(os.environ)
     # Ranks must be able to import ompi_tpu no matter how tpurun itself was
     # found (installed, -m from the repo, …).  Appended, not prepended: the
@@ -366,6 +476,13 @@ def main(argv=None) -> int:
     if not args.with_tpu:
         env_base.pop("PALLAS_AXON_POOL_IPS", None)
         env_base["JAX_PLATFORMS"] = "cpu"
+    if args.device_world:
+        env_base["OTPU_DEVICE_WORLD"] = "1"
+        if args.local_devices > 0:
+            env_base["XLA_FLAGS"] = (
+                env_base.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.local_devices}").strip()
     for name, value in args.mca:
         env_base["OTPU_MCA_" + name.removeprefix("otpu_")] = value
 
@@ -446,6 +563,10 @@ def main(argv=None) -> int:
                 child.append("--enable-recovery")
             if args.with_tpu:
                 child.append("--with-tpu")
+            if args.device_world:
+                child.append("--device-world")
+                if args.local_devices > 0:
+                    child += ["--local-devices", str(args.local_devices)]
             if args.bind_to != "none":
                 child += ["--bind-to", args.bind_to]
             for name, value in args.mca:

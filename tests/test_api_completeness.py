@@ -328,6 +328,40 @@ def test_neighbor_v_variants_multiprocess(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_session_api_surface(world):
+    """MPI-4 Sessions bindings (``ompi/mpi/c/session_*.c``): init/
+    finalize, info + errhandler, pset enumeration, and the sessions-
+    model construction chain Group_from_session_pset →
+    Comm_create_from_group (full lifecycle coverage in
+    test_session.py; device-world crossing in test_device_world.py)."""
+    from ompi_tpu.api.errhandler import ERRORS_RETURN
+    from ompi_tpu.api.session import Session
+
+    s = Session.init(errhandler=ERRORS_RETURN)
+    try:
+        n = s.get_num_psets()
+        names = [s.get_nth_pset(i) for i in range(n)]
+        assert "mpi://WORLD" in names and "mpi://SELF" in names
+        info = s.get_pset_info("mpi://WORLD")
+        g = ompi_tpu.Group.from_session_pset(s, "mpi://WORLD")
+        assert int(info.get("mpi_size")) == g.size
+        comm = ompi_tpu.Comm.create_from_group(g, "completeness")
+        assert comm.size == g.size and comm.cid >= 2
+        np.testing.assert_allclose(
+            np.asarray(comm.allreduce_array(
+                np.ones((comm.size, 2), np.float32))).ravel(),
+            comm.size)
+        comm.free()
+        lo = g.incl(range(g.size // 2))
+        hi = g.difference(lo)
+        inter = ompi_tpu.Comm.create_intercomm_from_groups(
+            lo, 0, hi, 0, "completeness-inter")
+        assert inter.is_inter and inter.remote_size == hi.size
+        inter.free()
+    finally:
+        s.finalize()
+
+
 def test_partitioned_communication(world):
     """MPI-4 partitioned p2p (Psend_init/Precv_init/Pready/Pready_range/
     Pready_list/Parrived — mca/part/persist); full coverage in

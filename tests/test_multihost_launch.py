@@ -86,6 +86,79 @@ def test_hostfile_slot_guard_and_oversubscribe(tmp_path):
     assert r.stdout.count("R ") == 4
 
 
+def test_fake_ssh_agent_contract(tmp_path):
+    """The DEFAULT multi-host path (``--launch-agent ssh``) exercised
+    without sshd: a fake-ssh shim stands in for ssh and asserts the
+    exact contract tpurun's head relies on —
+
+    * argv shape ``<agent words…> <host> <ONE shell command>`` (exactly
+      what ``ssh host "cmd"`` accepts);
+    * the command cd's into the launch cwd first (ssh starts in $HOME);
+    * the child launcher is fully self-described on its command line
+      (``--child-of`` coord address, ``--ranks``, ``-n``, ``--node-id``)
+      with NO environment marshalling — ssh forwards none, so any env
+      dependence would only fail on real clusters;
+
+    then execs the command locally through a SCRUBBED environment (PATH/
+    HOME only, like a fresh login shell), proving the remote side works
+    from the command line + cwd alone."""
+    shim = tmp_path / "fakessh.py"
+    shim.write_text(textwrap.dedent("""
+        import os, subprocess, sys
+
+        def fail(msg):
+            print("FAKESSH ASSERT:", msg, file=sys.stderr, flush=True)
+            sys.exit(99)
+
+        args = sys.argv[1:]
+        # tpurun split the agent string into words; ours ends with the
+        # ssh-style option so the full ssh argv shape is exercised
+        if args[:2] != ["-o", "BatchMode=yes"]:
+            fail(f"agent words not forwarded: {args[:2]}")
+        if len(args) != 4:
+            fail(f"expected '<opts> <host> <command>', got {args}")
+        host, command = args[2], args[3]
+        if host not in ("ghostA", "ghostB"):
+            fail(f"unexpected host {host}")
+        wdir = os.environ["FAKESSH_WDIR"]
+        if not command.startswith(f"cd {wdir} && "):
+            fail(f"command must cd into the launch cwd: {command[:80]}")
+        for needle in ("-m ompi_tpu.tools.tpurun", "--child-of",
+                       "--ranks", "--node-id " + host):
+            if needle not in command:
+                fail(f"{needle!r} missing from: {command}")
+        if "OTPU_" in command:
+            fail("identity must ride flags, not exported env")
+        with open(os.environ["FAKESSH_LOG"], "a") as log:
+            print(host, file=log, flush=True)
+        # exec like sshd: fresh login-ish env, nothing marshalled
+        env = {k: v for k, v in os.environ.items()
+               if k in ("PATH", "HOME", "LANG")}
+        sys.exit(subprocess.run(["/bin/sh", "-c", command],
+                                env=env).returncode)
+    """))
+    hf = tmp_path / "hosts.txt"
+    hf.write_text("ghostA slots=2\nghostB slots=2\n")
+    log = tmp_path / "shim.log"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FAKESSH_LOG=str(log), FAKESSH_WDIR=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+         "--hostfile", str(hf),
+         "--launch-agent",
+         f"{sys.executable} {shim} -o BatchMode=yes",
+         "--remote-python", sys.executable,
+         "-n", "4", sys.executable,
+         os.path.join(REPO, "examples", "ring.py")],
+        capture_output=True, text=True, timeout=180, cwd=REPO, env=env)
+    assert "FAKESSH ASSERT" not in r.stderr, r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "token now 0" in r.stdout, r.stdout
+    assert r.stdout.count("exiting") == 4
+    # one agent invocation per remote host
+    assert sorted(log.read_text().split()) == ["ghostA", "ghostB"]
+
+
 def test_hostfile_child_failure_tears_down(tmp_path):
     hf = tmp_path / "hosts.txt"
     hf.write_text("n1 slots=2\nn2 slots=2\n")

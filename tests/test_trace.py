@@ -170,6 +170,44 @@ def test_merge_aligns_clocks_and_skew_names_slowest():
     assert "p50_us" in report and "1k" in report
 
 
+def test_boot_path_spans_in_merged_timeline(tmp_path):
+    """The instance boot path is spanned — coord connect, jax
+    distributed init slot, modex fence, and the whole instance_boot —
+    so cross-rank merged timelines show STARTUP skew, not just
+    steady-state collective skew."""
+    script = tmp_path / "boot_traced.py"
+    script.write_text(textwrap.dedent("""
+        import ompi_tpu
+        w = ompi_tpu.init()
+        w.barrier()
+        ompi_tpu.finalize()
+    """))
+    tdir = tmp_path / "traces"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "2",
+         "--mca", "trace_enable", "1", "--mca", "trace_dir", str(tdir),
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in range(2):
+        p = json.load(open(tdir / f"trace_rank{rank}.json"))
+        boots = {e["name"] for e in p["traceEvents"]
+                 if e["cat"] == "boot"}
+        assert {"coord_connect", "jax_distributed_init", "modex_fence",
+                "instance_boot"} <= boots, boots
+        # the whole-boot span encloses the fence span
+        span_of = {e["name"]: e for e in p["traceEvents"]
+                   if e["cat"] == "boot"}
+        whole, fence = span_of["instance_boot"], span_of["modex_fence"]
+        assert whole["ts"] <= fence["ts"]
+        assert whole["ts"] + whole["dur"] >= fence["ts"] + fence["dur"]
+    merged = json.load(open(tdir / "trace_merged.json"))
+    boot_pids = {e["pid"] for e in merged["traceEvents"]
+                 if e.get("cat") == "boot"}
+    assert boot_pids == {0, 1}
+
+
 def test_tpurun_trace_gather_merge_and_skew(tmp_path):
     """4-rank end-to-end: per-rank Chrome JSON, merged timeline, skew
     report — the full gather path through the CoordServer."""
