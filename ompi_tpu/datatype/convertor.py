@@ -23,8 +23,14 @@ import numpy as np
 from ompi_tpu.datatype.core import Datatype
 
 # whole-element pack jobs at least this many bytes fan out over the
-# threads-framework worker pool instead of the single-thread native loop
-_POOL_PACK_MIN = 256 * 1024
+# threads-framework worker pool instead of the single-thread native loop.
+# fastpath: raised from 256KB — the bench threads_pool_pack_4MB row
+# measured the pool barely breaking even at 4MB (1.09x) because pool
+# dispatch (job split + cross-thread handoff + wait) costs tens of µs
+# that a sub-megabyte native pack never earns back; below this the
+# serial native loop is flatly faster and skips the dispatch entirely
+# (pinned by test_perf_guard.test_small_pack_skips_pool_dispatch)
+_POOL_PACK_MIN = 2 * 1024 * 1024
 
 
 class ConvertorFlags(enum.IntFlag):

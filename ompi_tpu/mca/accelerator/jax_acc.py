@@ -22,7 +22,9 @@ regime grdma targets.
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
+import time
+import weakref
+from collections import OrderedDict, deque
 from typing import Any, Optional
 
 import numpy as np
@@ -30,6 +32,7 @@ import numpy as np
 from ompi_tpu.base.containers import IntervalTree
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType, registry
+from ompi_tpu.runtime import spc, trace
 
 _rcache = IntervalTree()
 
@@ -46,25 +49,62 @@ _pool_bytes_var = registry.register(
          "LRU eviction")
 
 
-class _StagingPool:
-    """LRU pool of reusable host staging buffers (grdma-style reuse).
+#: smallest size class kept (below this an np.empty is cheaper than the
+#: pool bookkeeping)
+_MIN_CLASS = 256
 
-    ``acquire`` returns a warmed buffer when one of the exact
-    (shape, dtype) is cached (contents undefined, like ``np.empty``);
-    ``release`` returns it for reuse, evicting least-recently-used
-    entries beyond ``max_bytes``.  Unless explicitly overridden
-    (tests), enablement and capacity follow the MCA vars.
+
+class _StagingPool:
+    """Size-class binned pool of reusable host staging buffers
+    (grdma-style reuse, fastpath redesign).
+
+    Free memory is held as raw 1-D uint8 OWNER arrays binned by
+    power-of-two size class; ``acquire`` pops the most-recently-released
+    buffer of the class (warm pages first, O(1)) and returns it shaped
+    as a (shape, dtype) view, ``release`` maps the view back to its raw
+    class buffer in O(1) through the checkout table.  Contents are
+    undefined, like ``np.empty``, and nothing touches the buffer on
+    acquire — warmth is the whole point.
+
+    The previous exact-(shape, dtype)-keyed design measured an e2e
+    **regression** (BENCH_SWEEP `staging_pool_e2e` 0.78x) despite a
+    6.65x reuse micro: every release ran an O(n) identity scan of the
+    key's free list, eviction dumped the ENTIRE least-recently-used key
+    (a repeated-collective loop whose one hot key rotated to the front
+    lost its whole warm set at once), and odd-size blocks (`_blocks`
+    rounds ranks' shares up and down by one element) fragmented across
+    keys that could never reuse each other's memory.  Size-class bins
+    fix the fragmentation, the checkout table makes release O(1), and
+    eviction now retires ONE cold buffer at a time from the
+    least-recently-USED class, never the hot class at the deque's end.
+
+    Unless explicitly overridden (tests), enablement and capacity follow
+    the MCA vars.
     """
 
     def __init__(self, max_bytes: Optional[int] = None,
                  enabled: Optional[bool] = None) -> None:
         self._lock = threading.Lock()
-        self._free: OrderedDict[tuple, list] = OrderedDict()
+        # size class -> deque of raw uint8 owner arrays (LIFO: the back
+        # is the most recently released = warmest pages)
+        self._free: OrderedDict[int, deque] = OrderedDict()
+        # id(view handed out) -> (weakref(view), raw owner): release()
+        # maps the caller's array back to pool memory without walking
+        # .base chains; the weakref both guards against id() reuse and
+        # purges the entry if the view dies unreleased
+        self._out: dict[int, tuple] = {}
+        # id(owner) of adopted foreign buffers currently in _free: a
+        # double release of the same owner array would otherwise repool
+        # two aliases of one memory block (two later acquires would
+        # share bytes).  The pooled view keeps the owner alive, so the
+        # id stays valid for exactly as long as it is in this set.
+        self._adopted: set[int] = set()
         self._bytes = 0
         self._max_bytes = max_bytes
         self._enabled = enabled
         self.hits = 0
         self.misses = 0
+        self._warned_noncontig = False
 
     @property
     def enabled(self) -> bool:
@@ -87,47 +127,131 @@ class _StagingPool:
         self._max_bytes = int(v) if v is not None else None
 
     @staticmethod
-    def _key(shape, dtype) -> tuple:
-        if isinstance(shape, (int, np.integer)):
-            shape = (int(shape),)
-        return tuple(int(s) for s in shape), np.dtype(dtype).str
+    def _class_of(nbytes: int) -> int:
+        if nbytes <= _MIN_CLASS:
+            return _MIN_CLASS
+        return 1 << (int(nbytes) - 1).bit_length()
+
+    def _checkout(self, raw: np.ndarray, shape, dtype) -> np.ndarray:
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize \
+            if shape else np.dtype(dtype).itemsize
+        view = raw[:nbytes].view(dtype).reshape(shape)
+        token = id(view)
+        self._out[token] = (
+            weakref.ref(view, lambda _r, t=token: self._out.pop(t, None)),
+            raw)
+        return view
 
     def acquire(self, shape, dtype) -> np.ndarray:
-        key = self._key(shape, dtype)
-        if self.enabled:
-            with self._lock:
-                lst = self._free.get(key)
-                if lst:
-                    self._free.move_to_end(key)
-                    buf = lst.pop()
-                    self._bytes -= buf.nbytes
-                    self.hits += 1
-                    return buf
+        if isinstance(shape, (int, np.integer)):
+            shape = (int(shape),)
+        shape = tuple(int(s) for s in shape)
+        dtype = np.dtype(dtype)
+        if not self.enabled:
+            return np.empty(shape, dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize if shape \
+            else dtype.itemsize
+        cls = self._class_of(nbytes)
+        t0 = time.perf_counter_ns() if trace.enabled else 0
+        with self._lock:
+            dq = self._free.get(cls)
+            if dq:
+                raw = dq.pop()          # back = warmest
+                if not dq:
+                    del self._free[cls]
+                else:
+                    self._free.move_to_end(cls)
+                if raw.base is not None:        # adopted foreign owner
+                    self._adopted.discard(id(raw.base))
+                self._bytes -= raw.nbytes
+                self.hits += 1
+            else:
+                raw = None
                 self.misses += 1
-        return np.empty(key[0], np.dtype(dtype))
+        hit = raw is not None
+        if hit:
+            spc.record("fastpath_staging_hits")
+        else:
+            spc.record("fastpath_staging_misses")
+            raw = np.empty(cls, np.uint8)
+        out = self._checkout(raw, shape, dtype)
+        if trace.enabled:
+            name = "staging_hit" if hit else "staging_miss"
+            trace.span(name, "staging", t0, args={"nbytes": nbytes})
+            trace.hist_record(name, nbytes, time.perf_counter_ns() - t0)
+        return out
 
     def release(self, buf: np.ndarray) -> None:
-        if not self.enabled or buf.base is not None:
-            return   # never pool views: the base owns the memory
-        if buf.nbytes > self.max_bytes:
+        if not self.enabled:
+            return
+        if not buf.flags.c_contiguous:
+            # fastpath satellite: this used to vanish silently, leaking
+            # the buffer from the pool's accounting — warn loudly once
+            # (per-pool) so the caller's layout bug is visible
+            if not self._warned_noncontig:
+                self._warned_noncontig = True
+                from ompi_tpu.base.output import show_help
+
+                show_help("help-accel-staging", "non-contiguous-release",
+                          shape=tuple(buf.shape), dtype=str(buf.dtype))
+            return
+        entry = self._out.pop(id(buf), None)
+        if entry is not None and entry[0]() is buf:
+            raw = entry[1]              # pool view: repool its raw owner
+        elif buf.base is not None:
+            return   # foreign view (or a pool sub-view): the base owns
+                     # the memory — pooling it would alias the caller
+        else:
+            # foreign owner (a caller's np.empty handed back): adopt it
+            # as a flat byte view — the view's .base keeps it alive
+            raw = buf.reshape(-1).view(np.uint8)
+            if raw.nbytes < _MIN_CLASS:
+                return
+        # always binned at the FLOOR class so every buffer in a bin
+        # covers every request mapped there (requests bin at the
+        # ceiling).  Pool-allocated raws are class-flat (floor ==
+        # ceiling), but an adopted odd-size raw must never ride a
+        # checkout back into its CEILING class — a later acquire of
+        # that class would overrun it.
+        cls = 1 << (int(raw.nbytes).bit_length() - 1)
+        if raw.nbytes > self.max_bytes:
             return   # could never be retained — and pushing it through
                      # the LRU would flush every warm buffer first
-        key = self._key(buf.shape, buf.dtype)
         with self._lock:
-            lst = self._free.setdefault(key, [])
-            if any(b is buf for b in lst):
-                return   # double release: pooling the same ndarray
-                         # twice would alias two later acquires
-            lst.append(buf)
-            self._free.move_to_end(key)
-            self._bytes += buf.nbytes
+            if raw.base is not None and (
+                    id(raw.base) in self._adopted
+                    or any(e[1].base is raw.base
+                           for e in list(self._out.values()))):
+                return   # double release: the owner is already in a
+                         # free bin, or its bytes are checked out right
+                         # now (re-released after an acquire popped it)
+                         # — repooling would alias two later acquires.
+                         # Both checks live under the lock so racing
+                         # releases cannot all pass them.
+            dq = self._free.get(cls)
+            if dq is None:
+                dq = self._free[cls] = deque()
+            dq.append(raw)
+            if raw.base is not None:            # adopted foreign owner
+                self._adopted.add(id(raw.base))
+            self._free.move_to_end(cls)
+            self._bytes += raw.nbytes
+            # evict ONE cold buffer at a time from the least-recently-
+            # used class — never the hot class we just touched
             while self._bytes > self.max_bytes and self._free:
-                _, lst = self._free.popitem(last=False)   # LRU key out
-                self._bytes -= sum(b.nbytes for b in lst)
+                cold_cls, cold = next(iter(self._free.items()))
+                victim = cold.popleft()      # front = coldest
+                if victim.base is not None:
+                    self._adopted.discard(id(victim.base))
+                self._bytes -= victim.nbytes
+                if not cold:
+                    del self._free[cold_cls]
 
     def clear(self) -> None:
         with self._lock:
             self._free.clear()
+            self._out.clear()
+            self._adopted.clear()
             self._bytes = 0
             self.hits = self.misses = 0
 
@@ -200,3 +324,12 @@ class JaxAcceleratorComponent(Component):
 
 
 COMPONENT = JaxAcceleratorComponent()
+
+from ompi_tpu.base.output import register_help as _rh
+
+_rh("help-accel-staging", "non-contiguous-release",
+    "A non-C-contiguous buffer (shape {shape}, dtype {dtype}) was "
+    "released to the staging pool and cannot be repooled: staging "
+    "checkouts are contiguous, so a transformed (transposed/strided) "
+    "array points at a layout bug in the caller.  The buffer is "
+    "dropped; this warning is shown once.")

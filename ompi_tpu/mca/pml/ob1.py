@@ -317,14 +317,33 @@ class Ob1Pml:
         bandwidth (``bml_r2.c``'s bandwidth-proportional scheduling /
         btl/tcp link striping).  Eager/RNDV heads stay on the
         lowest-latency rail — order matters only for the matched head.
+
+        fastpath fragment pipelining: ``btl.send`` queues the fragment's
+        views and returns after ONE transport attempt (sendmsg/ring
+        write), so the pack of fragment n+1 below overlaps the kernel
+        draining fragment n — pack and wire move concurrently instead
+        of strictly alternating.  On the contiguous path pack_borrow is
+        an O(1) slice and the btl sees the user buffer's own memoryview
+        (zero payload copies, SPC ``fastpath_payload_copies``); only a
+        backpressured remainder is ever owned.
         """
         dst_world, peer_req = ack.src, ack.meta["peer_req"]
         rails = self._stripe_rails(dst_world, req.nbytes)
-        assigned = [0] * len(rails)
-        while not req.convertor.finished:
-            if len(rails) == 1:
-                j = 0
-            else:
+        conv = req.convertor
+        if len(rails) == 1:
+            # single-rail fast lane: no finish-time bookkeeping at all
+            ep = rails[0]
+            btl, max_send = ep.btl, rails[0].btl.max_send_size
+            while not conv.finished:
+                off = conv.position
+                data, borrowed = conv.pack_borrow(max_send)
+                btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
+                                  -1, 0, FRAG, data, total_len=req.nbytes,
+                                  offset=off, meta={"req_id": peer_req},
+                                  borrowed=borrowed))
+        else:
+            assigned = [0] * len(rails)
+            while not conv.finished:
                 # finish-time greedy: give the frag to the rail that
                 # would complete its assigned bytes soonest — long-run
                 # bandwidth-proportional, and a 100x-slower rail never
@@ -333,14 +352,14 @@ class Ob1Pml:
                         key=lambda k: (assigned[k]
                                        + rails[k].btl.max_send_size)
                         / max(1, rails[k].btl.bandwidth))
-            ep = rails[j]
-            off = req.convertor.position
-            data, borrowed = req.convertor.pack_borrow(ep.btl.max_send_size)
-            assigned[j] += len(data)
-            ep.btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
-                                 -1, 0, FRAG, data, total_len=req.nbytes,
-                                 offset=off, meta={"req_id": peer_req},
-                                 borrowed=borrowed))
+                ep = rails[j]
+                off = conv.position
+                data, borrowed = conv.pack_borrow(ep.btl.max_send_size)
+                assigned[j] += len(data)
+                ep.btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
+                                     -1, 0, FRAG, data, total_len=req.nbytes,
+                                     offset=off, meta={"req_id": peer_req},
+                                     borrowed=borrowed))
         self._send_reqs.pop(req.req_id, None)
         req.complete()
         if peruse.active():

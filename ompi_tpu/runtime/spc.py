@@ -14,6 +14,13 @@ _COUNTERS = (
     "rget_msgs", "striped_msgs",
     "part_pready", "part_parrived", "part_msgs", "part_bytes",
     "device_collectives", "device_bytes",
+    # fastpath counters: the zero-copy host-datapath contract, pinned by
+    # test_perf_guard (payload copies on the contiguous tcp send path
+    # must stay 0; the schedule cache must hit on repeated collectives)
+    "fastpath_hdr_fast", "fastpath_hdr_pickle", "fastpath_sendmsg",
+    "fastpath_payload_copies",
+    "fastpath_sched_hits", "fastpath_sched_misses", "fastpath_eager_lane",
+    "fastpath_staging_hits", "fastpath_staging_misses",
 )
 
 _pvars = {}

@@ -171,6 +171,13 @@ def main(argv=None) -> int:
                             f"size {size} (source {source})", p))
 
     if args.all or args.pvars:
+        # SPC counters normally register at instance boot; an info dump
+        # must list them (zeroed) without paying for a runtime boot.
+        # Lazily-registered pvars (trace histogram bins like
+        # btl_sendmsg/staging_hit) appear once a run has touched them.
+        from ompi_tpu.runtime import spc as _spc
+
+        _spc.init()
         for pv in registry.all_pvars():
             out.append(_fmt(
                 f"pvar {pv.name}",

@@ -82,7 +82,10 @@ def test_rget_speedup_pinned(pins):
         assert got >= 0.8 * pin, (
             f"{key}: speedup {got} fell >20% below pin {pin}")
         if "_sm/" in key:
-            assert got > 1.5, (
+            # fastpath (PR 4) made the FRAG stream itself faster
+            # (zero-copy convertor views + schedule caches), so RGET's
+            # margin legitimately narrowed; it must still WIN
+            assert got > 1.3, (
                 f"{key}: sm RGET speedup {got} no longer decisive — "
                 f"the zero-copy path degraded")
 

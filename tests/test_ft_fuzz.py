@@ -31,7 +31,11 @@ WORKER = Path(__file__).resolve().parent / "fuzz_agree_worker.py"
 
 N = 5
 ROUNDS = 4
-SEEDS = [0, 1, 11, 23, 37, 58, 71]
+# the designed worst cases (0, 1) run in tier-1; the randomized seeds
+# are the `slow` sweep — each is a 5-process kill-injection job whose
+# recovery timeouts dominate suite wall-clock on oversubscribed hosts
+SEEDS = [0, 1] + [pytest.param(s, marks=pytest.mark.slow)
+                  for s in (11, 23, 37, 58, 71)]
 
 
 def _plan_for(seed):

@@ -81,6 +81,7 @@ def _run_aot_subprocess() -> dict:
         return json.load(f)
 
 
+@pytest.mark.slow
 def test_all_kernels_aot_compile():
     try:
         import libtpu  # noqa: F401

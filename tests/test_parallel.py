@@ -172,6 +172,7 @@ def test_pp2_matches_pp1_same_model():
                                    err_msg=f"param {k} diverged")
 
 
+@pytest.mark.slow
 def test_dryrun_spec_override_and_16dev():
     """The driver-facing dryrun accepts a mesh-spec override (pp=2 on 8
     devices) and the 16-device default mesh — where pp activates on its

@@ -70,6 +70,128 @@ def test_allreduce_per_byte_cost_stays_linear(tmp_path):
     assert small_t < 0.032, f"256KB allreduce took {small_t * 1e3:.1f}ms"
 
 
+_FASTPATH_COPY_SCRIPT = textwrap.dedent("""
+    import json
+    import numpy as np, ompi_tpu
+    from ompi_tpu.runtime import spc
+
+    w = ompi_tpu.init()
+    # contiguous eager messages over btl/tcp (fake-nodes forces tcp),
+    # ping-ponged so the socket never backpressures: the zero-copy
+    # contract says the user buffer's view rides to sendmsg with NO
+    # intermediate payload copy
+    x = np.ones(16 << 10, np.uint8)
+    y = np.empty_like(x)
+    for i in range(50):
+        if w.rank == 0:
+            w.send(x, dest=1, tag=1)
+            w.recv(y, source=1, tag=2)
+        else:
+            w.recv(y, source=0, tag=1)
+            w.send(x, dest=1 - w.rank, tag=2)
+    c = spc.counters()
+    print(f"COPYPIN{w.rank} " + json.dumps(
+        [c.get("fastpath_payload_copies", -1),
+         c.get("fastpath_hdr_fast", -1),
+         c.get("fastpath_hdr_pickle", -1)]))
+    ompi_tpu.finalize()
+""")
+
+
+_SCHED_CACHE_SCRIPT = textwrap.dedent("""
+    import json
+    import numpy as np, ompi_tpu
+    from ompi_tpu.runtime import spc
+
+    w = ompi_tpu.init()
+    big = np.ones(65536, np.float32)      # 256KB: above the eager lane
+    small = np.ones(256, np.float32)      # 1KB: eager lane
+    w.allreduce(big)
+    base_hits = spc.read("fastpath_sched_hits")
+    w.allreduce(big)                      # identical second call
+    hits_after = spc.read("fastpath_sched_hits")
+    w.allreduce(small)
+    if w.rank == 0:
+        print("SCHEDPIN " + json.dumps(
+            [base_hits, hits_after,
+             spc.read("fastpath_eager_lane")]))
+    ompi_tpu.finalize()
+""")
+
+
+def test_fastpath_zero_copy_tcp_send(tmp_path):
+    """The fastpath acceptance pin: on the contiguous tcp send path the
+    payload must never be copied (SPC ``fastpath_payload_copies`` == 0
+    — the sender's memoryview rides to sendmsg) and the fixed fast
+    header must carry the data frames (pickle only for the handshake's
+    exotic frames)."""
+    script = tmp_path / "copy_pin.py"
+    script.write_text(_FASTPATH_COPY_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "2",
+         "--fake-nodes", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in (0, 1):
+        line = next(ln for ln in r.stdout.splitlines()
+                    if f"COPYPIN{rank}" in ln)
+        copies, fast, pickle_h = json.loads(
+            line.split(f"COPYPIN{rank} ", 1)[1])
+        assert copies == 0, (
+            f"rank {rank}: {copies} payload copies on the contiguous "
+            f"tcp send path (zero-copy contract broken)")
+        assert fast >= 50, f"rank {rank}: only {fast} fast headers"
+
+
+def test_tuned_schedule_cache_hits_on_second_call(tmp_path):
+    """coll/tuned decision+schedule caching: the second identical
+    allreduce must hit the cached pick (SPC ``fastpath_sched_hits``
+    grows), and a small allreduce must take the SPC-counted eager
+    lane.  ^sm_coll isolates the tuned ladder (on one host coll/sm owns
+    sub-slot payloads)."""
+    script = tmp_path / "sched_pin.py"
+    script.write_text(_SCHED_CACHE_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "4",
+         "--mca", "coll", "^sm_coll", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = next(ln for ln in r.stdout.splitlines() if "SCHEDPIN" in ln)
+    base_hits, hits_after, lane = json.loads(
+        line.split("SCHEDPIN ", 1)[1])
+    assert hits_after > base_hits, (
+        f"second identical allreduce did not hit the schedule cache "
+        f"({base_hits} -> {hits_after})")
+    assert lane >= 1, "small allreduce skipped the eager lane"
+
+
+def test_small_pack_skips_pool_dispatch(monkeypatch):
+    """fastpath satellite: packs below ``_POOL_PACK_MIN`` must never
+    reach the worker pool — the threads_pool_pack_4MB bench measured
+    pool dispatch barely breaking even at 4MB, so sub-threshold packs
+    keep the serial native loop with zero pool traffic."""
+    import numpy as np
+
+    from ompi_tpu.datatype import convertor as conv_mod
+    from ompi_tpu.datatype import core as dt_core
+    from ompi_tpu.mca.threads import base as threads_base
+
+    # the threshold itself is part of the contract
+    assert conv_mod._POOL_PACK_MIN >= (1 << 21), \
+        "parallel-pack fan-out threshold regressed below 2MB"
+    calls = []
+    monkeypatch.setattr(threads_base, "get_pool",
+                        lambda: calls.append(1))
+    vec = dt_core.vector(2, 1, 2, dt_core.FLOAT32)
+    n = (conv_mod._POOL_PACK_MIN // vec.size) - 1   # just under
+    buf = np.zeros(n * (vec.extent // 4), np.float32)
+    packed = conv_mod.Convertor(vec, n, buf).pack()
+    assert packed.nbytes == n * vec.size
+    assert not calls, "sub-threshold pack dispatched to the pool"
+
+
 _TRACE_PIN_SCRIPT = textwrap.dedent("""
     import json, time
     import numpy as np, ompi_tpu

@@ -16,15 +16,37 @@ class TestPoolUnit:
         p = _StagingPool(max_bytes=1 << 20)
         a = p.acquire(100, np.float32)
         assert p.misses == 1 and p.hits == 0
+        base = a.base
+        ptr = a.__array_interface__["data"][0]
         p.release(a)
         b = p.acquire(100, np.float32)
-        assert b is a                   # warmed buffer reused
+        # warmed MEMORY reused (size-class binning hands out a fresh
+        # shaped view of the same raw class buffer)
+        assert b.base is base
+        assert b.__array_interface__["data"][0] == ptr
+        assert b.shape == (100,) and b.dtype == np.float32
         assert p.hits == 1
-        # different shape or dtype is a different key
-        c = p.acquire(101, np.float32)
-        d = p.acquire(100, np.float64)
-        assert c is not a and d is not a
-        assert p.misses == 3
+        # same size class, different shape: still a hit once the class
+        # bin is warm — that is the binning win over exact-key pooling
+        p.release(b)
+        c = p.acquire(101, np.float32)      # 404 bytes, same 512b class
+        assert c.shape == (101,) and p.hits == 2
+        # a different size class is a miss
+        d = p.acquire(100, np.float64)      # 800 bytes -> 1k class
+        assert p.misses == 2
+
+    def test_noncontiguous_release_warns_loudly(self, capsys):
+        p = _StagingPool(max_bytes=1 << 20)
+        arr = np.empty((8, 8), np.float32)
+        p.release(arr.T)                    # non-C-contiguous
+        err = capsys.readouterr().err
+        assert "non-C-contiguous" in err or "staging" in err
+        # warned ONCE per pool, not per call
+        p.release(arr.T)
+        assert capsys.readouterr().err == ""
+        # nothing was pooled from those releases
+        assert p.acquire(64, np.float32) is not None
+        assert p.hits == 0
 
     def test_views_never_pooled(self):
         p = _StagingPool()
@@ -32,6 +54,28 @@ class TestPoolUnit:
         p.release(a[:5])                # view: base owns the memory
         assert p.acquire(5, np.float32) is not None
         assert p.hits == 0
+
+    def test_foreign_double_release_never_aliases(self):
+        p = _StagingPool(max_bytes=1 << 20)
+        owner = np.empty(512, np.uint8)     # foreign owner, adopted
+        p.release(owner)
+        p.release(owner)                    # double release: dropped
+        a = p.acquire(512, np.uint8)
+        b = p.acquire(512, np.uint8)
+        assert a.__array_interface__["data"][0] != \
+            b.__array_interface__["data"][0]
+
+    def test_eviction_skips_bins_emptied_by_acquire(self):
+        # acquire drains a class bin; a later eviction walking the LRU
+        # order from the cold end must not trip over the empty bin
+        p = _StagingPool(max_bytes=1024)
+        a = p.acquire(256, np.uint8)        # 256b class
+        p.release(a)
+        p.acquire(256, np.uint8)            # empties the 256b bin
+        big = [p.acquire(512, np.uint8) for _ in range(4)]
+        for b in big:                       # forces eviction passes
+            p.release(b)
+        assert p._bytes <= 1024
 
     def test_lru_eviction_bound(self):
         p = _StagingPool(max_bytes=1000)
