@@ -1,0 +1,130 @@
+"""observability — diagnostics and counters resolve to declared sinks.
+
+Three contracts the observability stack depends on:
+
+1. **show_help keys register**: every ``show_help(topic, key, ...)`` with
+   literal arguments must have a matching ``register_help(topic, key,
+   template)`` somewhere in the package — otherwise the user sees the
+   raw ``[topic:key] k=v`` fallback instead of the written diagnostic.
+   (``register_help`` import aliases like ``_rh`` are followed.)
+
+2. **SPC counters declare**: every literal name passed to
+   ``spc.record``/``spc.read`` must appear in the ``_COUNTERS`` tuple of
+   ``runtime/spc.py`` — a typo'd counter silently counts into nothing
+   (record() drops unknown names by design).
+
+3. **Trace span begins close**: a ``t0 = trace.now()`` begin must be
+   consumed by a ``trace.span(...)``/``trace.hist_record(...)`` in the
+   same function on some path — an unconsumed begin is a span that never
+   closes (the PR 1 family: the timeline silently loses the operation).
+"""
+from __future__ import annotations
+
+import ast
+
+from ompi_tpu.analysis import (AnalysisPass, Finding, Package, call_name,
+                               const_str, register_pass)
+
+
+def _register_aliases(mod) -> set:
+    """Names that mean base.output.register_help in this module."""
+    names = {"register_help"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("output"):
+            for alias in node.names:
+                if alias.name == "register_help":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+@register_pass
+class ObservabilityPass(AnalysisPass):
+    name = "observability"
+    description = ("show_help keys resolve to registered templates, SPC "
+                   "counter names are declared in runtime/spc.py, "
+                   "trace.now() begins are consumed by a span")
+
+    def run(self, pkg: Package) -> list[Finding]:
+        registered: set[tuple] = set()
+        counters: set[str] = set()
+        counters_declared = False
+        for mod in pkg.modules:
+            aliases = _register_aliases(mod)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    fname = call_name(node)
+                    short = fname.rsplit(".", 1)[-1]
+                    if short in aliases and len(node.args) >= 2:
+                        topic = const_str(node.args[0])
+                        key = const_str(node.args[1])
+                        if topic and key:
+                            registered.add((topic, key))
+            if mod.path.replace("\\", "/").endswith("spc.py"):
+                for stmt in mod.tree.body:
+                    if isinstance(stmt, ast.Assign) \
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "_COUNTERS"
+                                    for t in stmt.targets) \
+                            and isinstance(stmt.value, (ast.Tuple, ast.List)):
+                        counters_declared = True
+                        for elt in stmt.value.elts:
+                            s = const_str(elt)
+                            if s:
+                                counters.add(s)
+        out: list[Finding] = []
+        for mod in pkg.modules:
+            for fn, qual in mod.functions():
+                out.extend(self._check_fn(mod, fn, qual, registered,
+                                          counters, counters_declared))
+        return out
+
+    def _check_fn(self, mod, fn, qual, registered, counters,
+                  counters_declared) -> list:
+        out = []
+        begins: dict[str, ast.AST] = {}
+        consumed: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and call_name(node.value).endswith("trace.now") \
+                        and isinstance(node.targets[0], ast.Name):
+                    begins[node.targets[0].id] = node
+                continue
+            name = call_name(node)
+            short = name.rsplit(".", 1)[-1]
+            if short == "show_help" and len(node.args) >= 2:
+                topic, key = const_str(node.args[0]), const_str(node.args[1])
+                if topic and key and (topic, key) not in registered:
+                    out.append(Finding(
+                        self.name, mod.path, node.lineno, node.col_offset,
+                        f"show_help('{topic}', '{key}') has no matching "
+                        "register_help — the user would see the raw "
+                        "fallback instead of the written diagnostic",
+                        qual))
+            elif name in ("spc.record", "spc.read") and node.args \
+                    and counters_declared:
+                cname = const_str(node.args[0])
+                if cname and cname not in counters:
+                    out.append(Finding(
+                        self.name, mod.path, node.lineno, node.col_offset,
+                        f"SPC counter '{cname}' is not declared in "
+                        "runtime/spc.py _COUNTERS — record() silently "
+                        "drops unknown names", qual))
+            elif short in ("span", "hist_record"):
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            consumed.add(sub.id)
+        # a begin consumed anywhere in the function (incl. inside a
+        # lambda's span call) closes; otherwise the span never ends
+        for tname, node in begins.items():
+            if tname not in consumed:
+                out.append(Finding(
+                    self.name, mod.path, node.lineno, node.col_offset,
+                    f"'{tname} = trace.now()' is never consumed by a "
+                    "trace.span/hist_record in this function — the span "
+                    "begins but never closes", qual))
+        return out

@@ -21,6 +21,7 @@ from typing import Optional, Union
 import numpy as np
 
 from ompi_tpu.datatype.core import Datatype
+from ompi_tpu.runtime.hotpath import hot_path
 
 # whole-element pack jobs at least this many bytes fan out over the
 # threads-framework worker pool instead of the single-thread native loop.
@@ -307,6 +308,7 @@ class Convertor:
         self.position = start + n
         return out
 
+    @hot_path
     def pack_borrow(self, max_bytes: Optional[int] = None):
         """Like :meth:`pack` but may return a zero-copy VIEW of the bound
         user buffer: ``(chunk, borrowed)``.  When ``borrowed`` is True the

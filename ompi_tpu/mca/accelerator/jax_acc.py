@@ -32,7 +32,8 @@ import numpy as np
 from ompi_tpu.base.containers import IntervalTree
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType, registry
-from ompi_tpu.runtime import spc, trace
+from ompi_tpu.runtime import sanitizer, spc, trace
+from ompi_tpu.runtime.hotpath import hot_path
 
 _rcache = IntervalTree()
 
@@ -82,9 +83,22 @@ class _StagingPool:
     the MCA vars.
     """
 
+    #: otpu-lint lock-discipline contract: every pool structure —
+    #: including the checkout table the double-release guard scans —
+    #: mutates only under the pool lock.  The lint pass found _checkout
+    #: inserting into _out OUTSIDE the lock: between acquire's unlock
+    #: and the insert, a concurrent double release of the same adopted
+    #: owner passed the guard (its bytes looked neither free nor
+    #: checked out) and repooled memory that was in use — exactly the
+    #: PR 4 aliasing family.  The lock is an RLock because the weakref
+    #: purge callback can fire from GC while the owning thread already
+    #: holds it.
+    _guarded_by = {"_free": "_lock", "_out": "_lock", "_adopted": "_lock",
+                   "_bytes": "_lock", "hits": "_lock", "misses": "_lock"}
+
     def __init__(self, max_bytes: Optional[int] = None,
                  enabled: Optional[bool] = None) -> None:
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         # size class -> deque of raw uint8 owner arrays (LIFO: the back
         # is the most recently released = warmest pages)
         self._free: OrderedDict[int, deque] = OrderedDict()
@@ -137,11 +151,25 @@ class _StagingPool:
             if shape else np.dtype(dtype).itemsize
         view = raw[:nbytes].view(dtype).reshape(shape)
         token = id(view)
-        self._out[token] = (
-            weakref.ref(view, lambda _r, t=token: self._out.pop(t, None)),
-            raw)
+        with self._lock:
+            # the insert must be visible BEFORE the pool lock is ever
+            # released with raw popped from its free bin: release()'s
+            # double-release guard scans _out under the lock, and an
+            # entry registered after the unlock left a window where the
+            # owner looked neither free nor checked out
+            self._out[token] = (
+                weakref.ref(view, lambda _r, t=token: self._purge(t)),
+                raw)
         return view
 
+    def _purge(self, token: int) -> None:
+        """Weakref callback: a checked-out view died unreleased.  Runs
+        under the pool lock (RLock: GC may fire it while the owning
+        thread already holds the lock)."""
+        with self._lock:
+            self._out.pop(token, None)
+
+    @hot_path
     def acquire(self, shape, dtype) -> np.ndarray:
         if isinstance(shape, (int, np.integer)):
             shape = (int(shape),)
@@ -153,6 +181,7 @@ class _StagingPool:
             else dtype.itemsize
         cls = self._class_of(nbytes)
         t0 = time.perf_counter_ns() if trace.enabled else 0
+        out = None
         with self._lock:
             dq = self._free.get(cls)
             if dq:
@@ -165,26 +194,42 @@ class _StagingPool:
                     self._adopted.discard(id(raw.base))
                 self._bytes -= raw.nbytes
                 self.hits += 1
+                # checkout registration in the SAME critical section as
+                # the free-bin pop (the RLock re-enters in _checkout):
+                # a popped owner must never be observable as neither
+                # free nor checked out, or a stale concurrent release of
+                # the same owner slips past the double-release guard and
+                # repools bytes that are in use
+                out = self._checkout(raw, shape, dtype)
             else:
                 raw = None
                 self.misses += 1
-        hit = raw is not None
+        hit = out is not None
         if hit:
             spc.record("fastpath_staging_hits")
         else:
             spc.record("fastpath_staging_misses")
+            # fresh allocation OUTSIDE the lock (first-touch page faults
+            # are the expensive part); the owner was never pooled, so
+            # nothing can race its checkout registration
             raw = np.empty(cls, np.uint8)
-        out = self._checkout(raw, shape, dtype)
+            out = self._checkout(raw, shape, dtype)
         if trace.enabled:
             name = "staging_hit" if hit else "staging_miss"
             trace.span(name, "staging", t0, args={"nbytes": nbytes})
             trace.hist_record(name, nbytes, time.perf_counter_ns() - t0)
         return out
 
+    @hot_path
     def release(self, buf: np.ndarray) -> None:
         if not self.enabled:
             return
         if not buf.flags.c_contiguous:
+            if sanitizer.enabled:
+                sanitizer.fail(
+                    "non-C-contiguous buffer released to the staging "
+                    f"pool (shape {tuple(buf.shape)}, dtype {buf.dtype})"
+                    " — layout bug in the caller")
             # fastpath satellite: this used to vanish silently, leaking
             # the buffer from the pool's accounting — warn loudly once
             # (per-pool) so the caller's layout bug is visible
@@ -195,6 +240,10 @@ class _StagingPool:
                 show_help("help-accel-staging", "non-contiguous-release",
                           shape=tuple(buf.shape), dtype=str(buf.dtype))
             return
+        with self._lock:
+            self._release_locked(buf)
+
+    def _release_locked(self, buf: np.ndarray) -> None:
         entry = self._out.pop(id(buf), None)
         if entry is not None and entry[0]() is buf:
             raw = entry[1]              # pool view: repool its raw owner
@@ -217,35 +266,40 @@ class _StagingPool:
         if raw.nbytes > self.max_bytes:
             return   # could never be retained — and pushing it through
                      # the LRU would flush every warm buffer first
-        with self._lock:
-            if raw.base is not None and (
-                    id(raw.base) in self._adopted
-                    or any(e[1].base is raw.base
-                           for e in list(self._out.values()))):
-                return   # double release: the owner is already in a
-                         # free bin, or its bytes are checked out right
-                         # now (re-released after an acquire popped it)
-                         # — repooling would alias two later acquires.
-                         # Both checks live under the lock so racing
-                         # releases cannot all pass them.
-            dq = self._free.get(cls)
-            if dq is None:
-                dq = self._free[cls] = deque()
-            dq.append(raw)
-            if raw.base is not None:            # adopted foreign owner
-                self._adopted.add(id(raw.base))
-            self._free.move_to_end(cls)
-            self._bytes += raw.nbytes
-            # evict ONE cold buffer at a time from the least-recently-
-            # used class — never the hot class we just touched
-            while self._bytes > self.max_bytes and self._free:
-                cold_cls, cold = next(iter(self._free.items()))
-                victim = cold.popleft()      # front = coldest
-                if victim.base is not None:
-                    self._adopted.discard(id(victim.base))
-                self._bytes -= victim.nbytes
-                if not cold:
-                    del self._free[cold_cls]
+        if raw.base is not None and (
+                id(raw.base) in self._adopted
+                or any(e[1].base is raw.base
+                       for e in list(self._out.values()))):
+            # double release: the owner is already in a free bin, or
+            # its bytes are checked out right now (re-released after
+            # an acquire popped it) — repooling would alias two
+            # later acquires.  Both checks run under the pool lock
+            # (held by release) so racing releases cannot all pass.
+            if sanitizer.enabled:
+                sanitizer.fail(
+                    "double release of a staging owner buffer "
+                    f"({raw.nbytes} bytes): already pooled or "
+                    "checked out — repooling would alias two "
+                    "later acquires")
+            return
+        dq = self._free.get(cls)
+        if dq is None:
+            dq = self._free[cls] = deque()
+        dq.append(raw)
+        if raw.base is not None:            # adopted foreign owner
+            self._adopted.add(id(raw.base))
+        self._free.move_to_end(cls)
+        self._bytes += raw.nbytes
+        # evict ONE cold buffer at a time from the least-recently-
+        # used class — never the hot class we just touched
+        while self._bytes > self.max_bytes and self._free:
+            cold_cls, cold = next(iter(self._free.items()))
+            victim = cold.popleft()      # front = coldest
+            if victim.base is not None:
+                self._adopted.discard(id(victim.base))
+            self._bytes -= victim.nbytes
+            if not cold:
+                del self._free[cold_cls]
 
     def clear(self) -> None:
         with self._lock:

@@ -24,6 +24,7 @@ from typing import Optional
 from ompi_tpu.base.containers import Fifo
 from ompi_tpu.base.var import VarType
 from ompi_tpu.mca.btl.base import Btl, Endpoint, Frag, owned_bytes
+from ompi_tpu.runtime.hotpath import hot_path
 
 _HDR = struct.Struct("<QQ")  # head, tail
 _LEN = struct.Struct("<I")
@@ -316,6 +317,7 @@ class SmBtl(Btl):
                 self._db_addr[rank] = info["db"]
         return ring
 
+    @hot_path
     def send(self, ep: Endpoint, frag: Frag) -> None:
         ring = self._ring_to(ep.world_rank, ep.addr)
         hdr = _frame_hdr(frag)
@@ -327,6 +329,7 @@ class SmBtl(Btl):
                 (hdr, owned_bytes(frag.data)))
         self._ring_doorbell(ep.world_rank, ep.addr)
 
+    @hot_path
     def progress(self) -> int:
         events = 0
         # drain doorbell pings (edge signal only; frames carry the data)

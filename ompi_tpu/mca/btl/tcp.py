@@ -47,7 +47,8 @@ from typing import Optional
 from ompi_tpu.base.var import VarType
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, \
     Btl, Endpoint, Frag
-from ompi_tpu.runtime import spc, trace
+from ompi_tpu.runtime import sanitizer, spc, trace
+from ompi_tpu.runtime.hotpath import hot_path
 
 _LEN = struct.Struct("!I")
 _MAX_FRAME = (1 << 32) - 1          # the !I length prefix's ceiling
@@ -99,6 +100,11 @@ class _Conn:
     #: out of it, so bigger = more frames per syscall)
     SCRATCH = 1 << 18
 
+    #: otpu-lint lock-discipline contract: the out-queue and its byte
+    #: count mutate only under send_lock (helpers named *_locked run
+    #: with it held by the caller)
+    _guarded_by = {"outq": "send_lock", "out_bytes": "send_lock"}
+
     def __init__(self, sock: socket.socket, rank: Optional[int] = None):
         self.sock = sock
         self.rank = rank
@@ -131,6 +137,16 @@ class TcpBtl(Btl):
     latency = 100
     bandwidth = 100
 
+    #: otpu-lint lock-discipline contract.  _by_rank is mutated from app
+    #: threads (connect, flush hard-error drop), the progress thread
+    #: (EOF drop, handshake append), and close(): every mutation takes
+    #: _conns_lock — the otpu-lint pass found the unguarded remove/
+    #: extend races this declaration now pins.  Reads stay lock-free
+    #: snapshots (GIL-atomic dict get; _pick tolerates a concurrently
+    #: shrunk list).
+    _guarded_by = {"_by_rank": "_conns_lock",
+                   "_connect_locks": "_locks_guard"}
+
     def __init__(self) -> None:
         super().__init__()
         self._rte = None
@@ -139,6 +155,7 @@ class TcpBtl(Btl):
         # multi-link (btl_tcp_links): several connections per peer, frames
         # round-robined across them — the reference's per-link striping
         self._by_rank: dict[int, list[_Conn]] = {}
+        self._conns_lock = threading.Lock()
         self._rr: dict[int, int] = {}
         self._links = 1
         self._addr_cache: dict[int, tuple] = {}
@@ -264,9 +281,14 @@ class TcpBtl(Btl):
                 conns.append(conn)
             self._connect_backoff.pop(rank, None)
             # MERGE, never assign: _drain's handshake path may have
-            # appended accepted reply rails for this rank concurrently
-            self._by_rank.setdefault(rank, []).extend(conns)
-            return self._pick(rank, self._by_rank[rank])
+            # appended accepted reply rails for this rank concurrently.
+            # Pick from the list captured UNDER the lock — a re-read
+            # after release could KeyError if the progress thread
+            # dropped the rail (EOF on the fresh socket) in between.
+            with self._conns_lock:
+                merged = self._by_rank.setdefault(rank, [])
+                merged.extend(conns)
+            return self._pick(rank, merged)
 
     def _pick(self, rank: int, conns: list) -> _Conn:
         """Round-robin link selection (frames are self-contained; pml
@@ -279,6 +301,7 @@ class TcpBtl(Btl):
             # the progress thread dropped the last link concurrently
             raise ConnectionError(f"no live tcp links to rank {rank}")
 
+    @hot_path
     def send(self, ep: Endpoint, frag: Frag) -> None:
         # FT control traffic is best-effort: it honours connect backoff
         # and, when flagged, only rides ALREADY-established connections
@@ -350,7 +373,17 @@ class TcpBtl(Btl):
                 # borrowed views die with this call).  Only the queued
                 # REMAINDER is copied — the common uncongested case
                 # stays zero-copy end to end.
-                self._own_queued(conn, queued)
+                self._own_queued_locked(conn, queued)
+            if sanitizer.enabled and frag.borrowed:
+                # ownership tag: after a borrowed send returns, no queue
+                # entry may still alias the caller's memory
+                owner = payload.obj if isinstance(payload, memoryview) \
+                    else payload
+                for mv in conn.outq:
+                    if getattr(mv, "obj", None) is owner:
+                        sanitizer.fail(
+                            "btl/tcp out-queue still aliases a borrowed "
+                            "payload after send() returned")
 
     @staticmethod
     def _frame_too_large(nbytes: int) -> ValueError:
@@ -367,7 +400,7 @@ class TcpBtl(Btl):
             f"limit ({_MAX_FRAME}); fragment the payload below "
             "btl.max_send_size")
 
-    def _own_queued(self, conn: _Conn, tail: int) -> None:
+    def _own_queued_locked(self, conn: _Conn, tail: int) -> None:
         """Own the newest ``tail`` queue entries (send_lock held).
 
         Only the fragment queued by the current send can alias its
@@ -391,6 +424,7 @@ class TcpBtl(Btl):
         with conn.send_lock:
             self._flush_locked(conn)
 
+    @hot_path
     def _flush_locked(self, conn: _Conn) -> None:
         """Drain the out-queue with sendmsg scatter-gather; on EAGAIN
         with bytes left, register for writability instead of retrying —
@@ -447,6 +481,7 @@ class TcpBtl(Btl):
         conn.want_write = want
 
     # -- progress --------------------------------------------------------
+    @hot_path
     def progress(self) -> int:
         events = 0
         try:
@@ -500,13 +535,18 @@ class TcpBtl(Btl):
         return [c for conns in self._by_rank.values() for c in conns]
 
     def _drop_conn(self, conn: "_Conn") -> None:
+        # under _conns_lock: the app thread (flush hard error) and the
+        # progress thread (EOF) can race this remove against _connect's
+        # extend / the handshake append — the unguarded list mutation
+        # was an otpu-lint lock-discipline finding
         if conn.rank is None:
             return
-        conns = self._by_rank.get(conn.rank)
-        if conns and conn in conns:
-            conns.remove(conn)
-            if not conns:
-                self._by_rank.pop(conn.rank, None)
+        with self._conns_lock:
+            conns = self._by_rank.get(conn.rank)
+            if conns and conn in conns:
+                conns.remove(conn)
+                if not conns:
+                    self._by_rank.pop(conn.rank, None)
 
     @staticmethod
     def _need(inbuf) -> int:
@@ -516,6 +556,7 @@ class TcpBtl(Btl):
         (fl,) = _LEN.unpack_from(inbuf, 0)
         return max(0, _LEN.size + fl - len(inbuf))
 
+    @hot_path
     def _on_bytes(self, conn: _Conn, view: memoryview) -> int:
         """Parse one recv's worth of stream bytes.
 
@@ -544,6 +585,9 @@ class TcpBtl(Btl):
             # fast path: complete frames straight from the scratch view
             while n - pos >= _LEN.size:
                 (fl,) = _LEN.unpack_from(view, pos)
+                if sanitizer.enabled and fl < 1:
+                    sanitizer.fail("btl/tcp framing desync: zero-length "
+                                   "frame on the wire")
                 if n - pos < _LEN.size + fl:
                     break
                 frame = view[pos + _LEN.size:pos + _LEN.size + fl]
@@ -561,6 +605,7 @@ class TcpBtl(Btl):
                 conn.inbuf += view[pos:]
         return events
 
+    @hot_path
     def _drain(self, conn: _Conn) -> int:
         """Parse complete frames off the in-buffer (split-frame
         reassembly; the streaming path is :meth:`_on_bytes`).  The
@@ -575,6 +620,9 @@ class TcpBtl(Btl):
                 if len(buf) - pos < _LEN.size:
                     return events
                 (n,) = _LEN.unpack_from(buf, pos)
+                if sanitizer.enabled and n < 1:
+                    sanitizer.fail("btl/tcp framing desync: zero-length "
+                                   "frame in the reassembly buffer")
                 if len(buf) - pos < _LEN.size + n:
                     return events
                 frame = bytes(memoryview(buf)[pos + _LEN.size:
@@ -610,7 +658,8 @@ class TcpBtl(Btl):
         if isinstance(obj, dict) and "rank" in obj and conn.rank is None:
             conn.rank = obj["rank"]
             # accepted links become reply rails for this rank too
-            self._by_rank.setdefault(conn.rank, []).append(conn)
+            with self._conns_lock:
+                self._by_rank.setdefault(conn.rank, []).append(conn)
             return None
         cid, src, dst, tag, seq, kind, total_len, offset, meta = obj
         return Frag(cid, src, dst, tag, seq, kind,
@@ -644,7 +693,8 @@ class TcpBtl(Btl):
                 key.fileobj.close()
             except (OSError, KeyError):
                 pass
-        self._by_rank.clear()
+        with self._conns_lock:
+            self._by_rank.clear()
         if self._listener is not None:
             progress_mod.unregister_waiter(self._listener)
             try:

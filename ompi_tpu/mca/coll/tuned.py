@@ -29,6 +29,7 @@ from ompi_tpu.base.var import VarType
 from ompi_tpu.mca.coll import algorithms as algs
 from ompi_tpu.mca.coll.basic import BasicCollModule
 from ompi_tpu.runtime import spc
+from ompi_tpu.runtime.hotpath import hot_path
 
 _MENUS = {
     "allreduce": algs.ALLREDUCE,
@@ -99,6 +100,7 @@ class TunedModule:
         return fn(*args, **kw)
 
     # -- fixed ladders (decision_fixed.c shape, TPU-host re-derivation) --
+    @hot_path
     def allreduce(self, comm, sendbuf, op=op_mod.SUM):
         nbytes = _nbytes(sendbuf)
         # SPC-counted small-message eager lane: below the threshold the

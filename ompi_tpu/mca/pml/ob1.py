@@ -29,6 +29,7 @@ from ompi_tpu.datatype import Convertor
 from ompi_tpu.mca.bml import Bml
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, Frag
 from ompi_tpu.runtime import peruse, spc, trace
+from ompi_tpu.runtime.hotpath import hot_path
 
 
 class SendRequest(Request):
@@ -113,6 +114,11 @@ class _MatchState:
 class Ob1Pml:
     """The pml module (one per process)."""
 
+    #: otpu-lint lock-discipline contract: the matching table mutates
+    #: only under the pml lock (app threads post/cancel recvs while the
+    #: progress thread delivers frags into the same queues)
+    _guarded_by = {"_match": "_lock"}
+
     def __init__(self, component: "Ob1Component", rte) -> None:
         self.component = component
         self.rte = rte
@@ -193,6 +199,7 @@ class Ob1Pml:
             req.complete(err)
 
     # -- send path (pml_ob1_isend.c:233) --------------------------------
+    @hot_path
     def isend(self, comm, buf, dest: int, tag: int,
               sync: bool = False) -> Request:
         """``sync=True`` gives MPI_Ssend semantics: completion only after
@@ -486,6 +493,7 @@ class Ob1Pml:
         return False
 
     # -- fragment delivery (pml_ob1_recvfrag.c:450) ----------------------
+    @hot_path
     def _recv_frag(self, frag: Frag) -> None:
         if frag.kind == ACK:
             req = self._send_reqs.get(frag.meta["req_id"])
@@ -729,6 +737,7 @@ class Ob1Pml:
         if req is not None:
             self._stream_rest(req, frag)
 
+    @hot_path
     def _recv_data_frag(self, frag: Frag) -> None:
         req = self._recv_reqs.get(frag.meta["req_id"])
         if req is None:

@@ -46,6 +46,23 @@ def _recv_frame(sock: socket.socket) -> Any:
 class CoordServer:
     """Job-wide KV + fence + event service (runs inside the launcher)."""
 
+    #: otpu-lint lock-discipline contract: each service table mutates
+    #: only under its condition/lock.  The declaration also arms the
+    #: no-blocking-under-lock check: per-connection replies
+    #: (``_send_frame`` = blocking sendall) must never run while a
+    #: condition is held — one slow-reading client would stall every
+    #: fence/KV/event operation job-wide (helpers named *_locked run
+    #: with the lock held by the caller).
+    _guarded_by = {
+        "_kv": "_kv_cond", "_psets": "_kv_cond",
+        "_next_rank": "_kv_cond", "_spawn_seq": "_kv_cond",
+        "_fence_ranks": "_fence_cond", "_fence_gen": "_fence_cond",
+        "_fence_done": "_fence_cond", "_fence_expect": "_fence_cond",
+        "_failed": "_fence_cond",
+        "_events": "_event_cond", "_event_seq": "_event_cond",
+        "_conns": "_conns_lock",
+    }
+
     def __init__(self, nprocs: int, host: str = "127.0.0.1", port: int = 0):
         self.nprocs = nprocs
         self._kv: dict[tuple, Any] = {}
@@ -164,23 +181,30 @@ class CoordServer:
                         # fence while a live survivor is still outside it
                         oneshot = bool(req.get("oneshot"))
                         if oneshot and fid in self._fence_done:
-                            # late arrival to a completed one-shot round
-                            _send_frame(conn, {"ok": True})
-                            continue
-                        arrived = self._fence_ranks.setdefault(fid, set())
-                        arrived.add(req.get("rank", -1))
-                        if self._fence_satisfied(fid):
-                            self._complete_fence(fid, oneshot)
+                            # late arrival to a completed one-shot round:
+                            # fall through to the reply OUTSIDE the cond —
+                            # otpu-lint found the blocking sendall here
+                            # while _fence_cond was held, where one
+                            # slow-reading late client stalled every
+                            # fence/failure operation job-wide
+                            pass
                         else:
-                            gen = self._fence_gen.get(fid, 0)
-                            while self._fence_gen.get(fid, 0) == gen:
-                                self._fence_cond.wait(1.0)
-                                if self._aborted is not None:
-                                    break
-                                # a failure may have lowered the bar
-                                if self._fence_satisfied(fid):
-                                    self._complete_fence(fid, oneshot)
-                                    break
+                            arrived = self._fence_ranks.setdefault(
+                                fid, set())
+                            arrived.add(req.get("rank", -1))
+                            if self._fence_satisfied(fid):
+                                self._complete_fence_locked(fid, oneshot)
+                            else:
+                                gen = self._fence_gen.get(fid, 0)
+                                while self._fence_gen.get(fid, 0) == gen:
+                                    self._fence_cond.wait(1.0)
+                                    if self._aborted is not None:
+                                        break
+                                    # a failure may have lowered the bar
+                                    if self._fence_satisfied(fid):
+                                        self._complete_fence_locked(
+                                            fid, oneshot)
+                                        break
                     _send_frame(conn, {"ok": True})
                 elif op == "event_pub":
                     # routed through publish() so in-band failure reports
@@ -257,7 +281,7 @@ class CoordServer:
         expected = self._fence_expect.get(fid, range(self.nprocs))
         return all(r in arrived or r in self._failed for r in expected)
 
-    def _complete_fence(self, fid: str, oneshot: bool = False) -> None:
+    def _complete_fence_locked(self, fid: str, oneshot: bool = False) -> None:
         # caller holds _fence_cond.  One-shot fences (finalize) record
         # completion permanently: a rank arriving LATE — released peers
         # treated it as failed (e.g. its heartbeats stopped but the
@@ -300,7 +324,7 @@ class CoordServer:
                 # a pending fence may now be satisfiable by the survivors
                 for fid in list(self._fence_ranks):
                     if self._fence_ranks[fid] and self._fence_satisfied(fid):
-                        self._complete_fence(fid)
+                        self._complete_fence_locked(fid)
             # dynamic pset: the named surviving set the ULFM recovery
             # loop rebuilds from (world minus every known failure)
             with self._kv_cond:

@@ -1,0 +1,31 @@
+"""@hot_path — the allocation-budget tag for runtime hot functions.
+
+The decorator is IDENTITY at runtime: it records the function's qualified
+name in a registry (decoration-time cost only) and returns the function
+object unchanged, so a tagged hot loop carries zero wrapper overhead —
+pinned by ``test_perf_guard.test_sanitizer_off_zero_overhead``.
+
+Its value is static: ``otpu-lint``'s hot-path pass checks every tagged
+function against the allocation budget (no pickle / format-string /
+list-concat, no bare ``struct.error``), and the registry lets tooling
+(``otpu_info --lint``, debuggers) enumerate what the project considers
+hot.  Tag the functions that run per message or per progress tick:
+progress-loop drain, btl send/recv/framing, convertor pack, coll
+dispatch, staging checkout.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, str] = {}   # qualified name -> defining module
+
+
+def hot_path(fn: Callable) -> Callable:
+    """Tag ``fn`` as a runtime hot path (identity; see module docstring)."""
+    _REGISTRY[f"{fn.__module__}.{fn.__qualname__}"] = fn.__module__
+    return fn
+
+
+def registered() -> dict[str, str]:
+    """{qualified name: module} of every imported @hot_path function."""
+    return dict(_REGISTRY)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from ompi_tpu.base.var import VarType, registry
+from ompi_tpu.runtime import sanitizer
 
 _enable_var = registry.register(
     "memchecker", None, "enable", vtype=VarType.BOOL, default=False,
@@ -26,7 +27,9 @@ _enable_var = registry.register(
 
 
 def enabled() -> bool:
-    return bool(_enable_var.value)
+    # OTPU_SANITIZE=1 force-enables the guard: the sanitizer mode turns
+    # every ownership invariant — this one included — into a hard check
+    return bool(_enable_var.value) or sanitizer.enabled
 
 
 def protect_send(req, buf) -> None:

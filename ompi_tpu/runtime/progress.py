@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Callable
 
+from ompi_tpu.runtime.hotpath import hot_path
+
 _LOW_PRIORITY_CADENCE = 8  # opal_progress.c:227
 
 _lock = threading.RLock()
@@ -22,6 +24,11 @@ _callbacks: list[Callable[[], int]] = []
 _lp_callbacks: list[Callable[[], int]] = []
 _counter = 0
 _in_progress = threading.local()
+
+#: otpu-lint lock-discipline contract: callback lists, the cadence
+#: counter, and the waiter registry mutate only under the module lock
+_GUARDED_BY = {"_callbacks": "_lock", "_lp_callbacks": "_lock",
+               "_counter": "_lock", "_waiter_count": "_lock"}
 
 # -- event-based idle wait (the libevent role in opal_progress) ----------
 #
@@ -79,6 +86,7 @@ def unregister(cb: Callable[[], int]) -> None:
                 target.remove(cb)
 
 
+@hot_path
 def progress() -> int:
     """Poll all registered callbacks once; returns events progressed."""
     global _counter

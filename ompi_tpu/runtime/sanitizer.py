@@ -1,0 +1,37 @@
+"""OTPU_SANITIZE=1 — the runtime half of the otpu-lint invariants.
+
+Static passes prove what is provable from source; this mode turns the
+*dynamic* ownership invariants into hard assertions for the fuzz
+workers:
+
+- the staging pool's ownership tags: a double release (the PR 4 aliasing
+  family) or a non-contiguous release raises :class:`SanitizeError`
+  instead of being silently tolerated,
+- the tcp wire's borrowed contract: after a borrowed send returns, no
+  out-queue entry may still alias the caller's buffer; inbound framing
+  asserts frame sanity before parse (a desynced stream fails at the
+  first bad length, not three messages later),
+- ``runtime/memchecker.py`` is force-enabled, so writing into a buffer
+  MPI still owns fails at the racy write.
+
+Cost contract: ``enabled`` is a module bool read once at import from the
+environment; every check site is on a cold/error path or behind an
+``if sanitizer.enabled`` branch the default-off mode never enters.  The
+decorator/hook structure compiles out to no-ops when off — pinned by
+``test_perf_guard.test_sanitizer_off_zero_overhead``.  Tests may flip
+``sanitizer.enabled`` directly (consumers read it at use time).
+"""
+from __future__ import annotations
+
+import os
+
+#: read once at import; tpurun-spawned ranks inherit the launcher's env
+enabled = os.environ.get("OTPU_SANITIZE", "").strip() not in ("", "0")
+
+
+class SanitizeError(AssertionError):
+    """An ownership/framing invariant the sanitizer enforces was broken."""
+
+
+def fail(msg: str) -> None:
+    raise SanitizeError(msg)

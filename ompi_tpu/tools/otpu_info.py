@@ -95,6 +95,11 @@ def main(argv=None) -> int:
                     help="Machine-readable colon-separated output")
     ap.add_argument("--pvars", action="store_true",
                     help="Show performance variables (MPI_T pvar analog)")
+    ap.add_argument("--lint", action="store_true",
+                    help="Show registered otpu-lint analysis passes "
+                         "(the invariant families the static analyzer "
+                         "enforces; run them with ompi_tpu.tools"
+                         ".otpu_lint)")
     ap.add_argument("--psets", action="store_true",
                     help="Show the process sets the coordination service "
                          "advertises (name, size, membership source) — "
@@ -164,6 +169,15 @@ def main(argv=None) -> int:
 
         for line in hwloc.summary().splitlines():
             out.append(_fmt("topo", line.strip(), p))
+
+    if args.all or args.lint:
+        # the PR 2 dynamic-scan convention: enumerate the registry, never
+        # a hand-kept list — a pass added later shows up automatically
+        from ompi_tpu import analysis
+
+        for lint_pass in analysis.all_passes():
+            out.append(_fmt(f"lint pass {lint_pass.name}",
+                            lint_pass.description, p))
 
     if args.all or args.psets:
         for pname, size, source in _pset_rows():

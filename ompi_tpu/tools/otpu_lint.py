@@ -1,0 +1,112 @@
+"""otpu_lint — CLI front-end for the invariant-encoding static analyzer.
+
+Usage::
+
+    python -m ompi_tpu.tools.otpu_lint [paths...] [--list] [--parsable]
+        [--select pass1,pass2] [--suppressions FILE | --no-suppressions]
+        [--write-suppressions FILE]
+
+Defaults: paths = ``ompi_tpu`` (the package), suppressions =
+``lint_suppressions.txt`` in the current directory when present (the
+checked-in baseline the CI gate uses).  Exit status 0 means no
+unsuppressed findings and no parse errors; 1 otherwise.  Unused baseline
+entries are reported (and fail the run) so the suppressions file can
+only shrink — a fixed finding must take its baseline entry with it.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+DEFAULT_SUPPRESSIONS = "lint_suppressions.txt"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="otpu_lint",
+        description="Run the otpu-lint invariant passes over source trees")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="Files or directories (default: the ompi_tpu "
+                         "package)")
+    ap.add_argument("--list", action="store_true",
+                    help="List registered analysis passes and exit")
+    ap.add_argument("--select", metavar="PASSES",
+                    help="Comma-separated pass names to run (default all)")
+    ap.add_argument("--suppressions", metavar="FILE",
+                    help=f"Baseline file (default: ./{DEFAULT_SUPPRESSIONS} "
+                         "when present)")
+    ap.add_argument("--no-suppressions", action="store_true",
+                    help="Ignore any baseline file")
+    ap.add_argument("--write-suppressions", metavar="FILE",
+                    help="Write current findings as a baseline (each "
+                         "generated entry still needs a justification "
+                         "comment) and exit 0")
+    ap.add_argument("--parsable", action="store_true",
+                    help="Machine-readable colon-separated output")
+    args = ap.parse_args(argv)
+
+    from ompi_tpu import analysis
+
+    if args.list:
+        for p in analysis.all_passes():
+            if args.parsable:
+                print(f"{p.name}:{p.description}")
+            else:
+                print(f"{p.name + ':':<18} {p.description}")
+        return 0
+
+    paths = args.paths or ["ompi_tpu"]
+    select = [s.strip() for s in args.select.split(",") if s.strip()] \
+        if args.select else None
+
+    sup = None
+    if not args.no_suppressions and args.write_suppressions is None:
+        sup_path = args.suppressions or DEFAULT_SUPPRESSIONS
+        if args.suppressions or os.path.exists(sup_path):
+            try:
+                sup = analysis.Suppressions.load(sup_path)
+            except ValueError as exc:
+                print(f"otpu-lint: {exc}", file=sys.stderr)
+                return 1
+
+    try:
+        result = analysis.lint(paths, select=select, suppressions=sup)
+    except KeyError as exc:
+        print(f"otpu-lint: {exc.args[0]}", file=sys.stderr)
+        return 1
+
+    if args.write_suppressions is not None:
+        text = analysis.Suppressions.render(result.findings)
+        with open(args.write_suppressions, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"otpu-lint: wrote {len(result.findings)} baseline "
+              f"entr{'y' if len(result.findings) == 1 else 'ies'} to "
+              f"{args.write_suppressions}")
+        return 0
+
+    failures = 0
+    for f in result.errors + result.findings:
+        print(f.format(args.parsable))
+        failures += 1
+    # unused entries are reported only when this run could have proved
+    # them stale (their rule ran over their file): a partial run —
+    # subset paths or --select — must not demand baseline edits it
+    # cannot justify
+    unused = result.unused_suppressions(sup) if sup is not None else []
+    for e in unused:
+        print(f"{sup.path}:{e.line_no}: unused suppression "
+              f"'{e.rule} {e.path}{':' + e.symbol if e.symbol else ''}' "
+              "— the finding is gone, remove the entry")
+        failures += 1
+    if not args.parsable:
+        print(f"otpu-lint: {len(result.findings)} finding(s), "
+              f"{len(result.suppressed)} suppressed, "
+              f"{len(result.errors)} parse error(s), "
+              f"{len(unused)} unused suppression(s) "
+              f"[{result.passes} passes over {result.files} files]")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""Known-bad: borrowed views escaping the producing frame."""
+
+
+class Sender:
+    def stash_on_self(self, conv):
+        data, borrowed = conv.pack_borrow()
+        self.saved = data               # BAD: stored on self
+
+    def queue_on_self(self, conv):
+        chunk = conv.pack_borrow(4096)
+        self.pending.append(chunk)      # BAD: queued on a self container
+
+    def hand_back(self, conv):
+        data, borrowed = conv.pack_borrow()
+        return data                     # BAD: returned un-owned
+
+    def stash_on_param(self, conv, conn):
+        frame = ring.pop_frame()
+        conn.frames.append(frame)       # BAD: queued on a parameter

@@ -1,0 +1,25 @@
+"""Known-good twin: owned copies may escape; call args may borrow."""
+
+
+class Sender:
+    def stash_owned(self, conv):
+        data, borrowed = conv.pack_borrow()
+        self.saved = bytes(data)        # owning copy: fine
+
+    def queue_owned(self, conv):
+        chunk = conv.pack_borrow(4096)
+        self.pending.append(chunk.tobytes())   # owned: fine
+
+    def hand_back_owned(self, conv):
+        data, borrowed = conv.pack_borrow()
+        return data.toreadonly()        # sanctioned per convention
+
+    def pass_through(self, conv, btl, ep):
+        data, borrowed = conv.pack_borrow()
+        btl.send(ep, data)              # call arg: callee's contract
+
+    def local_list(self, conv):
+        data, borrowed = conv.pack_borrow()
+        bufs = []
+        bufs.append(data)               # local container: frame-scoped
+        return len(bufs)

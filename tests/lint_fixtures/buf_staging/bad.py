@@ -1,0 +1,16 @@
+"""Known-bad: staging acquire/release pairs broken."""
+from ompi_tpu.mca.accelerator import jax_acc
+
+
+def leaks(n):
+    tmp = jax_acc.staging_acquire(n, "uint8")
+    tmp[:] = 0                          # BAD: never released/returned/stored
+
+
+def early_return(comm, n):
+    tmp = jax_acc.staging_acquire(n, "float32")
+    if comm.size == 1:
+        return None                     # BAD: skips the release below
+    tmp[:] = 1
+    jax_acc.staging_release(tmp)
+    return True

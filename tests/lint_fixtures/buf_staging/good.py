@@ -1,0 +1,26 @@
+"""Known-good twin: try/finally pairing, ownership transfer."""
+from ompi_tpu.mca.accelerator import jax_acc
+
+
+def paired(comm, n):
+    if comm.size == 1:
+        return None                     # before the acquire: fine
+    tmp = jax_acc.staging_acquire(n, "float32")
+    try:
+        tmp[:] = 1
+        if comm.rank == 0:
+            return 0                    # finally still releases
+        return 1
+    finally:
+        jax_acc.staging_release(tmp)
+
+
+def transfers(n):
+    tmp = jax_acc.staging_acquire(n, "uint8")
+    return tmp                          # ownership moves to the caller
+
+
+class Holder:
+    def adopts(self, n):
+        tmp = jax_acc.staging_acquire(n, "uint8")
+        self.scratch = tmp              # ownership moves onto self

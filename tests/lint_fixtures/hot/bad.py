@@ -1,0 +1,22 @@
+"""Known-bad: @hot_path functions breaking the allocation budget."""
+import pickle
+import struct
+
+from ompi_tpu.runtime.hotpath import hot_path
+
+
+@hot_path
+def send_slow(frag):
+    hdr = pickle.dumps(frag.meta)       # BAD: pickle on the hot path
+    label = f"frag {frag.seq}"          # BAD: f-string
+    tag = "t{}".format(frag.tag)        # BAD: str.format
+    note = "seq %d" % frag.seq          # BAD: %-formatting
+    bufs = [hdr] + [label]              # BAD: list concatenation
+    return bufs, tag, note
+
+
+@hot_path
+def bad_raise(buf):
+    if len(buf) > 1 << 20:
+        raise struct.error("too big")   # BAD: bare struct.error
+    return buf

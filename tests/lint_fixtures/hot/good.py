@@ -1,0 +1,30 @@
+"""Known-good twin: cold error paths may format; untagged code is free."""
+import pickle
+import struct
+
+from ompi_tpu.runtime.hotpath import hot_path
+
+_HDR = struct.Struct("!IIq")
+
+
+@hot_path
+def send_fast(frag):
+    hdr = _HDR.pack(frag.cid, frag.src, frag.seq)   # preallocated struct
+    if frag.total_len > 1 << 32:
+        # error paths are cold: the f-string inside raise is fine
+        raise ValueError(f"frame of {frag.total_len} bytes over the cap")
+    return hdr
+
+
+@hot_path
+def drains(queue):
+    try:
+        return queue.popleft()
+    except IndexError:
+        # except handlers are cold too
+        note = f"queue drained at {id(queue)}"
+        return note
+
+
+def untagged_slow(meta):
+    return pickle.dumps(meta)           # not @hot_path: no budget

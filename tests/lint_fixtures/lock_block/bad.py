@@ -1,0 +1,25 @@
+"""Known-bad: blocking calls while a declared guard lock is held."""
+import threading
+import time
+
+
+def _rpc(sock, payload):
+    sock.sendall(payload)               # marks _rpc itself as blocking
+
+
+class Server:
+    _guarded_by = {"_kv": "_cond"}
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._kv = {}
+
+    def serve(self, sock, key, value):
+        with self._cond:
+            self._kv[key] = value
+            _rpc(sock, b"ok")           # BAD: blocking helper under _cond
+
+    def backoff(self, key):
+        with self._cond:
+            time.sleep(0.5)             # BAD: sleep under _cond
+            return self._kv.get(key)

@@ -1,0 +1,29 @@
+"""Known-good twin: replies outside the lock; Condition.wait is exempt."""
+import threading
+import time
+
+
+def _rpc(sock, payload):
+    sock.sendall(payload)
+
+
+class Server:
+    _guarded_by = {"_kv": "_cond"}
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._kv = {}
+
+    def serve(self, sock, key, value):
+        with self._cond:
+            self._kv[key] = value
+        _rpc(sock, b"ok")               # after release: fine
+
+    def get(self, key, deadline):
+        with self._cond:
+            while key not in self._kv:
+                self._cond.wait(1.0)    # wait releases the lock: exempt
+            return self._kv[key]
+
+    def nap(self):
+        time.sleep(0.01)                # no lock held: fine

@@ -1,0 +1,19 @@
+"""Known-bad: two classes guard the same attribute name under
+different locks — the module-wide guard key is ambiguous."""
+import threading
+
+
+class A:
+    _guarded_by = {"_table": "_lock_a"}
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._table = {}
+
+
+class B:
+    _guarded_by = {"_table": "_lock_b"}   # BAD: collides with A's key
+
+    def __init__(self):
+        self._lock_b = threading.Lock()
+        self._table = {}

@@ -1,0 +1,33 @@
+"""Known-bad: _guarded_by structures mutated outside their lock."""
+import threading
+
+_lock = threading.Lock()
+_callbacks = []
+
+_GUARDED_BY = {"_callbacks": "_lock"}
+
+
+def register(cb):
+    _callbacks.append(cb)               # BAD: module global, no lock
+
+
+class Pool:
+    _guarded_by = {"_free": "_lock", "_bytes": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = {}
+        self._bytes = 0
+
+    def put(self, key, buf):
+        self._free[key] = buf           # BAD: subscript store, no lock
+        self._bytes += buf.nbytes       # BAD: augassign, no lock
+
+    def pop_alias(self, key):
+        free = self._free
+        return free.pop(key)            # BAD: mutation through an alias
+
+    def drop(self, key):
+        with self._lock:
+            del self._free[key]         # fine
+        self._free.clear()              # BAD: after the lock released

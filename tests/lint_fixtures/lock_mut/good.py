@@ -1,0 +1,37 @@
+"""Known-good twin: mutations under the declared lock; _locked helpers."""
+import threading
+
+_lock = threading.Lock()
+_callbacks = []
+
+_GUARDED_BY = {"_callbacks": "_lock"}
+
+
+def register(cb):
+    with _lock:
+        _callbacks.append(cb)
+
+
+def snapshot():
+    return list(_callbacks)             # reads are lock-free by design
+
+
+class Pool:
+    _guarded_by = {"_free": "_lock", "_bytes": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = {}                 # __init__: not yet shared
+        self._bytes = 0
+
+    def put(self, key, buf):
+        with self._lock:
+            self._free[key] = buf
+            self._bytes += buf.nbytes
+
+    def drop(self, key):
+        with self._lock:
+            self._drop_locked(key)
+
+    def _drop_locked(self, key):
+        self._free.pop(key, None)       # *_locked: caller holds the lock

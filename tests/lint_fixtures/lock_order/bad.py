@@ -1,0 +1,24 @@
+"""Known-bad: inconsistent lock-acquisition order (A->B and B->A)."""
+import threading
+
+
+class TwoLocks:
+    _guarded_by = {"_a": "_lock_a", "_b": "_lock_b"}
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._a = {}
+        self._b = {}
+
+    def ab(self, k, v):
+        with self._lock_a:
+            self._a[k] = v
+            with self._lock_b:          # edge a -> b
+                self._b[k] = v
+
+    def ba(self, k, v):
+        with self._lock_b:
+            self._b[k] = v
+            with self._lock_a:          # edge b -> a: CYCLE
+                self._a[k] = v

@@ -1,0 +1,24 @@
+"""Known-good twin: nested acquisition always in the same order."""
+import threading
+
+
+class TwoLocks:
+    _guarded_by = {"_a": "_lock_a", "_b": "_lock_b"}
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._a = {}
+        self._b = {}
+
+    def ab(self, k, v):
+        with self._lock_a:
+            self._a[k] = v
+            with self._lock_b:
+                self._b[k] = v
+
+    def also_ab(self, k):
+        with self._lock_a:
+            del self._a[k]
+            with self._lock_b:          # same order: acyclic
+                self._b.pop(k, None)

@@ -1,0 +1,20 @@
+"""Known-bad: a btl component breaking the framework contract."""
+import os
+
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType, registry
+
+_side_var = registry.register(          # BAD: wrong group for mca/btl
+    "transport", None, "mode", vtype=VarType.STRING, default="")
+
+
+class BrokenBtl(Component):             # BAD: no 'send' slot, no name
+    priority = 5
+
+    def register_vars(self, fw):
+        # BAD: raw env read instead of an MCA var
+        self._mode = os.environ.get("OTPU_BROKEN_MODE", "")
+        self.register_var("eager_limit", vtype=VarType.SIZE, default="64k",
+                          help="ok")
+
+# BAD: no COMPONENT export — discovery silently skips this module

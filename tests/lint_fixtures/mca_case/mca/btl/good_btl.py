@@ -1,0 +1,21 @@
+"""Known-good twin: the contract-complete btl component."""
+from ompi_tpu.base.mca import Component
+from ompi_tpu.base.var import VarType, registry
+
+_ok_var = registry.register(            # group matches the framework
+    "btl", "fine", "mode", vtype=VarType.STRING, default="")
+
+
+class FineBtl(Component):
+    name = "fine"
+    priority = 5
+
+    def register_vars(self, fw):
+        self.register_var("eager_limit", vtype=VarType.SIZE, default="64k",
+                          help="ok")
+
+    def send(self, ep, frag):
+        pass
+
+
+COMPONENT = FineBtl()

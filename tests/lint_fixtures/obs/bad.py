@@ -1,0 +1,19 @@
+"""Known-bad: unregistered help key, undeclared counter, unclosed span."""
+from ompi_tpu.base.output import register_help, show_help
+from ompi_tpu.runtime import spc, trace
+
+register_help("help-fix", "known-key", "A registered template {x}.")
+
+
+def diagnose():
+    show_help("help-fix", "typo-key", x=1)    # BAD: key never registered
+
+
+def count():
+    spc.record("fast_framez")                 # BAD: not in _COUNTERS
+
+
+def timed(comm, buf):
+    t0 = trace.now()                          # BAD: never reaches a span
+    comm.allreduce(buf)
+    return buf

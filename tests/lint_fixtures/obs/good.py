@@ -1,0 +1,33 @@
+"""Known-good twin: registered keys, declared counters, closed spans."""
+from ompi_tpu.base.output import register_help as _rh
+from ompi_tpu.base.output import show_help
+from ompi_tpu.runtime import spc, trace
+
+_rh("help-fix", "good-key", "A registered template {x}.")
+
+
+def diagnose():
+    show_help("help-fix", "good-key", x=1)    # registered (via alias)
+
+
+def count():
+    spc.record("fast_frames")                 # declared in _COUNTERS
+    spc.record(_dynamic_name())               # non-literal: out of scope
+
+
+def _dynamic_name():
+    return "send"
+
+
+def timed(comm, buf):
+    t0 = trace.now()
+    try:
+        comm.allreduce(buf)
+    finally:
+        trace.span("allreduce", "coll", t0)   # begin consumed
+    return buf
+
+
+def timed_deferred(req):
+    t0 = trace.now()
+    req.on_complete(lambda r: trace.span("send", "pml", t0))

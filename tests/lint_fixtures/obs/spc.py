@@ -1,0 +1,5 @@
+"""Fixture stand-in for runtime/spc.py: the declared counter set."""
+
+_COUNTERS = (
+    "send", "recv", "fast_frames",
+)

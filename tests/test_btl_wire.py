@@ -239,11 +239,11 @@ def test_on_bytes_streaming_path_fuzzed(seed):
 
 
 def test_own_queued_copies_only_the_tail():
-    """Backpressure ownership is O(remainder): ``_own_queued`` owns only
-    the entries the current send queued (the queue's tail).  A standing
-    backlog of frames owned at their own send time must ride untouched —
-    re-copying it per borrowed send would be the O(n²) pathology the
-    deque out-queue replaced."""
+    """Backpressure ownership is O(remainder): ``_own_queued_locked``
+    owns only the entries the current send queued (the queue's tail).  A
+    standing backlog of frames owned at their own send time must ride
+    untouched — re-copying it per borrowed send would be the O(n²)
+    pathology the deque out-queue replaced."""
     import socket
 
     a, b = socket.socketpair()
@@ -254,7 +254,8 @@ def test_own_queued_copies_only_the_tail():
     user = bytearray(b"x" * 128)          # the caller's borrowed buffer
     conn.outq.append(memoryview(b"H" * 16))          # this send's header
     conn.outq.append(memoryview(user))
-    btl._own_queued(conn, 2)
+    with conn.send_lock:                  # the *_locked contract
+        btl._own_queued_locked(conn, 2)
     q = list(conn.outq)
     assert len(q) == 8
     for orig, now in zip(backlog, q[:6]):

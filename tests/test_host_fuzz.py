@@ -36,8 +36,12 @@ def _run(worker, n, env_extra, timeout=420):
 
 @pytest.mark.parametrize("seed", [11, 47])
 def test_fuzz_host_collectives(seed):
+    # OTPU_SANITIZE arms the hard-assertion mode for the designed
+    # worst-case seeds: staging double-release/aliasing, tcp framing
+    # desync, and memchecker's frozen in-flight send buffers all fail
+    # loudly at the faulty operation instead of corrupting downstream
     r = _run("fuzz_hostcoll_worker.py", 4,
-             {"HF_SEED": str(seed), "HF_ITERS": "15"})
+             {"HF_SEED": str(seed), "HF_ITERS": "15", "OTPU_SANITIZE": "1"})
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
     assert r.stdout.count("randomized iterations OK") == 4
 
@@ -71,7 +75,7 @@ def test_fuzz_io_views(seed, tmp_path):
 def test_fuzz_algorithm_menus(seed):
     """Every tuned-menu algorithm for every collective must agree with
     numpy on random payloads — the decision ladder may pick any entry."""
-    r = _run("fuzz_algs_worker.py", 4, {"AF_SEED": str(seed)},
-             timeout=520)
+    r = _run("fuzz_algs_worker.py", 4,
+             {"AF_SEED": str(seed), "OTPU_SANITIZE": "1"}, timeout=520)
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1500:]
     assert r.stdout.count("menus agree") == 4

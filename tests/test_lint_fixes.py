@@ -1,0 +1,329 @@
+"""Regression tests for the real bugs otpu-lint's passes surfaced in
+existing code, plus the OTPU_SANITIZE runtime-mode behavior.
+
+The three fixes under pin:
+
+1. **staging pool** (`mca/accelerator/jax_acc.py`): `_checkout` inserted
+   into the checkout table `_out` OUTSIDE the pool lock.  Between
+   acquire's unlock and the insert, a concurrent double release of the
+   same adopted owner passed the under-lock guard — the owner looked
+   neither free nor checked out — and repooled memory that was in use
+   (the PR 4 aliasing family).  Now every `_out` mutation holds the
+   (re-entrant) pool lock.
+
+2. **btl/tcp** (`mca/btl/tcp.py`): `_by_rank` was mutated by the app
+   thread (connect merge, flush-hard-error drop), the progress thread
+   (EOF drop, handshake append), and close() with no common lock — a
+   concurrent remove/extend on one peer's rail list could corrupt it.
+   Now every mutation takes `_conns_lock` per the `_guarded_by`
+   declaration.
+
+3. **coord server** (`rte/coord.py`): the one-shot-fence late-arrival
+   path called `_send_frame` (a blocking `sendall`) while `_fence_cond`
+   was held — one slow-reading client would stall every fence/failure
+   operation job-wide.  The reply now goes out after the condition is
+   released.
+"""
+import pickle
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mca.accelerator.jax_acc import _StagingPool
+from ompi_tpu.runtime import sanitizer
+from ompi_tpu.runtime.sanitizer import SanitizeError
+
+
+class _DepthLock:
+    """RLock wrapper recording held depth, for lock-held assertions."""
+
+    def __init__(self):
+        self._inner = threading.RLock()
+        self.depth = 0
+
+    def __enter__(self):
+        self._inner.acquire()
+        self.depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.depth -= 1
+        self._inner.release()
+        return False
+
+    acquire = __enter__
+
+    def release(self):
+        self.__exit__()
+
+
+class _HookLock(_DepthLock):
+    """Fires ``on_full_release`` the moment the lock is fully released —
+    the first instant a concurrent thread could acquire it."""
+
+    on_full_release = None
+
+    def __exit__(self, *exc):
+        super().__exit__(*exc)
+        if self.depth == 0 and self.on_full_release is not None:
+            cb, self.on_full_release = self.on_full_release, None
+            cb()
+        return False
+
+    release = __exit__
+
+
+class _AssertingDict(dict):
+    """Dict that records any mutation made while the lock is not held."""
+
+    def __init__(self, lock):
+        super().__init__()
+        self._lock = lock
+        self.violations = []
+
+    def _check(self, op):
+        if self._lock.depth == 0:
+            self.violations.append(op)
+
+    def __setitem__(self, k, v):
+        self._check("setitem")
+        super().__setitem__(k, v)
+
+    def pop(self, *a, **kw):
+        self._check("pop")
+        return super().pop(*a, **kw)
+
+
+# -- fix 1: staging checkout table under the pool lock -----------------
+
+def test_staging_checkout_table_mutates_only_under_pool_lock():
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    lock = _DepthLock()
+    pool._lock = lock
+    pool._out = _AssertingDict(lock)
+    buf = pool.acquire(1024, np.uint8)          # insert into _out
+    pool.release(buf)                           # pop from _out
+    # adopted foreign owner: the release/re-acquire cycle walks every
+    # checkout-table path, including the double-release guard scan
+    foreign = np.empty(2048, np.uint8)
+    pool.release(foreign)
+    again = pool.acquire(2048, np.uint8)
+    pool.release(again)
+    dead = pool.acquire(512, np.uint8)
+    del dead                                    # weakref purge path
+    assert pool._out.violations == [], (
+        f"checkout table mutated without the pool lock: "
+        f"{pool._out.violations}")
+
+
+def test_staging_double_release_guard_sees_live_checkout():
+    """The interleaving the unlocked insert allowed: an adopted owner is
+    re-acquired, and a stale second release of the SAME owner arrives
+    while its bytes are checked out.  The guard must reject the repool
+    (before the fix, a release racing the acquire->insert window could
+    alias the checked-out bytes)."""
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    owner = np.empty(4096, np.uint8)
+    pool.release(owner)                         # adopt
+    view = pool.acquire(4096, np.uint8)         # pops the adopted owner
+    view[:] = 7
+    pool.release(owner)                         # stale double release
+    other = pool.acquire(4096, np.uint8)        # must NOT alias `view`
+    other[:] = 0
+    assert view.sum() == 7 * 4096, "double release aliased a checkout"
+
+
+def test_staging_acquire_checkout_atomic_with_pop():
+    """The exact pre-fix interleaving: acquire pops an adopted owner
+    from the free bin, and a STALE release of the same owner lands at
+    the first instant the pool lock is free.  Before the fix the
+    checkout registration happened in a later critical section, so at
+    that instant the owner was neither free nor checked out — the guard
+    passed and the repooled owner aliased the live checkout."""
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    owner = np.empty(4096, np.uint8)
+    pool.release(owner)                         # adopt into the free bin
+    lock = _HookLock()
+    pool._lock = lock
+    lock.on_full_release = lambda: pool.release(owner)   # the stale racer
+    view = pool.acquire(4096, np.uint8)         # pops the adopted owner
+    view[:] = 7
+    other = pool.acquire(4096, np.uint8)
+    other[:] = 0
+    assert view.sum() == 7 * 4096, (
+        "stale release in the pop->checkout window aliased the live "
+        "checkout")
+
+
+# -- fix 2: tcp _by_rank rail lists guarded by _conns_lock -------------
+
+def _tcp_btl_and_conn():
+    from ompi_tpu.mca.btl.tcp import TcpBtl, _Conn
+
+    btl = TcpBtl.__new__(TcpBtl)
+    TcpBtl.__init__(btl)
+    conn = _Conn.__new__(_Conn)
+    conn.sock = None
+    conn.rank = 3
+    conn.inbuf = bytearray()
+    conn.outq = __import__("collections").deque()
+    conn.out_bytes = 0
+    conn.want_write = False
+    conn.send_lock = threading.Lock()
+    return btl, conn
+
+
+class _AssertingRails(dict):
+    def __init__(self, lock):
+        super().__init__()
+        self._lock = lock
+        self.violations = []
+
+    def _check(self, op):
+        if self._lock.depth == 0:
+            self.violations.append(op)
+
+    def setdefault(self, *a):
+        self._check("setdefault")
+        return super().setdefault(*a)
+
+    def pop(self, *a, **kw):
+        self._check("pop")
+        return super().pop(*a, **kw)
+
+    def clear(self):
+        self._check("clear")
+        super().clear()
+
+
+def test_tcp_by_rank_mutations_hold_conns_lock():
+    btl, conn = _tcp_btl_and_conn()
+    lock = _DepthLock()
+    btl._conns_lock = lock
+    btl._by_rank = _AssertingRails(lock)
+    # handshake append (progress thread): a pickle-header hello frame
+    hello = pickle.dumps({"rank": 3})
+    frame = bytes((0,)) + struct.pack("!I", len(hello)) + hello
+    fresh = type(conn).__new__(type(conn))
+    fresh.rank = None
+    assert btl._parse_frame(fresh, frame) is None
+    assert fresh.rank == 3
+    # EOF/hard-error drop (either thread)
+    btl._drop_conn(fresh)
+    assert 3 not in btl._by_rank
+    btl._drop_conn(conn)                        # rank present, list gone
+    assert btl._by_rank.violations == [], (
+        f"_by_rank mutated without _conns_lock: {btl._by_rank.violations}")
+
+
+def test_tcp_drop_conn_races_are_list_safe():
+    """Two threads dropping rails for one peer while a third re-adds:
+    with the lock this converges without ValueError/lost entries."""
+    btl, conn = _tcp_btl_and_conn()
+    conns = [conn]
+    for _ in range(3):
+        c = type(conn).__new__(type(conn))
+        c.rank = 3
+        conns.append(c)
+    with btl._conns_lock:
+        btl._by_rank.setdefault(3, []).extend(conns)
+    errs = []
+
+    def dropper(cs):
+        try:
+            for c in cs:
+                btl._drop_conn(c)
+        except Exception as exc:   # pragma: no cover - the regression
+            errs.append(exc)
+
+    ts = [threading.Thread(target=dropper, args=(conns[i::2],))
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert 3 not in btl._by_rank
+
+
+# -- fix 3: coord fence reply never rides under _fence_cond ------------
+
+def test_coord_fence_replies_sent_outside_fence_cond(monkeypatch):
+    from ompi_tpu.rte import coord as coord_mod
+
+    srv = coord_mod.CoordServer(nprocs=1)
+    held_during_send = []
+    real_send = coord_mod._send_frame
+
+    def checked_send(sock, obj):
+        held_during_send.append(srv._fence_cond._is_owned())
+        return real_send(sock, obj)
+
+    monkeypatch.setattr(coord_mod, "_send_frame", checked_send)
+    try:
+        client = coord_mod.CoordClient(addr=srv.addr, timeout=10.0)
+        try:
+            # normal one-shot round, then the LATE-ARRIVAL path that
+            # used to reply while _fence_cond was held
+            client.fence_oneshot("f-done", rank=0, expect=[0])
+            client.fence_oneshot("f-done", rank=0, expect=[0])
+            client.put(0, "k", 1)
+            assert client.get(0, "k") == 1
+        finally:
+            client.close()
+    finally:
+        srv.close()
+    assert held_during_send, "instrumentation never fired"
+    assert not any(held_during_send), (
+        "a coord reply was sent while _fence_cond was held — one slow "
+        "client would stall every fence job-wide")
+
+
+# -- OTPU_SANITIZE runtime mode ----------------------------------------
+
+@pytest.fixture
+def sanitize_on(monkeypatch):
+    monkeypatch.setattr(sanitizer, "enabled", True)
+    yield
+
+
+def test_sanitizer_double_release_raises(sanitize_on):
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    owner = np.empty(4096, np.uint8)
+    pool.release(owner)
+    _checked_out = pool.acquire(4096, np.uint8)
+    with pytest.raises(SanitizeError, match="double release"):
+        pool.release(owner)
+
+
+def test_sanitizer_noncontiguous_release_raises(sanitize_on):
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    arr = np.empty((64, 64), np.float32)
+    with pytest.raises(SanitizeError, match="non-C-contiguous"):
+        pool.release(arr.T)
+
+
+def test_sanitizer_tcp_framing_desync_raises(sanitize_on):
+    btl, conn = _tcp_btl_and_conn()
+    conn.inbuf = bytearray(struct.pack("!I", 0)) + b"junk"
+    with pytest.raises(SanitizeError, match="framing desync"):
+        btl._drain(conn)
+
+
+def test_sanitizer_forces_memchecker(sanitize_on):
+    from ompi_tpu.runtime import memchecker
+
+    assert memchecker.enabled()
+
+
+def test_sanitizer_off_by_default_and_tolerant():
+    assert sanitizer.enabled is False
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    owner = np.empty(4096, np.uint8)
+    pool.release(owner)
+    _checked_out = pool.acquire(4096, np.uint8)
+    pool.release(owner)        # tolerated silently (guarded, no raise)
+    arr = np.empty((64, 64), np.float32)
+    pool.release(arr.T)        # warn-once path, no raise

@@ -167,6 +167,39 @@ def test_tuned_schedule_cache_hits_on_second_call(tmp_path):
     assert lane >= 1, "small allreduce skipped the eager lane"
 
 
+def test_sanitizer_off_zero_overhead():
+    """OTPU_SANITIZE off must cost the 4KB eager lane NOTHING: the
+    @hot_path decorator is identity (no wrapper object on any tagged hot
+    function — the strongest possible zero-overhead proof), the
+    memchecker hook stays dormant, and the sanitizer flag is a module
+    bool no hot path reads outside its cold branches."""
+    from ompi_tpu.datatype.convertor import Convertor
+    from ompi_tpu.mca.accelerator.jax_acc import _StagingPool
+    from ompi_tpu.mca.btl.tcp import TcpBtl
+    from ompi_tpu.mca.coll.tuned import TunedModule
+    from ompi_tpu.runtime import hotpath, memchecker, progress, sanitizer
+
+    assert sanitizer.enabled is False          # default off
+    assert memchecker.enabled() is False       # hook dormant
+
+    def f():
+        return 1
+
+    assert hotpath.hot_path(f) is f            # decorator is identity
+    # every tagged hot function is the plain function object — no
+    # wrapper, no __wrapped__, nothing to pay per call
+    for fn in (TcpBtl.send, TcpBtl._flush_locked, TcpBtl._on_bytes,
+               TunedModule.allreduce, Convertor.pack_borrow,
+               _StagingPool.acquire, _StagingPool.release,
+               progress.progress):
+        assert not hasattr(fn, "__wrapped__"), fn
+    # the registry recorded the eager-lane path's hot functions
+    regs = hotpath.registered()
+    for qual in ("TcpBtl.send", "TunedModule.allreduce",
+                 "Convertor.pack_borrow", "_StagingPool.acquire"):
+        assert any(q.endswith(qual) for q in regs), qual
+
+
 def test_small_pack_skips_pool_dispatch(monkeypatch):
     """fastpath satellite: packs below ``_POOL_PACK_MIN`` must never
     reach the worker pool — the threads_pool_pack_4MB bench measured
