@@ -662,6 +662,133 @@ def host_part_points() -> list:
         os.unlink(script)
 
 
+_SERVING = """
+import json, sys
+import ompi_tpu
+from ompi_tpu.serving import ContinuousBatchScheduler, Router, ShardWorker
+from ompi_tpu.serving.driver import PoissonDriver
+
+mode = sys.argv[1]
+w = ompi_tpu.init()
+if w.rank == 0:
+    sched = ContinuousBatchScheduler(max_batch=8,
+                                     max_batch_tokens=1 << 14, slots=8)
+    r = Router(w, scheduler=sched, stages=(mode == "stages"),
+               decode_chunk=4, kv_elems=256)
+    rep = PoissonDriver(rate_rps=300.0, n_requests=96,
+                        prompt_lens=(8, 64), decode_lens=(4, 24),
+                        seed=5).run(r, max_wall_s=150)
+    r.shutdown()
+    print("SERVING " + json.dumps(rep), flush=True)
+elif mode == "stages" and w.rank == 1:
+    ShardWorker(w, router=0, role="prefill", peer=2, slots=8,
+                kv_elems=256).serve()
+elif mode == "stages" and w.rank == 2:
+    ShardWorker(w, router=0, role="decode", peer=1, slots=8,
+                kv_elems=256, kv_partitions=16).serve()
+else:
+    ShardWorker(w, router=0).serve()
+ompi_tpu.finalize()
+"""
+
+
+def serving_rows() -> list:
+    """The heavy-traffic serving benchmark (ROADMAP item 3): a Poisson
+    open-loop driver against the continuous-batching engine — router +
+    2 workers, colocated AND disaggregated (KV slabs over partitioned
+    requests) — reporting p50/p99 request latency from the otpu-trace
+    log2 histograms and decoded tokens/sec.  A queueing benchmark, not
+    a ping-pong: latency includes admission waiting, which is why it is
+    a new surface next to the OSU-style sweeps."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_SERVING)
+        script = f.name
+    rows = []
+    try:
+        for mode in ("colocated", "stages"):
+            proc = subprocess.run(
+                [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "3",
+                 sys.executable, script, mode],
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if "SERVING " in ln), None)
+            if proc.returncode or line is None:
+                print(f"serving bench ({mode}) failed "
+                      f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}",
+                      file=sys.stderr)
+                rows.append({"coll": f"serving_poisson_{mode}",
+                             "ok": False})
+                continue
+            rep = _json.loads(line.split("SERVING ", 1)[1])
+            rows.append({
+                "coll": f"serving_poisson_{mode}",
+                "nbytes": rep["requests"],
+                "p50_ms": rep["p50_ms"], "p99_ms": rep["p99_ms"],
+                "p99_exact_ms": rep["p99_exact_ms"],
+                "tokens_per_s": rep["tokens_per_s"],
+                "req_per_s": rep["req_per_s"],
+            })
+    finally:
+        os.unlink(script)
+    return rows
+
+
+def _serving_md_section(rows) -> list:
+    lines = ["", "## Serving (Poisson open-loop, router + 2 workers)",
+             "",
+             "Request latency percentiles come from the otpu-trace "
+             "log2 histogram estimator (`p99_exact` is the driver's "
+             "own sample check); tokens/sec counts decoded tokens. "
+             "Open-loop queueing numbers, not ping-pong latency.",
+             "",
+             "| mode | requests | p50 ms | p99 ms | p99 exact ms | "
+             "tokens/s | req/s |", "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        if not r.get("ok", True):
+            lines.append(f"| {r['coll']} | FAILED | - | - | - | - | - |")
+            continue
+        lines.append(
+            f"| {r['coll']} | {r['nbytes']} | {r['p50_ms']} | "
+            f"{r['p99_ms']} | {r['p99_exact_ms']} | "
+            f"{r['tokens_per_s']} | {r['req_per_s']} |")
+    return lines
+
+
+def refresh_serving_tables() -> list:
+    """``bench.py --serving``: run the serving rows and fold them into
+    the committed sweep tables (replacing any previous serving rows) —
+    the device/host rows are left untouched."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows = serving_rows()
+    try:
+        with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        payload = {"ndev": 0, "results": []}
+    payload["results"] = [r for r in payload.get("results", [])
+                          if not str(r.get("coll", "")).startswith(
+                              "serving_")] + rows
+    _atomic_write(os.path.join(here, "BENCH_SWEEP.json"),
+                  json.dumps(payload, indent=1))
+    # regenerate only the Serving section of the markdown table
+    md_path = os.path.join(here, "BENCH_SWEEP.md")
+    try:
+        with open(md_path) as f:
+            md = f.read()
+    except OSError:
+        md = "# Collective sweep\n"
+    head, _sep, _old = md.partition(
+        "\n## Serving (Poisson open-loop")
+    _atomic_write(md_path, head.rstrip("\n") + "\n"
+                  + "\n".join(_serving_md_section(rows)) + "\n")
+    return rows
+
+
 _STAGING_OSU = """
 import json, statistics, sys, time
 import numpy as np
@@ -1164,6 +1291,20 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
                 stale_device_rows=None, stale_rounds=0,
                 mfu=None) -> None:
     here = os.path.dirname(os.path.abspath(__file__))
+    # serving rows are refreshed by `bench.py --serving`, not by the
+    # sweep: carry the committed ones forward so a sweep refresh cannot
+    # erase them (the carried-device-rows discipline)
+    serving_prev = []
+    if not any(str(r.get("coll", "")).startswith("serving_")
+               for r in results):
+        try:
+            with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
+                serving_prev = [
+                    r for r in json.load(f).get("results", [])
+                    if str(r.get("coll", "")).startswith("serving_")]
+        except (OSError, ValueError):
+            serving_prev = []
+        results = results + serving_prev
     payload = {"ndev": ndev, "results": results}
     if mfu:
         payload["mfu"] = mfu
@@ -1176,7 +1317,9 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
              "#1-#5)", ""]
     if header_note:
         lines += [header_note, ""]
-    lines += [f"Devices: {ndev}", ""] + _table(results)
+    lines += [f"Devices: {ndev}", ""] + _table(
+        [r for r in results
+         if not str(r.get("coll", "")).startswith("serving_")])
     if mfu:
         lines += ["", "## Single-chip MFU", ""]
         for r in mfu:
@@ -1201,6 +1344,10 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
                   "dispatch + algorithm-choice regressions show up "
                   "here without pod access.  NOT bandwidth numbers.",
                   ""] + _table(multidev_rows)
+    serving_now = [r for r in results
+                   if str(r.get("coll", "")).startswith("serving_")]
+    if serving_now:
+        lines += _serving_md_section(serving_now)
     _atomic_write(os.path.join(here, "BENCH_SWEEP.md"),
                   "\n".join(lines) + "\n")
 
@@ -1838,6 +1985,9 @@ if __name__ == "__main__":
     elif "--multidev" in sys.argv:
         for row in multidev_sweep():
             print(row)
+    elif "--serving" in sys.argv:
+        for row in refresh_serving_tables():
+            print(json.dumps(row))
     elif "--pod-smoke" in sys.argv:
         sys.exit(pod_smoke(dry_run="--dry-run" in sys.argv))
     elif "--mfu" in sys.argv:

@@ -179,6 +179,16 @@ class Request:
         raise MpiError(ErrorClass.ERR_REQUEST,
                        "Parrived on a non-partitioned request")
 
+    def parrived_range(self, partition_low: int,
+                       partition_high: int) -> bool:
+        """Have ALL partitions in the inclusive [low, high] range
+        arrived?  (No standard analog — the serving KV-slab receiver
+        uses it to test one sequence slot that maps onto a RUN of
+        receiver partitions when send/recv partition counts differ.)"""
+        return all(self.parrived(p)
+                   for p in range(int(partition_low),
+                                  int(partition_high) + 1))
+
     def _raise_if_error(self) -> None:
         if self.error is not None:
             raise self.error

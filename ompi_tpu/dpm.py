@@ -27,9 +27,18 @@ import numpy as np
 from ompi_tpu.api.comm import Comm
 from ompi_tpu.api.errors import ErrorClass, MpiError
 from ompi_tpu.api.group import Group
+from ompi_tpu.base.var import VarType, registry
 
 # cross-job CIDs live far above any locally-agreed CID
 _DPM_CID_BASE = 1 << 20
+
+_spawn_timeout_var = registry.register(
+    "dpm", None, "spawn_timeout", vtype=VarType.FLOAT, default=60.0,
+    help="Seconds MPI_Comm_spawn waits for every child rank to join the "
+         "runtime (the __spawn_join__ handshake) before releasing the "
+         "allocated CID and raising ERR_SPAWN — a child that dies during "
+         "boot must produce a loud error, not a half-built "
+         "intercommunicator")
 
 
 def _client(comm) -> object:
@@ -61,32 +70,96 @@ def _make_intercomm(comm, cid: int, remote_ranks: Sequence[int],
     return inter
 
 
-def spawn(comm, command: Sequence[str], maxprocs: int,
-          root: int = 0) -> Comm:
-    """``MPI_Comm_spawn``: launch ``maxprocs`` new ranks running
-    ``command``; returns the parent↔children intercommunicator.
+def _await_spawn_join(client, ranks: Sequence[int], job: str,
+                      timeout: float) -> None:
+    """Block until every spawned rank published its ``__spawn_join__``
+    marker (done by ``ProcRte.__init__`` as soon as the child's coord
+    connection is up).  A child that died during boot (the launcher's
+    proc_failed report lands in the local ft state) or never joined
+    within ``timeout`` raises a loud ERR_SPAWN — the half-built-
+    intercommunicator hang this replaces."""
+    import time as _time
 
-    Collective over ``comm``.  Children find their side via
-    ``get_parent()``.
-    """
+    from ompi_tpu.ft import state as ft_state
+
+    deadline = _time.monotonic() + timeout
+    for r in ranks:
+        while True:
+            if ft_state.is_failed(r):
+                raise MpiError(
+                    ErrorClass.ERR_SPAWN,
+                    f"spawned rank {r} (job {job}) died during join — "
+                    "the child process exited before reaching the "
+                    "runtime")
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise MpiError(
+                    ErrorClass.ERR_SPAWN,
+                    f"spawned rank {r} (job {job}) did not join within "
+                    f"{timeout:g}s (otpu_dpm_spawn_timeout); aborting "
+                    "the spawn instead of leaving a half-built "
+                    "intercommunicator")
+            # short sub-waits keep the died-during-join check live while
+            # a slow child is still booting
+            got = client.get(r, f"__spawn_join__:{job}", wait=True,
+                             timeout=min(1.0, remaining))
+            if got is not None:
+                break
+
+
+def _spawn_at_root(comm, cmd, total: int):
+    """Root-side spawn: allocate the bridge CID, launch, and run the
+    join handshake.  On ANY failure the reserved-but-never-used CID is
+    released again (the children can only adopt it after completing the
+    join, so no peer can hold a communicator on it)."""
+    from ompi_tpu.runtime import init as rt
+
+    client = _client(comm)
+    cid = _new_bridge_cid(client)
+    # hold the cid locally from allocation on: a concurrent local
+    # create must not collide with it while the children are joining
+    rt.reserve_cid(cid)
+    try:
+        parent_ranks = ",".join(str(w) for w in comm.group.world_ranks)
+        ranks, job = client.spawn(
+            cmd, total,
+            env={"OTPU_PARENT_RANKS": parent_ranks,
+                 "OTPU_PARENT_CID": str(cid)})
+        if len(ranks) != total:
+            raise MpiError(
+                ErrorClass.ERR_SPAWN,
+                f"launcher allocated {len(ranks)} of {total} requested "
+                "ranks — aborting the spawn instead of building a "
+                "short-sized intercommunicator")
+        _await_spawn_join(client, ranks, job,
+                          float(_spawn_timeout_var.value or 60.0))
+        return cid, ranks, job
+    except BaseException:
+        rt.release_cid(cid)
+        raise
+
+
+def _job_seq(job: str) -> int:
+    """Numeric tail of a coord job id ('job3' -> 3; -1 if unparsable)."""
+    tail = str(job).removeprefix("job")
+    return int(tail) if tail.isdigit() else -1
+
+
+def _spawn_common(comm, cmd, total: int, root: int, name: str) -> Comm:
+    """Shared body of spawn / spawn_multiple: root launches + joins,
+    the sentinel bcast tells non-roots success or failure, and the
+    intercommunicator carries ``spawn_job`` (the coord job id, whose
+    ``mpi://job/<id>`` pset names the children)."""
     comm._check_state()
-    info = np.zeros(2 + maxprocs, np.int64)
+    info = np.zeros(3 + total, np.int64)
     err = None
     if comm.rank == root:
         try:
-            client = _client(comm)
-            cid = _new_bridge_cid(client)
-            parent_ranks = ",".join(str(w) for w in comm.group.world_ranks)
-            ranks, job = client.spawn(
-                list(command), maxprocs,
-                env={"OTPU_PARENT_RANKS": parent_ranks,
-                     "OTPU_PARENT_CID": str(cid)})
-            if len(ranks) != maxprocs:
-                raise MpiError(ErrorClass.ERR_SPAWN,
-                               f"spawn returned {len(ranks)} ranks")
+            cid, ranks, job = _spawn_at_root(comm, cmd, total)
             info[0] = cid
-            info[1] = maxprocs
-            info[2:2 + maxprocs] = ranks
+            info[1] = total
+            info[2] = _job_seq(job)
+            info[3:3 + total] = ranks
         except Exception as exc:
             # error sentinel: non-roots are already blocked in the bcast
             # and must learn the spawn failed rather than hang
@@ -96,11 +169,27 @@ def spawn(comm, command: Sequence[str], maxprocs: int,
     if int(info[0]) < 0:
         if err is not None:
             raise err
-        raise MpiError(ErrorClass.ERR_SPAWN, "spawn failed at root")
-    cid = int(info[0])
-    children = [int(r) for r in info[2:2 + int(info[1])]]
-    return _make_intercomm(comm, cid, children,
-                           name=f"{comm.name}~spawn")
+        raise MpiError(ErrorClass.ERR_SPAWN, f"{name} failed at root")
+    children = [int(r) for r in info[3:3 + int(info[1])]]
+    inter = _make_intercomm(comm, int(info[0]), children,
+                            name=f"{comm.name}~{name}")
+    seq = int(info[2])
+    inter.spawn_job = f"job{seq}" if seq >= 0 else None
+    return inter
+
+
+def spawn(comm, command: Sequence[str], maxprocs: int,
+          root: int = 0) -> Comm:
+    """``MPI_Comm_spawn``: launch ``maxprocs`` new ranks running
+    ``command``; returns the parent↔children intercommunicator.
+
+    Collective over ``comm``.  Children find their side via
+    ``get_parent()``.  The root waits for every child's join handshake
+    before the intercomm exists anywhere; a child dying during boot (or
+    a short rank allocation) releases the bridge CID and raises
+    ERR_SPAWN on all ranks.
+    """
+    return _spawn_common(comm, list(command), int(maxprocs), root, "spawn")
 
 
 def spawn_multiple(comm, commands: Sequence[Sequence[str]],
@@ -115,36 +204,7 @@ def spawn_multiple(comm, commands: Sequence[Sequence[str]],
     per_rank: list = []
     for cmd, cnt in zip(commands, maxprocs):
         per_rank.extend([list(cmd)] * int(cnt))
-    comm._check_state()
-    total = len(per_rank)
-    info = np.zeros(2 + total, np.int64)
-    err = None
-    if comm.rank == root:
-        try:
-            client = _client(comm)
-            cid = _new_bridge_cid(client)
-            parent_ranks = ",".join(str(w) for w in comm.group.world_ranks)
-            ranks, job = client.spawn(
-                per_rank, total,
-                env={"OTPU_PARENT_RANKS": parent_ranks,
-                     "OTPU_PARENT_CID": str(cid)})
-            if len(ranks) != total:
-                raise MpiError(ErrorClass.ERR_SPAWN,
-                               f"spawn returned {len(ranks)} ranks")
-            info[0] = cid
-            info[1] = total
-            info[2:2 + total] = ranks
-        except Exception as exc:
-            err = exc
-            info[0] = -1
-    info = np.asarray(comm.bcast(info, root=root))
-    if int(info[0]) < 0:
-        if err is not None:
-            raise err
-        raise MpiError(ErrorClass.ERR_SPAWN, "spawn_multiple failed at root")
-    children = [int(r) for r in info[2:2 + int(info[1])]]
-    return _make_intercomm(comm, int(info[0]), children,
-                           name=f"{comm.name}~spawnm")
+    return _spawn_common(comm, per_rank, len(per_rank), root, "spawnm")
 
 
 def join(fd) -> Comm:

@@ -53,6 +53,13 @@ class ProcRte(Rte):
         self._node = os.environ.get("OTPU_NODE_ID", self._hostname)
         self.modex_put("hostname", self._hostname)
         self.modex_put("node", self._node)
+        if self.job != "0":
+            # dpm join handshake: a spawned rank announces it reached the
+            # runtime as soon as the coord connection is up, so the
+            # parent's MPI_Comm_spawn can distinguish "children booting"
+            # from "a child died during join" (ERR_SPAWN) instead of
+            # hanging on a half-built intercommunicator
+            self.modex_put(f"__spawn_join__:{self.job}", 1)
         self._fence_counter = 0
 
     def modex_put(self, key: str, value: Any) -> None:

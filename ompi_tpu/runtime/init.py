@@ -95,6 +95,19 @@ def is_cid_free(cid: int) -> bool:
         return not _cid_map.is_set(cid)
 
 
+def release_cid(cid: int) -> None:
+    """Return a NEVER-USED CID to the pool (spawn partial-failure path).
+
+    Only legal for a cid no communicator was ever built on, on any rank:
+    dpm's bridge CIDs come from the coordination service's atomic
+    counter, so a reservation made before the children joined can be
+    dropped on join failure without any reuse hazard — the counter never
+    hands the value out again.  Used CIDs must go through
+    :func:`retire_cid` instead."""
+    with _cid_lock:
+        _cid_map.clear(cid)
+
+
 def retire_cid(cid: int) -> None:
     """Freed CIDs are retired, never returned to the pool: reuse would
     both break the agreement's density assumption and allow a revoked

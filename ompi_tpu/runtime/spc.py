@@ -21,6 +21,11 @@ _COUNTERS = (
     "fastpath_payload_copies",
     "fastpath_sched_hits", "fastpath_sched_misses", "fastpath_eager_lane",
     "fastpath_staging_hits", "fastpath_staging_misses",
+    # serving counters (ompi_tpu/serving): continuous-batching engine
+    # admissions/evictions per tick, decoded token volume, KV-slab
+    # streaming epochs, and requests requeued by serve-through-failure
+    "serve_requests", "serve_tokens", "serve_ticks", "serve_admitted",
+    "serve_evicted", "serve_requeued", "serve_kv_epochs", "serve_scaleups",
 )
 
 _pvars = {}

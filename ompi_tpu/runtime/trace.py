@@ -48,8 +48,10 @@ _slot = itertools.count()
 _anchor_wall_ns = time.time_ns()
 _anchor_mono_ns = time.perf_counter_ns()
 
-# histogram state: (coll, log2 bin) -> [count, sum_ns, min_ns, max_ns,
-# count_pvar, sum_pvar]; exact under _hist_lock (enabled path only)
+# histogram state: (coll, log2 size bin) -> [count, sum_ns, min_ns,
+# max_ns, count_pvar, sum_pvar, {log2 dur bin: count}]; exact under
+# _hist_lock (enabled path only).  The trailing dict is the log2
+# LATENCY sub-histogram percentile estimation interpolates over.
 _hist: dict = {}
 _hist_lock = threading.Lock()
 
@@ -174,13 +176,25 @@ def hist_record(coll: str, nbytes: int, dur_ns: int) -> None:
                 pclass=PvarClass.AGGREGATE,
                 help=f"Summed {coll} latency (us) in the [{label}, "
                      "next-bin) payload size bin")
-            cell = _hist[key] = [0, 0, dur_ns, dur_ns, cnt, tot]
+            cell = _hist[key] = [0, 0, dur_ns, dur_ns, cnt, tot, {}]
+            for q, qname in ((0.5, "p50"), (0.99, "p99")):
+                pv = registry.register_pvar(
+                    "trace", "hist", f"{coll}_{label}_{qname}_us",
+                    pclass=PvarClass.LEVEL,
+                    help=f"{qname} {coll} latency (us, interpolated from "
+                         f"the log2 latency bins) in the [{label}, "
+                         "next-bin) payload size bin")
+                # pre-read hook: percentiles are derived, not accumulated
+                pv.on_read = (lambda pv=pv, key=key, q=q:
+                              pv.set(_key_percentile_us(key, q)))
         cell[0] += 1
         cell[1] += dur_ns
         cell[2] = min(cell[2], dur_ns)
         cell[3] = max(cell[3], dur_ns)
         cell[4].add_relaxed(1)
         cell[5].add_relaxed(dur_ns / 1000.0)
+        db = int(dur_ns).bit_length()
+        cell[6][db] = cell[6].get(db, 0) + 1
 
 
 def histograms() -> dict:
@@ -191,6 +205,81 @@ def histograms() -> dict:
                                     c[3] / 1000.0)
             for (coll, b), c in _hist.items()
         }
+
+
+def _interp_percentile_ns(dur_bins: dict, q: float, lo_clamp: int,
+                          hi_clamp: int) -> float:
+    """Estimate the q-quantile (ns) from a {log2 bin: count} latency
+    histogram: find the bin holding the q*N-th sample and interpolate
+    linearly inside it (bin b covers [2^(b-1), 2^b)), clamped to the
+    exact observed [min, max] so single-bin cells don't over-report."""
+    total = sum(dur_bins.values())
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    est = float(hi_clamp)
+    for b in sorted(dur_bins):
+        cnt = dur_bins[b]
+        if cum + cnt >= target:
+            lo = 0 if b == 0 else (1 << (b - 1))
+            hi = 1 if b == 0 else (1 << b)
+            frac = (target - cum) / cnt
+            est = lo + frac * (hi - lo)
+            break
+        cum += cnt
+    return float(max(lo_clamp, min(hi_clamp, est)))
+
+
+def _key_percentile_us(key, q: float) -> float:
+    """q-quantile (us) of ONE (coll, size-bin) cell (pvar read hook)."""
+    with _hist_lock:
+        cell = _hist.get(key)
+        if cell is None:
+            return 0.0
+        return _interp_percentile_ns(cell[6], q, cell[2], cell[3]) / 1000.0
+
+
+def hist_reset(coll: str) -> None:
+    """Drop every histogram cell of ``coll`` so the next records start
+    a fresh population — measurement harnesses (the serving driver) use
+    this to keep per-run percentiles from merging with an earlier run's
+    samples in the same process.  The cells' pvars stay registered
+    (counters remain cumulative, like every SPC pvar); the percentile
+    pvars re-bind to the new cells on the next record."""
+    with _hist_lock:
+        for key in [k for k in _hist if k[0] == coll]:
+            del _hist[key]
+
+
+def hist_percentile(coll: str, q: float,
+                    nbytes: Optional[int] = None) -> float:
+    """Estimated q-quantile latency in MICROSECONDS of ``coll``'s
+    recorded invocations — interpolated from the log2-duration bins the
+    histogram keeps per cell (exact to within one log2 bin; the serving
+    driver's p50/p99 report and ``otpu_info --pvars`` read this).
+
+    ``nbytes`` restricts the estimate to that payload's size bin;
+    without it the duration bins of every size bin are merged."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    if nbytes is not None:
+        return _key_percentile_us((coll, int(nbytes).bit_length()), q)
+    with _hist_lock:
+        merged: dict = {}
+        lo_clamp, hi_clamp, any_cell = None, 0, False
+        for (c, _b), cell in _hist.items():
+            if c != coll:
+                continue
+            any_cell = True
+            lo_clamp = cell[2] if lo_clamp is None else min(lo_clamp,
+                                                            cell[2])
+            hi_clamp = max(hi_clamp, cell[3])
+            for db, cnt in cell[6].items():
+                merged[db] = merged.get(db, 0) + cnt
+        if not any_cell:
+            return 0.0
+        return _interp_percentile_ns(merged, q, lo_clamp, hi_clamp) / 1000.0
 
 
 # -- per-comm coll table interposition ----------------------------------

@@ -1,0 +1,92 @@
+"""ompi_tpu.serving — continuous-batching inference on top of the runtime.
+
+The serving frontier of ROADMAP open item 3: everything below this
+package optimizes the *training* path; this one opens the
+heavy-traffic inference scenario using exactly the machinery the
+earlier PRs built —
+
+* a **request router** rank feeding model-shard **worker** ranks over an
+  ordinary communicator (:mod:`ompi_tpu.serving.router`,
+  :mod:`ompi_tpu.serving.worker`);
+* **continuous batching**: an admission scheduler merges in-flight
+  requests into prefill/decode micro-batches every engine tick and
+  evicts finished sequences without draining the batch
+  (:mod:`ompi_tpu.serving.scheduler`);
+* **KV-cache streaming** between the prefill and decode stages over
+  MPI-4 partitioned persistent requests — ``Psend_init``/``Precv_init``
+  per stage pair, one ``Pready`` per finished sequence, the bucketed-
+  overlap machinery of ``mca/part`` pointed at inference
+  (:mod:`ompi_tpu.serving.kv_stream`);
+* **autoscaling** via ``dpm.spawn`` when queue depth crosses a
+  watermark, new workers joining through the dynamic ``mpi://job/<id>``
+  process set;
+* **serve-through-failure**: on ``proc_failed`` the comm is revoked,
+  survivors shrink (publishing ``mpi://surviving``), the router
+  re-shards its worker table and requeues the dead worker's in-flight
+  requests — no admitted request is ever dropped.
+
+Why the eager/partitioned lanes and not naive per-request sends:
+"Optimizing Allreduce with Multiple Processes per GPU" (arxiv
+2508.13397) shows per-message software overhead dominating small
+transfers — the regime of per-request decode traffic — so decode
+commands ride one coalesced micro-batch message per worker per tick and
+KV blocks ride the aggregated partitioned slab.
+
+Role placement: ``tpurun --router-ranks/--worker-ranks`` publish the
+``mpi://serving/router`` / ``mpi://serving/workers`` psets; without
+them the lowest comm rank routes and the rest serve shards
+(:func:`roles`).
+"""
+from __future__ import annotations
+
+#: role process-set names served by the coordination service (published
+#: by ``tpurun --router-ranks`` / ``--worker-ranks``)
+PSET_ROUTER = "mpi://serving/router"
+PSET_WORKERS = "mpi://serving/workers"
+
+
+def roles(comm) -> tuple[int, list]:
+    """(router comm-rank, [worker comm-ranks]) for ``comm``.
+
+    Resolution order: the ``mpi://serving/router`` / ``.../workers``
+    psets when the coordination service advertises them (world ranks are
+    mapped into ``comm``; members outside the comm are ignored), else
+    the default split — lowest rank routes, everyone else serves.
+    """
+    router, workers = None, None
+    client = getattr(comm.rte, "client", None)
+    if client is not None:
+        try:
+            r_entry = client.pset_get(PSET_ROUTER)
+            w_entry = client.pset_get(PSET_WORKERS)
+        except Exception:
+            r_entry = w_entry = None
+        in_comm = {w: i for i, w in enumerate(comm.group.world_ranks)}
+        if r_entry is not None:
+            rr = [in_comm[int(m)] for m in r_entry["members"]
+                  if int(m) in in_comm]
+            router = rr[0] if rr else None
+        if w_entry is not None:
+            workers = sorted(in_comm[int(m)] for m in w_entry["members"]
+                             if int(m) in in_comm)
+    if router is None:
+        router = 0
+    if not workers:
+        workers = [r for r in range(comm.size) if r != router]
+    return router, [w for w in workers if w != router]
+
+
+from ompi_tpu.serving.scheduler import (ContinuousBatchScheduler,  # noqa: E402
+                                        ServeRequest)
+from ompi_tpu.serving.kv_stream import (KvSlabReceiver,  # noqa: E402
+                                        KvSlabSender)
+from ompi_tpu.serving.router import Router  # noqa: E402
+from ompi_tpu.serving.worker import ShardWorker, worker_main  # noqa: E402
+from ompi_tpu.serving.driver import PoissonDriver  # noqa: E402
+
+__all__ = [
+    "PSET_ROUTER", "PSET_WORKERS", "roles",
+    "ServeRequest", "ContinuousBatchScheduler",
+    "KvSlabSender", "KvSlabReceiver",
+    "Router", "ShardWorker", "worker_main", "PoissonDriver",
+]

@@ -1,0 +1,114 @@
+"""serving/driver — the synthetic heavy-traffic driver.
+
+Poisson arrivals (seeded exponential inter-arrival gaps) with mixed
+prompt/decode lengths, fed into a :class:`~ompi_tpu.serving.router.
+Router` in wall-clock time; the report reads p50/p99 request latency
+out of the otpu-trace ``serve_request`` log2 histogram (the percentile
+estimator of ``runtime/trace.py``) and computes tokens/sec from the
+completed set — the serving benchmark surface ``bench.py --serving``
+publishes, qualitatively different from the OSU-style sweeps (open-loop
+offered load against a queueing system instead of a closed
+request/reply ping-pong).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.base.var import registry
+from ompi_tpu.runtime import trace
+
+
+class PoissonDriver:
+    """Open-loop traffic: ``n_requests`` arrivals at ``rate_rps`` with
+    prompt/decode lengths drawn uniformly from the given ranges."""
+
+    def __init__(self, rate_rps: float = 200.0, n_requests: int = 64,
+                 prompt_lens: tuple = (8, 64),
+                 decode_lens: tuple = (4, 24), seed: int = 0) -> None:
+        rng = np.random.default_rng(seed)
+        self.n_requests = int(n_requests)
+        gaps = rng.exponential(1.0 / float(rate_rps), self.n_requests)
+        self.arrivals_s = np.cumsum(gaps)       # offsets from run start
+        self.prompts = rng.integers(prompt_lens[0], prompt_lens[1] + 1,
+                                    self.n_requests)
+        self.decodes = rng.integers(decode_lens[0], decode_lens[1] + 1,
+                                    self.n_requests)
+        self._next = 0
+
+    def due(self, elapsed_s: float) -> list:
+        """(prompt_len, decode_len) pairs whose arrival time has come."""
+        out = []
+        while (self._next < self.n_requests
+               and self.arrivals_s[self._next] <= elapsed_s):
+            out.append((int(self.prompts[self._next]),
+                        int(self.decodes[self._next])))
+            self._next += 1
+        return out
+
+    @property
+    def exhausted(self) -> bool:
+        return self._next >= self.n_requests
+
+    def run(self, router, max_wall_s: float = 120.0,
+            tick_sleep_s: float = 0.0) -> dict:
+        """Drive the router under this arrival process and report.
+
+        Tracing is force-enabled for the run (the latency histogram IS
+        the measurement instrument) and restored afterwards.
+        """
+        was_enabled = trace.enabled
+        if not was_enabled:
+            registry.set("otpu_trace_enable", True)
+        # fresh percentile population: an earlier run in this process
+        # must not bleed into this run's p50/p99
+        trace.hist_reset("serve_request")
+        t0 = time.perf_counter()
+        try:
+            while True:
+                elapsed = time.perf_counter() - t0
+                if elapsed > max_wall_s:
+                    raise TimeoutError(
+                        f"serving driver exceeded {max_wall_s}s with "
+                        f"{len(router.completed())}/{self.n_requests} "
+                        "requests complete")
+                for prompt_len, decode_len in self.due(elapsed):
+                    router.submit(prompt_len, decode_len)
+                router.tick()
+                if (self.exhausted and not router.sched.depth()
+                        and not router.sched.running()):
+                    break
+                if tick_sleep_s:
+                    time.sleep(tick_sleep_s)
+            elapsed = time.perf_counter() - t0
+            return self.report(router, elapsed)
+        finally:
+            if not was_enabled:
+                registry.set("otpu_trace_enable", False)
+
+    def report(self, router, elapsed_s: float) -> dict:
+        done = router.completed()
+        tokens = sum(len(r.tokens) for r in done)
+        lat_ms = sorted((r.done_ns - r.arrival_ns) / 1e6 for r in done
+                        if r.done_ns is not None)
+        exact_p99 = lat_ms[min(len(lat_ms) - 1,
+                               int(0.99 * len(lat_ms)))] if lat_ms else 0.0
+        return {
+            "requests": len(done),
+            "elapsed_s": round(elapsed_s, 3),
+            "tokens": int(tokens),
+            "tokens_per_s": round(tokens / elapsed_s, 1),
+            "req_per_s": round(len(done) / elapsed_s, 1),
+            # the contract numbers: percentiles interpolated from the
+            # otpu-trace log2 latency histogram
+            "p50_ms": round(
+                trace.hist_percentile("serve_request", 0.50) / 1000.0, 3),
+            "p99_ms": round(
+                trace.hist_percentile("serve_request", 0.99) / 1000.0, 3),
+            # cross-check: exact p99 over the driver's own sample list
+            # (the histogram estimate must sit within a log2 bin of it)
+            "p99_exact_ms": round(exact_p99, 3),
+            "requeued": router.lost_and_requeued,
+        }

@@ -1,0 +1,358 @@
+"""serving/router — the request-router rank.
+
+The router owns admission (the continuous-batching scheduler), the
+worker table, and the engine clock.  Every :meth:`Router.tick`:
+
+1. **recover** — if the FT layer knows a worker died, revoke the comm
+   (so every survivor unblocks with RevokedError), shrink to the
+   ``mpi://surviving`` set, re-shard the worker table, and requeue the
+   dead worker's in-flight requests — zero admitted requests dropped;
+2. **admit** — the scheduler evicts finished sequences and admits
+   queued ones into the freed batch space (strict FIFO), each admission
+   getting a worker (least-loaded) and a KV slot;
+3. **dispatch** — ONE coalesced command message per active worker:
+   colocated workers get ``("work", batch, free_rids)``, disaggregated
+   stage pairs get ``("prefill", epoch, ...)`` to the prefill rank and
+   ``("kv", epoch, ...)`` to its decode peer before the decode work;
+4. **collect** — one coalesced result message per dispatched worker;
+   each completed sequence is verified (deterministic toy model),
+   recorded into the ``serve_request`` otpu-trace latency histogram,
+   and marked done so step 2 evicts it next tick;
+5. **autoscale** — queue depth above the watermark for
+   ``scale_patience`` consecutive ticks triggers ``MPI_Comm_spawn`` of
+   ``scale_step`` fresh workers (collective: the workers were told in
+   the same tick), verified against the dynamic ``mpi://job/<id>``
+   pset, merged parents-first so every rank keeps its rank.
+
+Deployment shapes: ``stages=False`` (default) runs colocated
+prefill+decode workers; ``stages=True`` pairs the worker list — first
+half prefill, second half decode — and streams KV slabs pair-wise.
+After a failure the router always falls back to colocated (a pair may
+have lost one side), matching the workers' own recovery.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+from ompi_tpu.api.errhandler import ERRORS_RETURN
+from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
+                                 RevokedError)
+from ompi_tpu.runtime import spc, trace
+from ompi_tpu.serving.scheduler import (ContinuousBatchScheduler,
+                                        RequestState, ServeRequest)
+from ompi_tpu.serving.worker import TAG_CMD, TAG_RES, toy_token
+
+_HIST = "serve_request"
+
+
+class Router:
+    """Admission + dispatch + recovery for one serving communicator."""
+
+    def __init__(self, comm, scheduler: Optional[ContinuousBatchScheduler]
+                 = None, stages: bool = False, decode_chunk: int = 4,
+                 kv_elems: int = 256,
+                 workers: Optional[list] = None,
+                 scale_watermark: Optional[int] = None,
+                 scale_step: int = 1, scale_patience: int = 3,
+                 scale_cooldown: int = 50,
+                 scale_max_workers: Optional[int] = None,
+                 scale_argv: Optional[list] = None) -> None:
+        from ompi_tpu import serving as _pkg
+
+        comm.set_errhandler(ERRORS_RETURN)
+        self.comm = comm
+        self.me, self.workers = _pkg.roles(comm)
+        if workers is not None:        # explicit table (tests, subsets)
+            self.workers = [int(w) for w in workers if int(w) != self.me]
+        if not self.workers:
+            raise MpiError(ErrorClass.ERR_ARG,
+                           "serving needs at least one worker rank")
+        self.sched = scheduler or ContinuousBatchScheduler()
+        self.stages = bool(stages)
+        self.decode_chunk = int(decode_chunk)
+        self.kv_elems = int(kv_elems)
+        self.scale_watermark = scale_watermark
+        self.scale_step = int(scale_step)
+        self.scale_patience = int(scale_patience)
+        self.scale_cooldown = int(scale_cooldown)
+        # more workers than batch slots can never be busy — the default
+        # cap keeps a persistent backlog from spawning an idle fleet
+        self.scale_max_workers = (int(scale_max_workers)
+                                  if scale_max_workers is not None
+                                  else self.sched.max_batch)
+        self.scale_argv = list(scale_argv) if scale_argv else None
+        self._over_watermark = 0
+        self._scale_cooling = 0
+        self._pair_epoch: dict = {}      # pair index -> last KV epoch
+        self._completed: list = []
+        # eviction notices: recently finished rids, re-sent with every
+        # work dispatch (worker-side pops are idempotent, so repeats
+        # are harmless and no notice can be misrouted across a shrink)
+        self._recent_done: collections.deque = collections.deque(
+            maxlen=64)
+        self._lost_and_requeued = 0
+        if self.stages and len(self.workers) < 2:
+            raise MpiError(ErrorClass.ERR_ARG,
+                           "disaggregated serving needs >= 2 workers "
+                           "(prefill + decode)")
+
+    # -- worker table ------------------------------------------------------
+    def _stage_split(self) -> tuple:
+        """(prefill ranks, decode ranks, extra ranks) — pair i of the
+        first two lists streams KV to each other; ``extra`` (the odd
+        leftover when the worker count is not even) serves colocated,
+        so no rank is silently idle.  Colocated mode decodes
+        everywhere."""
+        if not self.stages:
+            return [], list(self.workers), []
+        half = len(self.workers) // 2
+        return (self.workers[:half], self.workers[half:half * 2],
+                self.workers[half * 2:])
+
+    def _pick_worker(self, decode_ranks) -> int:
+        """Least-loaded decode/colocated rank (running-request count)."""
+        load = {w: 0 for w in decode_ranks}
+        for r in self.sched.running():
+            if r.worker in load:
+                load[r.worker] += 1
+        return min(decode_ranks, key=lambda w: (load[w], w))
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt_len: int, max_new_tokens: int,
+               rid: Optional[int] = None) -> ServeRequest:
+        return self.sched.submit(
+            ServeRequest(prompt_len, max_new_tokens, rid=rid))
+
+    def completed(self) -> list:
+        return list(self._completed)
+
+    @property
+    def lost_and_requeued(self) -> int:
+        """Requests returned to the queue by failure recovery (the
+        serve-through-failure tests assert these all complete)."""
+        return self._lost_and_requeued
+
+    def tick(self) -> None:
+        """One engine tick (see module doc).  Any ULFM error inside the
+        tick routes through recovery and the tick retries cleanly on
+        the shrunken communicator at the next call."""
+        try:
+            self._tick_inner()
+        except (RevokedError, ProcFailedError):
+            self._recover()
+
+    def serve_until_drained(self, max_ticks: int = 100000,
+                            check_invariants: bool = False) -> list:
+        """Tick until every submitted request completed (tests/driver);
+        returns the completed list."""
+        ticks = 0
+        while True:
+            with_work = (self.sched.depth() or self.sched.running()
+                         or None)
+            if with_work is None:
+                break
+            self.tick()
+            if check_invariants:
+                self.sched.check_invariants()
+            ticks += 1
+            if ticks >= max_ticks:
+                raise MpiError(ErrorClass.ERR_INTERN,
+                               f"serving did not drain in {max_ticks} "
+                               "ticks (a request starved)")
+        return self.completed()
+
+    def shutdown(self) -> None:
+        """Tell every worker to exit its serve loop."""
+        for w in list(self.workers):
+            try:
+                self.comm.send_obj(("stop",), w, TAG_CMD)
+            except MpiError:
+                pass                   # a dead worker needs no stop
+
+    # -- the tick ----------------------------------------------------------
+    def _tick_inner(self) -> None:
+        if self._failed_workers():
+            raise ProcFailedError("worker failure detected", ())
+        admitted, _evicted = self.sched.tick()
+        prefill_ranks, decode_ranks, extra_ranks = self._stage_split()
+
+        # worker assignment for fresh admissions (decode pairs + any
+        # colocated leftover share the load)
+        for req in admitted:
+            req.worker = self._pick_worker(decode_ranks + extra_ranks)
+
+        running = self.sched.running()
+        if not running:
+            self._maybe_autoscale()
+            return
+
+        # stage round: stream this tick's new KV blocks pair-wise; a
+        # fresh request on an extra (colocated) rank prefills with its
+        # work command instead
+        fresh = [r for r in running if not r.prefilled]
+        paired = [r for r in fresh if r.worker in decode_ranks] \
+            if self.stages else []
+        if paired:
+            per_pair: dict = {}
+            for r in paired:
+                per_pair.setdefault(decode_ranks.index(r.worker),
+                                    []).append(r)
+            for pair, reqs in sorted(per_pair.items()):
+                # epochs are PER PAIR: each slab pairing counts its own
+                # consecutive rounds (a global counter would desync a
+                # pair that sat out a round)
+                epoch = self._pair_epoch.get(pair, -1) + 1
+                self._pair_epoch[pair] = epoch
+                self.comm.send_obj(
+                    ("prefill", epoch,
+                     [(r.rid, r.slot, r.prompt_len) for r in reqs]),
+                    prefill_ranks[pair], TAG_CMD)
+                self.comm.send_obj(
+                    ("kv", epoch,
+                     [(r.rid, r.slot) for r in reqs]),
+                    decode_ranks[pair], TAG_CMD)
+            # prefill acks, then decode-side kv acks — order-free drain
+            for pair in sorted(per_pair):
+                self._expect(prefill_ranks[pair], "prefilled")
+                self._expect(decode_ranks[pair], "kv_ready")
+        for r in fresh:
+            r.prefilled = True         # paired: streamed above;
+        #                                colocated: rides the work cmd
+
+        # decode micro-batches: one coalesced cmd per active worker
+        per_worker: dict = {}
+        for r in running:
+            n = min(self.decode_chunk, r.remaining)
+            if n > 0:
+                per_worker.setdefault(r.worker, []).append(
+                    (r.rid, r.prompt_len, len(r.tokens), n))
+            elif r.state is not RequestState.DONE:
+                # fully decoded but never marked (e.g. a recovery replay
+                # raced completion): retire it instead of starving
+                self._finish(r)
+        free_rids = list(self._recent_done)
+        for w, batch in sorted(per_worker.items()):
+            self.comm.send_obj(("work", batch, free_rids), w, TAG_CMD)
+        by_rid = {r.rid: r for r in running}
+        for w in sorted(per_worker):
+            kind, results = self._expect_res(w)
+            if kind != "res":
+                raise MpiError(ErrorClass.ERR_INTERN,
+                               f"expected decode results, got {kind!r}")
+            for rid, toks in results:
+                req = by_rid.get(rid)
+                if req is None:
+                    continue           # finished during recovery replay
+                base = len(req.tokens)
+                for i, tok in enumerate(toks):
+                    if tok != toy_token(rid, base + i):
+                        raise MpiError(
+                            ErrorClass.ERR_INTERN,
+                            f"rid {rid} token {base + i} corrupted")
+                req.tokens.extend(toks)
+                if req.remaining <= 0:
+                    self._finish(req)
+        self._maybe_autoscale()
+
+    def _expect_res(self, worker: int):
+        msg = self.comm.recv_obj(worker, TAG_RES)
+        return msg[0], msg[-1]
+
+    def _expect(self, worker: int, kind: str) -> None:
+        msg = self.comm.recv_obj(worker, TAG_RES)
+        if msg[0] != kind:
+            raise MpiError(ErrorClass.ERR_INTERN,
+                           f"expected {kind!r} from worker {worker}, "
+                           f"got {msg[0]!r}")
+
+    def _finish(self, req: ServeRequest) -> None:
+        if req.state is RequestState.DONE:
+            return                     # a replay must not double-count
+        self.sched.mark_done(req)
+        self._completed.append(req)
+        self._recent_done.append(req.rid)   # KV eviction notice
+        if trace.enabled:
+            # request latency (arrival -> last token) into the log2
+            # histogram the percentile estimator reads; "size" is the
+            # token footprint so the bins separate small/large requests
+            trace.hist_record(_HIST, req.cost,
+                              trace.now() - req.arrival_ns)
+
+    # -- failure handling --------------------------------------------------
+    def _failed_workers(self) -> list:
+        from ompi_tpu.ft import state as ft_state
+
+        out = []
+        for w in self.workers:
+            if ft_state.is_failed(self.comm.group.world_rank(w)):
+                out.append(w)
+        return out
+
+    def _recover(self) -> None:
+        """Serve-through-failure, router side: revoke (unblocks every
+        survivor into its own recovery), shrink, re-shard, requeue."""
+        try:
+            self.comm.revoke()
+        except MpiError:
+            pass                       # already revoked is fine
+        new = self.comm.shrink()
+        new.set_errhandler(ERRORS_RETURN)
+        self.comm = new
+        from ompi_tpu import serving as _pkg
+
+        self.me, self.workers = _pkg.roles(new)
+        self.stages = False            # pairs may have lost a side
+        self._pair_epoch.clear()
+        # requeue EVERY in-flight request: results in transit on the
+        # revoked comm are gone, and decode is deterministic so a
+        # replay from tokens_done is bit-identical
+        running = self.sched.running()
+        self._lost_and_requeued += len(running)
+        self.sched.requeue(running)
+
+    # -- autoscaling -------------------------------------------------------
+    def _maybe_autoscale(self) -> None:
+        if self.scale_watermark is None or self.scale_argv is None:
+            return
+        if getattr(self.comm.rte, "client", None) is None:
+            return
+        if self._scale_cooling > 0:    # let the last scale-up absorb
+            self._scale_cooling -= 1
+            return
+        if len(self.workers) >= self.scale_max_workers:
+            return
+        if self.sched.depth() <= self.scale_watermark:
+            self._over_watermark = 0
+            return
+        self._over_watermark += 1
+        if self._over_watermark < self.scale_patience:
+            return
+        self._over_watermark = 0
+        self._scale_cooling = self.scale_cooldown
+        self._scale_up(self.scale_step)
+
+    def _scale_up(self, n: int) -> None:
+        """Spawn ``n`` fresh worker processes and fold them into the
+        serving communicator (collective with the current workers)."""
+        for w in self.workers:
+            self.comm.send_obj(("scale", self.scale_argv, n), w, TAG_CMD)
+        inter = self.comm.spawn(self.scale_argv, n, root=self.me)
+        client = getattr(self.comm.rte, "client", None)
+        job = getattr(inter, "spawn_job", None)
+        if client is not None and job is not None:
+            # the dynamic pset IS the membership contract: the children
+            # we merge with must be exactly the job's published set
+            entry = client.pset_get(f"mpi://job/{job}")
+            members = sorted(int(m) for m in entry["members"])
+            if members != sorted(inter.remote_group.world_ranks):
+                raise MpiError(
+                    ErrorClass.ERR_SPAWN,
+                    f"mpi://job/{job} pset {members} does not match the "
+                    "spawned intercomm")
+        full = inter.merge(high=False)  # parents first: router keeps rank
+        full.set_errhandler(ERRORS_RETURN)
+        self.comm = full
+        new_ranks = list(range(full.size - n, full.size))
+        self.workers = sorted(set(self.workers) | set(new_ranks))
+        spc.record("serve_scaleups")

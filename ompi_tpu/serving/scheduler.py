@@ -1,0 +1,243 @@
+"""serving/scheduler — the continuous-batching admission scheduler.
+
+One object owns the request lifecycle: QUEUED (submitted, waiting) →
+RUNNING (admitted into the in-flight batch, holding a KV slot) → DONE.
+Every engine tick the router calls :meth:`ContinuousBatchScheduler.tick`,
+which first *evicts* sequences that finished since the last tick (their
+KV slots return to the free list immediately — the batch is never
+drained) and then *admits* queued requests strictly in arrival order
+while three budgets hold: batch width (``max_batch``), reserved token
+budget (``max_batch_tokens``, counting ``prompt_len + max_new_tokens``
+per admitted request), and free KV slots.
+
+Strict-FIFO admission is the no-starvation guarantee the tests pin: a
+request is admitted only when it is the OLDEST queued request, so a
+stream of short requests can never overtake a long one indefinitely.
+
+Thread discipline: ``submit`` may be called from a driver thread while
+the router thread ticks, so every queue/batch structure is declared
+``_guarded_by`` the scheduler lock (otpu-lint's lock-discipline pass
+enforces the annotation); :meth:`tick` is tagged ``@hot_path`` — it
+runs once per engine tick and stays inside the allocation budget the
+hot-path pass checks (no pickle, no string formatting, no list concat).
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from typing import Optional
+
+from ompi_tpu.api.errors import ErrorClass, MpiError
+from ompi_tpu.runtime import spc, trace
+from ompi_tpu.runtime.hotpath import hot_path
+
+_rid_counter = itertools.count()
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+
+
+class ServeRequest:
+    """One inference request travelling through the serving engine."""
+
+    __slots__ = ("rid", "prompt_len", "max_new_tokens", "arrival_ns",
+                 "state", "tokens", "slot", "worker", "prefilled",
+                 "admit_ns", "done_ns")
+
+    def __init__(self, prompt_len: int, max_new_tokens: int,
+                 rid: Optional[int] = None) -> None:
+        if prompt_len <= 0 or max_new_tokens <= 0:
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"request needs positive prompt/decode "
+                           f"lengths, got ({prompt_len}, {max_new_tokens})")
+        self.rid = next(_rid_counter) if rid is None else int(rid)
+        self.prompt_len = int(prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.arrival_ns = trace.now()
+        self.state = RequestState.QUEUED
+        self.tokens: list = []           # decoded tokens, router-collected
+        self.slot: Optional[int] = None  # KV slot while RUNNING
+        self.worker: Optional[int] = None
+        self.prefilled = False
+        self.admit_ns: Optional[int] = None
+        self.done_ns: Optional[int] = None
+
+    @property
+    def cost(self) -> int:
+        """Reserved token budget: prompt + the full decode allowance
+        (the batch must never exceed budget even if every admitted
+        sequence runs to its cap)."""
+        return self.prompt_len + self.max_new_tokens
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    def __repr__(self) -> str:
+        return (f"ServeRequest(rid={self.rid}, {self.state.value}, "
+                f"prompt={self.prompt_len}, "
+                f"decoded={len(self.tokens)}/{self.max_new_tokens})")
+
+
+class ContinuousBatchScheduler:
+    """Admission control for the continuous batch (see module doc)."""
+
+    _guarded_by = {
+        "_sq": "_slock", "_running": "_slock", "_done": "_slock",
+        "_free_slots": "_slock",
+    }
+
+    def __init__(self, max_batch: int = 8,
+                 max_batch_tokens: int = 1 << 14,
+                 slots: Optional[int] = None) -> None:
+        if max_batch <= 0 or max_batch_tokens <= 0:
+            raise MpiError(ErrorClass.ERR_ARG,
+                           "scheduler budgets must be positive")
+        self.max_batch = int(max_batch)
+        self.max_batch_tokens = int(max_batch_tokens)
+        self.slots = int(slots) if slots is not None else self.max_batch
+        if self.slots < self.max_batch:
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"{self.slots} KV slots cannot back a batch "
+                           f"of {self.max_batch}")
+        self._slock = threading.Lock()
+        self._sq: list = []             # FIFO admission queue
+        self._running: list = []
+        self._done: list = []
+        self._free_slots = list(range(self.slots - 1, -1, -1))
+        self._used_tokens = 0
+
+    # -- submission (any thread) -----------------------------------------
+    def submit(self, req: ServeRequest) -> ServeRequest:
+        if req.cost > self.max_batch_tokens:
+            raise MpiError(
+                ErrorClass.ERR_ARG,
+                f"request {req.rid} reserves {req.cost} tokens, above "
+                f"the whole-batch budget {self.max_batch_tokens} — it "
+                "could never be admitted")
+        spc.record("serve_requests")
+        with self._slock:
+            self._sq.append(req)
+        return req
+
+    def depth(self) -> int:
+        """Queued (not yet admitted) request count — the autoscaling
+        watermark signal."""
+        with self._slock:
+            return len(self._sq)
+
+    def running(self) -> list:
+        with self._slock:
+            return list(self._running)
+
+    def done_count(self) -> int:
+        with self._slock:
+            return len(self._done)
+
+    def used_tokens(self) -> int:
+        with self._slock:
+            return self._used_tokens
+
+    # -- engine tick (router thread) -------------------------------------
+    @hot_path
+    def tick(self) -> tuple:
+        """One admission round: (admitted, evicted) lists.
+
+        Eviction first — a sequence that finished last tick frees its
+        slot and token reservation for this tick's admissions, which is
+        what keeps the batch continuously full instead of draining.
+        """
+        spc.record("serve_ticks")
+        admitted: list = []
+        evicted: list = []
+        with self._slock:
+            keep: list = []
+            for r in self._running:
+                if r.state is RequestState.DONE:
+                    evicted.append(r)
+                    self._done.append(r)
+                    self._used_tokens -= r.cost
+                    if r.slot is not None:
+                        self._free_slots.append(r.slot)
+                        r.slot = None
+                else:
+                    keep.append(r)
+            self._running = keep
+            while self._sq:
+                head = self._sq[0]
+                if len(self._running) >= self.max_batch:
+                    break
+                if self._used_tokens + head.cost > self.max_batch_tokens:
+                    break
+                if not self._free_slots:
+                    break
+                self._sq.pop(0)
+                head.slot = self._free_slots.pop()
+                head.state = RequestState.RUNNING
+                head.admit_ns = trace.now()
+                self._used_tokens += head.cost
+                self._running.append(head)
+                admitted.append(head)
+        if admitted:
+            spc.record("serve_admitted", len(admitted))
+        if evicted:
+            spc.record("serve_evicted", len(evicted))
+        return admitted, evicted
+
+    def mark_done(self, req: ServeRequest) -> None:
+        """Sequence finished decoding: it leaves the batch at the NEXT
+        tick's eviction sweep (state flip only — callable from the
+        result-drain path without the lock because state is a single
+        attribute store and eviction happens on the tick thread)."""
+        req.done_ns = trace.now()
+        req.state = RequestState.DONE
+
+    # -- failure recovery -------------------------------------------------
+    def requeue(self, reqs) -> None:
+        """Serve-through-failure: push RUNNING requests back to the
+        head of the queue (arrival order preserved) after their worker
+        died.  Decoded tokens survive — decode is deterministic, so a
+        replacement worker continues from ``len(tokens)``."""
+        back = sorted(reqs, key=lambda r: r.arrival_ns)
+        with self._slock:
+            for r in reversed(back):
+                if r not in self._running:
+                    continue
+                if r.state is RequestState.DONE:
+                    # finished before the failure — nothing was lost;
+                    # the next tick's eviction sweep retires it (a
+                    # requeue here would re-admit a request with no
+                    # decode work left, which can never complete again)
+                    continue
+                self._running.remove(r)
+                self._used_tokens -= r.cost
+                if r.slot is not None:
+                    self._free_slots.append(r.slot)
+                    r.slot = None
+                r.state = RequestState.QUEUED
+                r.worker = None
+                r.prefilled = False
+                self._sq.insert(0, r)
+        spc.record("serve_requeued", len(back))
+
+    # -- invariants (tests) ------------------------------------------------
+    def check_invariants(self) -> None:
+        """Raise AssertionError when a batch/budget/slot invariant is
+        violated — the serving tests call this every tick."""
+        with self._slock:
+            assert len(self._running) <= self.max_batch, \
+                "batch width exceeded"
+            used = sum(r.cost for r in self._running)
+            assert used == self._used_tokens, "token accounting drifted"
+            assert used <= self.max_batch_tokens, "token budget exceeded"
+            slots = [r.slot for r in self._running]
+            assert None not in slots, "RUNNING request without a slot"
+            assert len(set(slots)) == len(slots), "slot double-assigned"
+            assert set(slots).isdisjoint(self._free_slots), \
+                "slot both free and assigned"
+            assert len(slots) + len(self._free_slots) == self.slots, \
+                "slots leaked"

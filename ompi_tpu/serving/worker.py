@@ -1,0 +1,269 @@
+"""serving/worker — model-shard worker ranks.
+
+A worker owns one shard of the (toy) model and executes the micro-batch
+commands its router sends each engine tick over the eager lane — one
+coalesced command message per worker per tick, one coalesced result
+message back (per-request messages would pay the per-message software
+overhead 2508.13397 measures in exactly this small-transfer regime).
+
+Roles:
+
+* ``colocated`` (default) — prefill AND decode on the same rank; the KV
+  block of a sequence stays local from prefill to eviction.
+* ``prefill`` — runs prefills only and streams each finished sequence's
+  KV block to its paired decode rank through a
+  :class:`~ompi_tpu.serving.kv_stream.KvSlabSender` epoch per
+  micro-batch.
+* ``decode`` — receives KV blocks (``Parrived`` per slot), copies them
+  into its local cache, and generates tokens.
+
+The "model" is deliberately tiny but *checkable*: ``toy_kv`` and
+``toy_token`` are deterministic functions of the request id, so the
+decode stage verifies every streamed KV block bit-exactly and the
+router verifies every decoded token — a correctness harness for the
+transport, not an ML demo.
+
+Failure story: any communication error that ULFM classifies
+(revocation after the router saw a death, or a direct peer-failure
+report) drops the worker into :meth:`ShardWorker._recover` — shrink to
+the survivors (the coord service has already published
+``mpi://surviving``), rebind to the shrunken communicator, fall back to
+the colocated role (stage pairs may have lost a side), and keep
+serving.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
+                                 RevokedError)
+from ompi_tpu.api.errhandler import ERRORS_RETURN
+from ompi_tpu.runtime import spc
+
+#: user-space tags of the serving protocol (below the 2^20 cap)
+TAG_CMD = 601
+TAG_RES = 602
+TAG_KV = 603
+
+_VOCAB = 50021
+_KV_MOD = 997
+
+
+def toy_kv(rid: int, elems: int) -> np.ndarray:
+    """Deterministic stand-in KV block for request ``rid`` — both stages
+    can recompute it, which turns KV streaming into a checkable
+    transport (the decode side verifies arrival bit-exactly)."""
+    base = (int(rid) * 1009 + np.arange(elems, dtype=np.int64)) % _KV_MOD
+    return (base.astype(np.float32) / _KV_MOD)
+
+
+def toy_token(rid: int, t: int) -> int:
+    """Deterministic token ``t`` of request ``rid`` — decode survives a
+    worker death because a replacement regenerates the identical
+    continuation from ``tokens_done``."""
+    return (int(rid) * 1_000_003 + int(t) * 7919) % _VOCAB
+
+
+class ShardWorker:
+    """One worker rank's engine loop (see module doc)."""
+
+    def __init__(self, comm, router: Optional[int] = None,
+                 role: str = "colocated", peer: Optional[int] = None,
+                 slots: int = 8, kv_elems: int = 256,
+                 kv_partitions: Optional[int] = None) -> None:
+        from ompi_tpu import serving as _pkg
+        from ompi_tpu.serving.kv_stream import (KvSlabReceiver,
+                                                KvSlabSender)
+
+        comm.set_errhandler(ERRORS_RETURN)   # ULFM: errors raise, not abort
+        self.comm = comm
+        self.router = _pkg.roles(comm)[0] if router is None else int(router)
+        self.role = role
+        self.slots, self.kv_elems = int(slots), int(kv_elems)
+        self._kv: dict = {}          # rid -> local KV block (decode state)
+        self._stopped = False
+        self._sender = self._receiver = None
+        if role == "prefill":
+            self._sender = KvSlabSender(comm, int(peer), self.slots,
+                                        self.kv_elems, TAG_KV)
+        elif role == "decode":
+            self._receiver = KvSlabReceiver(comm, int(peer), self.slots,
+                                            self.kv_elems, TAG_KV,
+                                            partitions=kv_partitions)
+
+    # -- compute ----------------------------------------------------------
+    def _prefill(self, rid: int, prompt_len: int) -> np.ndarray:
+        # simulated prefill cost scales with the prompt (a tanh pass
+        # over prompt_len model rows), result is the checkable KV block
+        _ = np.tanh(np.arange(int(prompt_len) * 8,
+                              dtype=np.float32)).sum()
+        return toy_kv(rid, self.kv_elems)
+
+    def _decode(self, rid: int, tokens_done: int, n: int) -> list:
+        kv = self._kv.get(rid)
+        if kv is None:
+            raise MpiError(ErrorClass.ERR_INTERN,
+                           f"decode of rid {rid} without its KV block")
+        # one fused read of the KV block per chunk keeps the toy model
+        # honest about touching its state
+        _ = float(kv[: max(1, n)].sum())
+        return [toy_token(rid, tokens_done + i) for i in range(int(n))]
+
+    # -- command handlers --------------------------------------------------
+    def _handle(self, msg) -> None:
+        kind = msg[0]
+        if kind == "work":
+            self._on_work(msg[1], msg[2])
+        elif kind == "prefill":
+            self._on_prefill(msg[1], msg[2])
+        elif kind == "kv":
+            self._on_kv(msg[1], msg[2])
+        elif kind == "scale":
+            self._on_scale(msg[1], msg[2])
+        elif kind == "stop":
+            self._stopped = True
+        else:
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"unknown serving command {kind!r}")
+
+    def _on_work(self, batch, free_rids) -> None:
+        """Colocated/decode micro-batch: (rid, prompt_len, tokens_done,
+        n) per entry; results are one coalesced reply."""
+        results = []
+        for rid, prompt_len, tokens_done, n in batch:
+            if rid not in self._kv:
+                if self.role == "decode":
+                    raise MpiError(
+                        ErrorClass.ERR_INTERN,
+                        f"decode work for rid {rid} before its KV block")
+                self._kv[rid] = self._prefill(rid, prompt_len)
+            toks = self._decode(rid, tokens_done, n)
+            spc.record("serve_tokens", len(toks))
+            results.append((rid, toks))
+        for rid in free_rids:          # router-confirmed evictions
+            self._kv.pop(rid, None)
+        self.comm.send_obj(("res", results), self.router, TAG_RES)
+
+    def _on_prefill(self, epoch, batch) -> None:
+        """Prefill-stage micro-batch: compute each block, Pready it the
+        moment it is final, aggregate-flush the slab tail."""
+        self._sender.begin_epoch(epoch)
+        rids = []
+        for rid, slot, prompt_len in batch:
+            self._sender.write_slot(slot, self._prefill(rid, prompt_len))
+            self._sender.slot_ready(slot)
+            rids.append(rid)
+        self._sender.finish_epoch(wait=True)
+        self.comm.send_obj(("prefilled", epoch, rids), self.router,
+                           TAG_RES)
+
+    def _on_kv(self, epoch, batch) -> None:
+        """Decode-stage KV intake: poll Parrived per assigned slot, copy
+        the block out (verified against the deterministic model), then
+        drain the epoch's tail so the next one may start."""
+        from ompi_tpu.runtime.progress import progress
+
+        self._receiver.begin_epoch(epoch)
+        pending = list(batch)
+        rids = []
+        while pending:
+            still = []
+            for rid, slot in pending:
+                if self._receiver.slot_arrived(slot):
+                    block = self._receiver.read_slot(slot)
+                    expect = toy_kv(rid, self.kv_elems)
+                    if not np.array_equal(block, expect):
+                        raise AssertionError(
+                            f"KV stream corrupted rid {rid} slot {slot}")
+                    self._kv[rid] = block
+                    rids.append(rid)
+                else:
+                    still.append((rid, slot))
+            pending = still
+            if pending:
+                progress()
+        self._receiver.finish_epoch()
+        self.comm.send_obj(("kv_ready", epoch, rids), self.router,
+                           TAG_RES)
+
+    def _on_scale(self, argv, n) -> None:
+        """Autoscale participation: spawn is collective over the comm,
+        so every worker joins the router's MPI_Comm_spawn + merge; the
+        merged communicator (parents first) replaces ours."""
+        inter = self.comm.spawn(list(argv), int(n), root=self.router)
+        full = inter.merge(high=False)
+        full.set_errhandler(ERRORS_RETURN)
+        self.comm = full               # router keeps comm-rank 0 ordering
+
+    # -- engine loop -------------------------------------------------------
+    def step(self) -> bool:
+        """Handle at most one pending command; False when idle."""
+        found, _st = self.comm.iprobe(self.router, TAG_CMD)
+        if not found:
+            return False
+        msg = self.comm.recv_obj(self.router, TAG_CMD)
+        self._handle(msg)
+        return True
+
+    def serve(self) -> None:
+        """Loop until the router says stop.  Revocation (the router saw
+        a death) or a direct peer-failure report drops into recovery;
+        a dead ROUTER ends the loop — workers cannot serve without
+        admission control."""
+        idle_s = 0.0005
+        while not self._stopped:
+            try:
+                if not self.step():
+                    time.sleep(idle_s)
+            except RevokedError:
+                self._recover()
+            except ProcFailedError:
+                from ompi_tpu.ft import state as ft_state
+
+                router_world = self.comm.group.world_rank(self.router)
+                if ft_state.is_failed(router_world):
+                    return             # no admission control left
+                self._recover()
+
+    def _recover(self) -> None:
+        """Serve-through-failure, worker side: shrink with the other
+        survivors, rebind, fall back to the colocated role (a stage
+        pair may have lost its other half), keep serving."""
+        for stream in (self._sender, self._receiver):
+            if stream is not None:
+                try:
+                    stream.free()
+                except Exception:
+                    pass               # stream rode the dead comm
+        self._sender = self._receiver = None
+        new = self.comm.shrink()
+        new.set_errhandler(ERRORS_RETURN)
+        self.comm = new
+        from ompi_tpu import serving as _pkg
+
+        self.router = _pkg.roles(new)[0]
+        self.role = "colocated"
+
+
+def worker_main() -> int:
+    """Entry point of an AUTOSCALED worker process (``python -m
+    ompi_tpu.serving.worker``): meet the parents through
+    ``MPI_Comm_get_parent``, merge into their serving communicator
+    (children rank after parents, so the router's rank is unchanged),
+    and serve."""
+    import ompi_tpu
+
+    ompi_tpu.init()
+    parent = ompi_tpu.get_parent()
+    if parent is None:
+        raise SystemExit("serving worker_main: not a spawned process")
+    full = parent.merge(high=True)
+    ShardWorker(full, router=0).serve()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(worker_main())

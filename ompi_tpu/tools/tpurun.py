@@ -373,6 +373,18 @@ def main(argv=None) -> int:
                          "NAME with the given ranks (comma list with "
                          "ranges: 'workers:0,2-3'); sessions resolve it "
                          "via Session.group_from_pset")
+    ap.add_argument("--router-ranks", default=None, metavar="RANKS",
+                    dest="router_ranks",
+                    help="Serving role flag: publish the given ranks "
+                         "(comma list with ranges) as the "
+                         "'mpi://serving/router' pset — "
+                         "ompi_tpu.serving.roles() resolves placement "
+                         "from it")
+    ap.add_argument("--worker-ranks", default=None, metavar="RANKS",
+                    dest="worker_ranks",
+                    help="Serving role flag: publish the given ranks as "
+                         "the 'mpi://serving/workers' pset (the serving "
+                         "router's model-shard worker table)")
     ap.add_argument("--device-world", action="store_true",
                     dest="device_world",
                     help="Boot a multi-process device world: every rank "
@@ -446,6 +458,12 @@ def main(argv=None) -> int:
     for spec_s in args.pset:
         pname, pranks = _parse_pset(spec_s, args.nprocs)
         server.publish_pset(pname, pranks, source="user")
+    # serving role psets (ompi_tpu.serving.roles) — same RANKS syntax
+    for flag, pset_name in ((args.router_ranks, "mpi://serving/router"),
+                            (args.worker_ranks, "mpi://serving/workers")):
+        if flag:
+            _, pranks = _parse_pset(f"serving:{flag}", args.nprocs)
+            server.publish_pset(pset_name, pranks, source="user")
 
     if args.device_world:
         # jax.distributed coordinator lives INSIDE rank 0's process;

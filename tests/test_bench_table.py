@@ -90,6 +90,34 @@ def test_rget_speedup_pinned(pins):
                 f"the zero-copy path degraded")
 
 
+def test_serving_rows_pinned(pins):
+    """The serving benchmark rows (bench.py --serving: Poisson driver
+    against the continuous-batching engine) must stay in the committed
+    sweep with sane throughput/latency.  Wide tolerances — an open-loop
+    queueing benchmark on a loaded CI host is noisy — but a collapse
+    (4x latency, 4x throughput loss) fails."""
+    sweep = _load("BENCH_SWEEP.json")
+    rows = {r.get("coll"): r for r in sweep["results"]}
+    for key, pin in pins["serving_tokens_per_s"].items():
+        r = rows.get(key)
+        assert r is not None, f"pinned serving row {key} vanished"
+        assert r.get("ok", True), f"{key}: serving bench FAILED"
+        got = r["tokens_per_s"]
+        assert got >= 0.25 * pin, (
+            f"{key}: {got} tokens/s vs pin {pin} — >4x throughput "
+            "collapse in the serving engine")
+    for key, pin in pins["serving_p99_ms"].items():
+        r = rows[key]
+        got = r["p99_ms"]
+        assert got <= 4.0 * pin, (
+            f"{key}: p99 {got}ms vs pin {pin}ms — >4x tail-latency "
+            "regression")
+        # the histogram estimator must agree with the driver's exact
+        # sample to within its one-log2-bin contract
+        assert r["p99_ms"] <= 2.0 * r["p99_exact_ms"] + 1.0
+        assert r["p99_exact_ms"] <= 2.0 * r["p99_ms"] + 1.0
+
+
 def test_mfu_rows_structure():
     """The MFU section (single-chip FLOPs utilization) must exist with
     all three rows once a sweep has been produced by a bench new enough

@@ -13,6 +13,11 @@ import sys
 import textwrap
 from pathlib import Path
 
+import pytest
+
+import ompi_tpu
+from ompi_tpu.api.errors import ErrorClass, MpiError
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -168,6 +173,106 @@ def test_recovery_shrink_spawn_merge(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("recovered OK") == 2
     assert "replacement joined OK" in r.stdout
+
+
+class _FakeSpawnClient:
+    """Coord-client stand-in for the spawn partial-failure paths: a
+    configurable rank allocation and a join KV that never fills."""
+
+    def __init__(self, ranks, job="job9"):
+        self._ranks, self._job = list(ranks), job
+
+    def fetch_add(self, rank, key, delta):
+        return 0                      # first bridge CID: _DPM_CID_BASE
+
+    def spawn(self, cmd, n, env=None):
+        return list(self._ranks), self._job
+
+    def get(self, rank, key, wait=True, timeout=60.0):
+        return None                   # the join marker never appears
+
+
+@pytest.fixture
+def inproc_world():
+    from ompi_tpu.runtime import init as rt
+
+    rt.reset_for_testing()
+    w = ompi_tpu.init()
+    yield w
+    rt.reset_for_testing()
+
+
+def test_spawn_short_rank_list_releases_cid(inproc_world):
+    """A launcher that allocates fewer ranks than requested must raise a
+    loud ERR_SPAWN and give the reserved bridge CID back — not hand the
+    caller a short-sized intercommunicator."""
+    from ompi_tpu import dpm
+    from ompi_tpu.runtime import init as rt
+
+    w = inproc_world
+    old = getattr(w.rte, "client", None)
+    w.rte.client = _FakeSpawnClient(ranks=[100])   # 1 of 2 requested
+    try:
+        with pytest.raises(MpiError) as ei:
+            w.spawn([sys.executable, "-c", "pass"], 2)
+        assert ei.value.error_class is ErrorClass.ERR_SPAWN
+        assert "allocated 1 of 2" in str(ei.value)
+        assert rt.is_cid_free(dpm._DPM_CID_BASE + 0), \
+            "failed spawn leaked its reserved bridge CID"
+    finally:
+        w.rte.client = old
+
+
+def test_spawn_join_timeout_releases_cid(inproc_world):
+    """Children that never reach the runtime (die during join) must trip
+    the join-handshake timeout into ERR_SPAWN with the CID released."""
+    from ompi_tpu import dpm
+    from ompi_tpu.base.var import registry
+    from ompi_tpu.runtime import init as rt
+
+    w = inproc_world
+    var = registry.lookup("otpu_dpm_spawn_timeout")
+    old_t, old_client = var.value, getattr(w.rte, "client", None)
+    var.set(0.2)
+    w.rte.client = _FakeSpawnClient(ranks=[100, 101])
+    try:
+        with pytest.raises(MpiError) as ei:
+            w.spawn([sys.executable, "-c", "pass"], 2)
+        assert ei.value.error_class is ErrorClass.ERR_SPAWN
+        assert "did not join" in str(ei.value)
+        assert rt.is_cid_free(dpm._DPM_CID_BASE + 0)
+    finally:
+        var.set(old_t)
+        w.rte.client = old_client
+
+
+def test_spawn_child_dies_during_join(tmp_path):
+    """Multi-process regression: a child that exits before reaching the
+    runtime turns into ERR_SPAWN at the parent (fast, via the
+    launcher's proc_failed report) — and the parent's world remains
+    fully usable afterwards."""
+    script = tmp_path / "deadspawn.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        import numpy as np, ompi_tpu
+        from ompi_tpu.api.errors import ErrorClass, MpiError
+        from ompi_tpu.base.var import registry
+        import ompi_tpu.dpm                  # registers the timeout var
+        w = ompi_tpu.init()
+        registry.set("otpu_dpm_spawn_timeout", 30.0)
+        try:
+            w.spawn([sys.executable, "-c", "import sys; sys.exit(3)"], 1)
+            raise AssertionError("spawn of a dying child succeeded")
+        except MpiError as e:
+            assert e.error_class is ErrorClass.ERR_SPAWN, e
+        out = np.asarray(w.allreduce(np.ones(1)))
+        assert out[0] == w.size
+        print(f"SPAWNFAIL OK {w.rank}", flush=True)
+    """))
+    r = _tpurun(1, [sys.executable, str(script)], timeout=120,
+                extra=("--enable-recovery",))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SPAWNFAIL OK" in r.stdout
 
 
 def test_publish_lookup_name(tmp_path):

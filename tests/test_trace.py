@@ -105,6 +105,68 @@ def test_concurrent_recording_is_consistent(tracer):
     assert len(events) == per_thread * nthreads
 
 
+def test_hist_percentile_interpolates_log2_bins(tracer):
+    # a known latency population: 90 fast (1us) + 10 slow (1ms) calls.
+    # p50 must land in the fast bin, p99 in the slow bin — each within
+    # its log2 bin (the estimator's contract), clamped to observed
+    # min/max.
+    for _ in range(90):
+        trace.hist_record("serve_request", 256, 1_000)
+    for _ in range(10):
+        trace.hist_record("serve_request", 256, 1_000_000)
+    p50 = trace.hist_percentile("serve_request", 0.5)
+    p99 = trace.hist_percentile("serve_request", 0.99)
+    assert 1.0 <= p50 <= 2.0, p50          # us; fast bin [512ns, 1024ns]+clamp
+    assert 512.0 <= p99 <= 1048.0, p99     # us; slow bin [2^19, 2^20) ns
+    assert p50 <= trace.hist_percentile("serve_request", 0.9) <= p99
+
+    # single-bin population: clamping pins the estimate to observed range
+    for _ in range(10):
+        trace.hist_record("one_bin", 8, 700)
+    assert trace.hist_percentile("one_bin", 0.99) == pytest.approx(
+        0.7, abs=0.3)
+
+
+def test_hist_percentile_merges_size_bins_and_filters(tracer):
+    trace.hist_record("bcast", 64, 10_000)        # 64b size bin, 10us
+    trace.hist_record("bcast", 1 << 20, 90_000)   # 1m size bin, 90us
+    # per-size-bin query sees only its own cell
+    assert trace.hist_percentile("bcast", 0.5, nbytes=64) < 20.0
+    assert trace.hist_percentile("bcast", 0.5, nbytes=1 << 20) > 60.0
+    # merged query spans both; an unknown coll reports 0
+    merged = trace.hist_percentile("bcast", 0.99)
+    assert merged >= 64.0
+    assert trace.hist_percentile("nope", 0.5) == 0.0
+    with pytest.raises(ValueError):
+        trace.hist_percentile("bcast", 1.5)
+
+
+def test_hist_reset_starts_fresh_population(tracer):
+    for _ in range(50):
+        trace.hist_record("serve_request", 64, 1_000_000)   # 1ms
+    assert trace.hist_percentile("serve_request", 0.5) > 500.0
+    trace.hist_reset("serve_request")
+    assert trace.hist_percentile("serve_request", 0.5) == 0.0
+    trace.hist_record("serve_request", 64, 1_000)           # 1us
+    assert trace.hist_percentile("serve_request", 0.99) < 10.0
+    # other collectives' cells survive the reset
+    trace.hist_record("bcast", 64, 5_000)
+    trace.hist_reset("serve_request")
+    assert trace.hist_percentile("bcast", 0.5) > 0.0
+
+
+def test_hist_percentile_pvars_via_read_path(tracer):
+    for d in (1_000, 2_000, 4_000, 1_000_000):
+        trace.hist_record("allreduce", 4096, d)
+    by_name = {p.name: p for p in registry.all_pvars()}
+    pv50 = by_name.get("otpu_trace_hist_allreduce_4k_p50_us")
+    pv99 = by_name.get("otpu_trace_hist_allreduce_4k_p99_us")
+    assert pv50 is not None and pv99 is not None
+    v50, v99 = pv50.read(), pv99.read()
+    assert 0 < v50 < v99 <= 1000.0
+    assert v99 > 100.0      # pulled toward the 1ms outlier
+
+
 def test_ring_overwrites_oldest(tracer):
     n = trace._ring_n
     for i in range(n + 100):
