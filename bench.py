@@ -789,6 +789,127 @@ def refresh_serving_tables() -> list:
     return rows
 
 
+_RECOVERY = """
+import json, sys
+import ompi_tpu
+from ompi_tpu.parallel.elastic import ElasticTrainer
+
+w = ompi_tpu.init()
+tr = ElasticTrainer(w, ckpt_dir=sys.argv[1], model_size=32,
+                    global_batch=40, ckpt_every=4, respawn=False)
+tr.train(20)
+if tr.comm.rank == 0:
+    print("RECOVERY " + json.dumps(tr.recoveries), flush=True)
+ompi_tpu.finalize()
+"""
+
+
+def recovery_rows() -> list:
+    """``bench.py --recovery``: detect→resume latency of the elastic
+    train-through-failure loop.  One 5-rank job with a chaos kill
+    schedule that fells three ranks at different steps — three full
+    revoke→agree→shrink→restore recoveries — reporting p50/p99 of the
+    end-to-end recovery time plus the median per-phase split.  The
+    launcher-detection path (--enable-recovery), not the heartbeat
+    ring, so the number is the runtime's recovery cost, not the
+    detector timeout."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(_RECOVERY)
+        script = f.name
+    ckpt = tempfile.mkdtemp(prefix="otpu-recovery-")
+    spec = "kill:rank=1,step=6;kill:rank=2,step=11;kill:rank=3,step=16"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "5",
+             "--enable-recovery", "--mca", "otpu_chaos_spec", spec,
+             sys.executable, script, ckpt],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if "RECOVERY " in ln), None)
+        if line is None:
+            print(f"recovery bench failed (rc={proc.returncode}):\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            return [{"coll": "recovery_detect_to_resume", "ok": False}]
+        recs = _json.loads(line.split("RECOVERY ", 1)[1])
+        totals = sorted(r["total_ms"] for r in recs)
+        phases = {}
+        for ph in ("revoke", "agree", "shrink", "restore"):
+            vals = sorted(r[ph + "_ms"] for r in recs if ph + "_ms" in r)
+            if vals:
+                phases[ph] = round(vals[len(vals) // 2], 3)
+        return [{
+            "coll": "recovery_detect_to_resume",
+            "nbytes": len(totals),
+            "p50_ms": round(totals[len(totals) // 2], 3),
+            "p99_ms": round(totals[-1], 3),
+            "min_ms": round(totals[0], 3),
+            "phase_median_ms": phases,
+        }]
+    finally:
+        import shutil
+
+        os.unlink(script)
+        shutil.rmtree(ckpt, ignore_errors=True)
+
+
+def _recovery_md_section(rows) -> list:
+    lines = ["", "## Recovery (elastic train-through-failure)",
+             "",
+             "Detect→resume latency of the full "
+             "revoke→agree→shrink→restore recovery sequence "
+             "(`bench.py --recovery`: 5-rank job, 3 chaos-scheduled "
+             "rank kills).  Launcher detection; add the detector "
+             "timeout for heartbeat-detected hangs.",
+             "",
+             "| rows | samples | p50 ms | p99 ms | min ms | "
+             "phase medians (ms) |", "|---|---|---|---|---|---|"]
+    for r in rows:
+        if not r.get("ok", True):
+            lines.append(f"| {r['coll']} | FAILED | - | - | - | - |")
+            continue
+        ph = "; ".join(f"{k}={v}" for k, v in
+                       r.get("phase_median_ms", {}).items())
+        lines.append(
+            f"| {r['coll']} | {r['nbytes']} | {r['p50_ms']} | "
+            f"{r['p99_ms']} | {r['min_ms']} | {ph} |")
+    return lines
+
+
+def refresh_recovery_tables() -> list:
+    """``bench.py --recovery``: run the recovery rows and fold them
+    into the committed sweep tables (replacing previous recovery rows);
+    everything else is left untouched — the serving-table discipline."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows = recovery_rows()
+    try:
+        with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        payload = {"ndev": 0, "results": []}
+    payload["results"] = [r for r in payload.get("results", [])
+                          if not str(r.get("coll", "")).startswith(
+                              "recovery_")] + rows
+    _atomic_write(os.path.join(here, "BENCH_SWEEP.json"),
+                  json.dumps(payload, indent=1))
+    md_path = os.path.join(here, "BENCH_SWEEP.md")
+    try:
+        with open(md_path) as f:
+            md = f.read()
+    except OSError:
+        md = "# Collective sweep\n"
+    head, _sep, _old = md.partition(
+        "\n## Recovery (elastic train-through-failure)")
+    _atomic_write(md_path, head.rstrip("\n") + "\n"
+                  + "\n".join(_recovery_md_section(rows)) + "\n")
+    return rows
+
+
 _STAGING_OSU = """
 import json, statistics, sys, time
 import numpy as np
@@ -1291,20 +1412,20 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
                 stale_device_rows=None, stale_rounds=0,
                 mfu=None) -> None:
     here = os.path.dirname(os.path.abspath(__file__))
-    # serving rows are refreshed by `bench.py --serving`, not by the
-    # sweep: carry the committed ones forward so a sweep refresh cannot
-    # erase them (the carried-device-rows discipline)
-    serving_prev = []
-    if not any(str(r.get("coll", "")).startswith("serving_")
-               for r in results):
-        try:
-            with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
-                serving_prev = [
-                    r for r in json.load(f).get("results", [])
-                    if str(r.get("coll", "")).startswith("serving_")]
-        except (OSError, ValueError):
-            serving_prev = []
-        results = results + serving_prev
+    # serving/recovery rows are refreshed by `bench.py --serving` /
+    # `--recovery`, not by the sweep: carry the committed ones forward
+    # so a sweep refresh cannot erase them (the carried-device-rows
+    # discipline)
+    for prefix in ("serving_", "recovery_"):
+        if not any(str(r.get("coll", "")).startswith(prefix)
+                   for r in results):
+            try:
+                with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
+                    results = results + [
+                        r for r in json.load(f).get("results", [])
+                        if str(r.get("coll", "")).startswith(prefix)]
+            except (OSError, ValueError):
+                pass
     payload = {"ndev": ndev, "results": results}
     if mfu:
         payload["mfu"] = mfu
@@ -1319,7 +1440,8 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
         lines += [header_note, ""]
     lines += [f"Devices: {ndev}", ""] + _table(
         [r for r in results
-         if not str(r.get("coll", "")).startswith("serving_")])
+         if not str(r.get("coll", "")).startswith(("serving_",
+                                                   "recovery_"))])
     if mfu:
         lines += ["", "## Single-chip MFU", ""]
         for r in mfu:
@@ -1348,6 +1470,10 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
                    if str(r.get("coll", "")).startswith("serving_")]
     if serving_now:
         lines += _serving_md_section(serving_now)
+    recovery_now = [r for r in results
+                    if str(r.get("coll", "")).startswith("recovery_")]
+    if recovery_now:
+        lines += _recovery_md_section(recovery_now)
     _atomic_write(os.path.join(here, "BENCH_SWEEP.md"),
                   "\n".join(lines) + "\n")
 
@@ -1987,6 +2113,9 @@ if __name__ == "__main__":
             print(row)
     elif "--serving" in sys.argv:
         for row in refresh_serving_tables():
+            print(json.dumps(row))
+    elif "--recovery" in sys.argv:
+        for row in refresh_recovery_tables():
             print(json.dumps(row))
     elif "--pod-smoke" in sys.argv:
         sys.exit(pod_smoke(dry_run="--dry-run" in sys.argv))

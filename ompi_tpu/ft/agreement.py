@@ -420,6 +420,15 @@ def _p2p_send(rte, dst_world: int, op: str, instance: tuple,
 
     import numpy as np
 
+    from ompi_tpu.ft import chaos
+
+    if chaos.enabled and op in ("prepare", "decision"):
+        # protocol-phase kill points: 'kill:site=agree_prepare,count=k'
+        # dies before sending prepare frame #(k+1) — the
+        # cascading-takeover windows ERA's early-return tables exist for
+        # (the designed worst cases of tests/test_ft_fuzz.py)
+        chaos.kill_point("agree_" + op)
+
     from ompi_tpu.mca.bml import resolve_bml
     from ompi_tpu.mca.btl.base import CTL, Frag
     from ompi_tpu.runtime import init as rt

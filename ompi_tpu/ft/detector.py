@@ -34,6 +34,13 @@ _grace_var = registry.register(
     help="Extra staleness allowance before a rank whose heartbeat was "
          "NEVER observed is declared failed (the reference arms the "
          "timeout relative to heartbeat activation, not first poll)")
+_jitter_var = registry.register(
+    "ft", None, "detector_jitter", vtype=VarType.FLOAT, default=0.2,
+    help="Deterministic per-rank jitter fraction applied to the "
+         "heartbeat period (rank-seeded, +/-20% by default): "
+         "desynchronises the ring's emission ticks so one busy node "
+         "cannot produce a synchronized false-suspicion storm; 0 "
+         "restores lockstep periods")
 
 
 class Detector:
@@ -49,8 +56,22 @@ class Detector:
         from ompi_tpu.rte.coord import CoordClient
 
         self.rte = rte
-        self.client = CoordClient()
-        self.period = float(_period_var.value)
+        # retries=0: heartbeats have the p2p carrier as their fallback;
+        # a dead coord must flip coord_up, not park the emitter thread
+        # in a reconnect backoff (which would silence OUR heartbeats and
+        # get this rank falsely declared dead)
+        self.client = CoordClient(retries=0)
+        # deterministic per-rank period jitter: with N ranks sharing one
+        # oversubscribed host, lockstep emission ticks alias against the
+        # scheduler quantum and a single busy core can stall EVERY
+        # rank's heartbeat in the same window — a synchronized
+        # false-suspicion storm.  Seeded by rank: reproducible runs.
+        import random as _random
+
+        jf = float(_jitter_var.value or 0.0)
+        j = 1.0 + jf * (2.0 * _random.Random(
+            f"ft-jitter:{rte.my_world_rank}").random() - 1.0)
+        self.period = float(_period_var.value) * j
         self.timeout = float(_timeout_var.value)
         self.startup_grace = float(_grace_var.value)
         self._stop = threading.Event()
@@ -155,6 +176,24 @@ class Detector:
         for r in range(self.rte.world_size):
             if r != me and not self._known_gone(r):
                 self._send_frag(r, meta)
+
+    def wire_suspicion(self, rank: int) -> None:
+        """A btl reported peer-reset/EOF on ``rank``'s connection
+        mid-traffic (``propagator.wire_suspicion``).  A known clean
+        departure (tombstone) or already-failed rank is ignored; an
+        unexplained reset is treated as failure evidence and reported —
+        the wire IS a heartbeat carrier, and a reset is the loudest
+        possible missed heartbeat."""
+        me = self.rte.my_world_rank
+        if rank == me or self._known_gone(rank):
+            return
+        from ompi_tpu.ft import propagator
+        from ompi_tpu.runtime import trace
+
+        if trace.enabled:
+            trace.instant("ft_wire_suspicion", "ft", args={"rank": rank})
+        propagator.report_failure(self.rte, rank, origin="wire-reset",
+                                  client=self.client)
 
     def _on_hb(self, frag) -> None:
         """CTL receive path (runs on whatever thread drives progress)."""

@@ -176,7 +176,10 @@ class EventPoller:
         from ompi_tpu.rte.coord import CoordClient
 
         self.rte = rte
-        self.client = CoordClient()
+        # retries=0: the poller's fallback carrier is the p2p flood —
+        # a dead coord must end the poll loop fast, not stall it
+        # through the reconnect backoff ladder
+        self.client = CoordClient(retries=0)
         self.interval = interval
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -223,6 +226,27 @@ class EventPoller:
 
 _poller: Optional[EventPoller] = None
 _detector = None
+
+
+def wire_suspicion(world_rank: int) -> None:
+    """A transport saw a peer reset / unexpected EOF mid-traffic: route
+    it into the failure detector as a suspicion instead of letting the
+    btl raise (or silently drop) into the application.  No-op when no
+    detector is running — the wire alone cannot distinguish a clean
+    teardown from a death, so only a job that opted into detection
+    (``ft_detector``) treats resets as failure evidence.
+
+    The report runs on its OWN short-lived thread: it publishes over
+    the detector's coordination connection, and a hung-but-alive coord
+    would otherwise park the btl progress loop (the caller) for a full
+    RPC timeout — freezing this rank's transports and heartbeats, and
+    turning one wire reset into a cascading false-death."""
+    det = _detector
+    if det is None:
+        return
+    threading.Thread(target=det.wire_suspicion,
+                     args=(int(world_rank),),
+                     name="otpu-ft-wire-suspicion", daemon=True).start()
 
 
 def start(rte, with_detector: bool = False) -> None:

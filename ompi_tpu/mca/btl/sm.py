@@ -23,7 +23,8 @@ from typing import Optional
 
 from ompi_tpu.base.containers import Fifo
 from ompi_tpu.base.var import VarType
-from ompi_tpu.mca.btl.base import Btl, Endpoint, Frag, owned_bytes
+from ompi_tpu.ft import chaos
+from ompi_tpu.mca.btl.base import CTL, Btl, Endpoint, Frag, owned_bytes
 from ompi_tpu.runtime.hotpath import hot_path
 
 _HDR = struct.Struct("<QQ")  # head, tail
@@ -319,8 +320,23 @@ class SmBtl(Btl):
 
     @hot_path
     def send(self, ep: Endpoint, frag: Frag) -> None:
+        chaos_dup = False
+        if chaos.enabled:
+            rule = chaos.wire_send("sm", frag.kind == CTL)
+            if rule is not None:
+                fault = rule["fault"]
+                if fault == "drop":
+                    return          # best-effort CTL frame lost
+                if fault == "delay":
+                    chaos.sleep_ms(rule)
+                chaos_dup = fault == "dup"
         ring = self._ring_to(ep.world_rank, ep.addr)
         hdr = _frame_hdr(frag)
+        if chaos_dup:
+            # framing-level duplicate of an idempotent CTL frame
+            if not ring.push_frame(hdr, frag.data):
+                self._pending.setdefault(ep.world_rank, Fifo()).push(
+                    (hdr, owned_bytes(frag.data)))
         if not ring.push_frame(hdr, frag.data):
             # defer with an OWNED payload copy: the caller's request may
             # complete (eager) and the user reuse the buffer before the
@@ -348,7 +364,18 @@ class SmBtl(Btl):
                 if buf is None:
                     break
                 if self._recv_cb is not None:
-                    self._recv_cb(_unframe(buf))
+                    frag = _unframe(buf)
+                    if chaos.enabled:
+                        rule = chaos.wire_recv("sm", frag.kind == CTL)
+                        if rule is not None:
+                            fault = rule["fault"]
+                            if fault == "delay":
+                                chaos.sleep_ms(rule)
+                            elif fault == "drop" and frag.kind == CTL:
+                                continue   # delivery withheld
+                            elif fault == "dup" and frag.kind == CTL:
+                                self._recv_cb(_unframe(buf))
+                    self._recv_cb(frag)
                     events += 1
         # retry pending writes
         for rank, fifo in self._pending.items():

@@ -41,10 +41,12 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Optional
 
 from ompi_tpu.base.var import VarType
+from ompi_tpu.ft import chaos
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, \
     Btl, Endpoint, Frag
 from ompi_tpu.runtime import sanitizer, spc, trace
@@ -56,6 +58,20 @@ _MAX_FRAME = (1 << 32) - 1          # the !I length prefix's ceiling
 # header-type byte (per-fragment negotiation)
 _H_PICKLE = 0
 _H_FAST = 1
+# checksummed variants (htype + _H_CK_BASE): the frame carries a crc32
+# of everything after the crc field.  Armed under chaos / OTPU_SANITIZE
+# on the SEND side; the receiver verifies whatever arrives checksummed,
+# so mixed-arming jobs interoperate.  Silent wire corruption becomes a
+# loud, attributed error instead of a downstream mystery.
+_H_CK_BASE = 2
+_CKSUM = struct.Struct("!I")
+
+
+def _cksum_armed() -> bool:
+    """Frame checksumming is opt-in: chaos (corruption is being
+    *injected*) or the sanitizer hard-assertion mode arms it; the
+    default fast path never pays the crc."""
+    return chaos.enabled or sanitizer.enabled
 
 # fast header: cid, src, dst (u32), tag (i32), seq (i64), kind (u8),
 # total_len, offset, req_id (i64; req_id -1 = no meta)
@@ -145,6 +161,7 @@ class TcpBtl(Btl):
     #: snapshots (GIL-atomic dict get; _pick tolerates a concurrently
     #: shrunk list).
     _guarded_by = {"_by_rank": "_conns_lock",
+                   "_suspects": "_conns_lock",
                    "_connect_locks": "_locks_guard"}
 
     def __init__(self) -> None:
@@ -162,6 +179,10 @@ class TcpBtl(Btl):
         self._locks_guard = threading.Lock()
         self._connect_locks: dict[int, threading.Lock] = {}  # per peer
         self._connect_backoff: dict[int, float] = {}   # rank -> retry-after
+        # peers whose connection died mid-traffic (reset/EOF), pending
+        # hand-off to the FT detector as suspicions — filled under
+        # _conns_lock in _drop_conn, drained lock-free by send/progress
+        self._suspects: list[int] = []
 
     def register_vars(self, fw) -> None:
         self.register_var(
@@ -308,10 +329,20 @@ class TcpBtl(Btl):
         # (a shutdown tombstone flood must not block connecting to a
         # possibly-dead peer)
         meta = frag.meta or {}
+        chaos_rule = None
+        if chaos.enabled:
+            chaos_rule = chaos.wire_send("tcp", frag.kind == CTL)
+            if chaos_rule is not None:
+                fault = chaos_rule["fault"]
+                if fault == "drop":
+                    return          # best-effort CTL frame lost
+                if fault == "delay":
+                    chaos.sleep_ms(chaos_rule)
+                    chaos_rule = None
         nbytes = getattr(frag.data, "nbytes", None)
         if nbytes is None:
             nbytes = len(frag.data)
-        if nbytes + (1 + _FAST.size + _LEN.size) > _MAX_FRAME:
+        if nbytes + (1 + _FAST.size + _LEN.size + _CKSUM.size) > _MAX_FRAME:
             # early check on the payload alone so the failure fires
             # before any connect/memoryview work; a pickle header can
             # outgrow the assumed fast-header size, so the built frame
@@ -326,6 +357,23 @@ class TcpBtl(Btl):
             conn = self._pick(ep.world_rank, conns)
         else:
             conn = self._connect(ep.world_rank, best_effort=ft)
+        if chaos_rule is not None and chaos_rule["fault"] == "reset":
+            # injected connection reset: shutdown (the selector sees a
+            # readable EOF and runs the normal teardown, which also
+            # routes the reset into the detector as a suspicion).  A
+            # best-effort CTL frame is silently lost, exactly like a
+            # real reset; application traffic fails loudly.
+            try:
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._drop_conn(conn)
+            self._drain_suspects()
+            if frag.kind == CTL:
+                return
+            raise ConnectionError(
+                f"chaos: injected connection reset to rank "
+                f"{ep.world_rank}")
         # payload as a flat byte view — memoryview routes an ndarray
         # through the buffer protocol; .cast("B") flattens multi-dim /
         # non-uint8 views so len() counts bytes
@@ -338,25 +386,40 @@ class TcpBtl(Btl):
         hdr = _fast_header(frag)
         if hdr is not None:
             spc.record("fastpath_hdr_fast")
-            frame_len = 1 + len(hdr) + len(payload)
-            if frame_len > _MAX_FRAME:
-                raise self._frame_too_large(frame_len)
-            head = _LEN.pack(frame_len) + bytes((_H_FAST,)) + hdr
+            htype = _H_FAST
         else:
             spc.record("fastpath_hdr_pickle")
             hdr = pickle.dumps(
                 (frag.cid, frag.src, frag.dst, frag.tag, frag.seq,
                  frag.kind, frag.total_len, frag.offset, frag.meta),
                 protocol=pickle.HIGHEST_PROTOCOL)
-            frame_len = 1 + _LEN.size + len(hdr) + len(payload)
+            hdr = _LEN.pack(len(hdr)) + hdr
+            htype = _H_PICKLE
+        if _cksum_armed():
+            # checksummed variant: [len][htype+2][crc32][hdr][payload],
+            # crc over everything after the crc field
+            crc = zlib.crc32(payload, zlib.crc32(hdr))
+            frame_len = 1 + _CKSUM.size + len(hdr) + len(payload)
+            if frame_len > _MAX_FRAME:
+                raise self._frame_too_large(frame_len)
+            head = (_LEN.pack(frame_len) + bytes((htype + _H_CK_BASE,))
+                    + _CKSUM.pack(crc) + hdr)
+        else:
+            frame_len = 1 + len(hdr) + len(payload)
             # re-checked here: a pickle header can outgrow the fast-
             # header size the early payload check assumed — and the
             # check must precede _LEN.pack, which would die on a
             # bare struct.error first
             if frame_len > _MAX_FRAME:
                 raise self._frame_too_large(frame_len)
-            head = (_LEN.pack(frame_len) + bytes((_H_PICKLE,))
-                    + _LEN.pack(len(hdr)) + hdr)
+            head = _LEN.pack(frame_len) + bytes((htype,)) + hdr
+        if chaos_rule is not None and chaos_rule["fault"] == "corrupt":
+            # on-the-wire bit rot, injected AFTER the checksum was
+            # computed (the armed receiver catches it loudly); flips a
+            # header byte so the caller's payload memory stays pristine
+            mangled = bytearray(head)
+            mangled[-1] ^= 0x01
+            head = bytes(mangled)
         with conn.send_lock:
             conn.outq.append(memoryview(head))
             conn.out_bytes += len(head)
@@ -384,6 +447,32 @@ class TcpBtl(Btl):
                         sanitizer.fail(
                             "btl/tcp out-queue still aliases a borrowed "
                             "payload after send() returned")
+        if chaos_rule is not None and chaos_rule["fault"] == "dup":
+            # duplicate delivery of a best-effort CTL frame (a framing-
+            # level retransmit): the FT protocols riding CTL are
+            # idempotent by design, which this proves on demand
+            with conn.send_lock:
+                conn.outq.append(memoryview(head))
+                conn.out_bytes += len(head)
+                if len(payload):
+                    conn.outq.append(memoryview(bytes(payload)))
+                    conn.out_bytes += len(payload)
+                self._flush_locked(conn)
+        self._drain_suspects()
+
+    def _drain_suspects(self) -> None:
+        """Fire deferred wire-reset suspicions (recorded by
+        ``_drop_conn`` under ``_conns_lock``, delivered here with no
+        lock held: the report floods CTL frags over other conns and
+        must not nest under transport locks)."""
+        if not self._suspects:
+            return
+        with self._conns_lock:
+            pending, self._suspects = self._suspects, []
+        from ompi_tpu.ft import propagator
+
+        for rank in pending:
+            propagator.wire_suspicion(rank)
 
     @staticmethod
     def _frame_too_large(nbytes: int) -> ValueError:
@@ -484,6 +573,7 @@ class TcpBtl(Btl):
     @hot_path
     def progress(self) -> int:
         events = 0
+        self._drain_suspects()
         try:
             ready = self._sel.select(timeout=0)
         except OSError:
@@ -547,6 +637,11 @@ class TcpBtl(Btl):
                 conns.remove(conn)
                 if not conns:
                     self._by_rank.pop(conn.rank, None)
+            # peer reset / unexpected EOF mid-traffic: recorded as a
+            # suspicion for the FT detector (drained outside the lock;
+            # no-op in jobs without a detector).  A teardown-time close
+            # never reaches here: close() clears _by_rank wholesale.
+            self._suspects.append(conn.rank)
 
     @staticmethod
     def _need(inbuf) -> int:
@@ -592,6 +687,25 @@ class TcpBtl(Btl):
                     break
                 frame = view[pos + _LEN.size:pos + _LEN.size + fl]
                 pos += _LEN.size + fl
+                if chaos.enabled:
+                    # recv-side faults on tcp are delay + corrupt only
+                    # (loss_ok=False): the frag class is unknown before
+                    # parse, and loss faults on the wire are the SEND
+                    # side's job anyway — injecting them here would
+                    # count faults that were never applied
+                    rule = chaos.wire_recv("tcp", False)
+                    if rule is not None:
+                        if rule["fault"] == "delay":
+                            chaos.sleep_ms(rule)
+                        elif rule["fault"] == "corrupt" \
+                                and fl > 1 + _CKSUM.size + 1 \
+                                and frame[0] >= _H_CK_BASE:
+                            # pre-verify bit rot in the recv scratch:
+                            # only on checksummed frames (an unarmed
+                            # sender's frame would corrupt silently —
+                            # the exact thing the armed checksum
+                            # exists to preclude)
+                            frame[1 + _CKSUM.size] ^= 0x01
                 frag = self._parse_frame(conn, frame, borrowed=True)
                 if frag is not None and self._recv_cb is not None:
                     self._recv_cb(frag)
@@ -639,22 +753,33 @@ class TcpBtl(Btl):
     def _parse_frame(self, conn: _Conn, frame,
                      borrowed: bool = False) -> Optional[Frag]:
         """Decode one frame (bytes or memoryview).  ``borrowed`` marks
-        the payload as a view of transient recv scratch."""
+        the payload as a view of transient recv scratch.  Checksummed
+        frames (htype >= _H_CK_BASE, armed sender) are verified before
+        any parse: a mismatch is a loud, attributed error, never a
+        silently-corrupt delivery."""
         import numpy as np
 
         htype = frame[0]
+        off = 1
+        if htype >= _H_CK_BASE:
+            (want,) = _CKSUM.unpack_from(frame, 1)
+            off = 1 + _CKSUM.size
+            got = zlib.crc32(memoryview(frame)[off:])
+            if got != want:
+                self._corrupt_frame(conn, len(frame), want, got)
+            htype -= _H_CK_BASE
         if htype == _H_FAST:
             (cid, src, dst, tag, seq, code, total_len, offset,
-             req_id) = _FAST.unpack_from(frame, 1)
+             req_id) = _FAST.unpack_from(frame, off)
             return Frag(cid, src, dst, tag, seq, _CODE_TO_KIND[code],
                         np.frombuffer(frame, np.uint8,
-                                      offset=1 + _FAST.size),
+                                      offset=off + _FAST.size),
                         total_len, offset,
                         {} if req_id < 0 else {"req_id": req_id},
                         borrowed=borrowed)
-        (hlen,) = _LEN.unpack_from(frame, 1)
+        (hlen,) = _LEN.unpack_from(frame, off)
         obj = pickle.loads(
-            memoryview(frame)[1 + _LEN.size:1 + _LEN.size + hlen])
+            memoryview(frame)[off + _LEN.size:off + _LEN.size + hlen])
         if isinstance(obj, dict) and "rank" in obj and conn.rank is None:
             conn.rank = obj["rank"]
             # accepted links become reply rails for this rank too
@@ -664,8 +789,36 @@ class TcpBtl(Btl):
         cid, src, dst, tag, seq, kind, total_len, offset, meta = obj
         return Frag(cid, src, dst, tag, seq, kind,
                     np.frombuffer(frame, np.uint8,
-                                  offset=1 + _LEN.size + hlen),
+                                  offset=off + _LEN.size + hlen),
                     total_len, offset, meta, borrowed=borrowed)
+
+    def _corrupt_frame(self, conn: Optional[_Conn], nbytes: int,
+                       want: int, got: int) -> None:
+        """A checksummed frame failed verification: silent wire
+        corruption made loud and attributed.  Raising from the progress
+        thread alone would only unregister this btl's callback and turn
+        the job into a hang — the abort event lets the launcher tear
+        the job down with the diagnostic on record."""
+        from ompi_tpu.base.output import show_help
+
+        peer = conn.rank if conn is not None and conn.rank is not None \
+            else -1
+        spc.record("wire_cksum_fail")
+        if trace.enabled:
+            trace.instant("wire_cksum_fail", "btl",
+                          args={"peer": peer, "nbytes": nbytes})
+        show_help("help-btl-tcp", "frame-corrupt", peer=peer,
+                  nbytes=nbytes, want=want, got=got)
+        if self._rte is not None:
+            try:
+                self._rte.event_notify(
+                    "abort", {"code": 1, "why": "wire corruption"})
+            except Exception:
+                pass
+        raise sanitizer.SanitizeError(
+            f"btl/tcp frame from rank {peer} failed its crc32 "
+            f"({nbytes} bytes, want {want:#x} got {got:#x}): wire "
+            "corruption detected")
 
     def close(self) -> None:
         # flush queued outbound bytes before closing (same delivered-but-
@@ -713,3 +866,9 @@ _rh("help-btl-tcp", "frame-too-large",
     "btl/tcp was asked to send a {nbytes}-byte frame, above the u32 "
     "length-prefix limit of {limit} bytes.  Fragment the payload below "
     "btl_tcp_max_send_size instead of sending it whole.")
+_rh("help-btl-tcp", "frame-corrupt",
+    "btl/tcp received a {nbytes}-byte frame from rank {peer} whose "
+    "crc32 does not verify (expected {want}, computed {got}): the "
+    "bytes were corrupted on the wire.  The job is being aborted — "
+    "silent corruption must never reach the application.  (Checksums "
+    "are armed under chaos injection and OTPU_SANITIZE.)")

@@ -7,18 +7,64 @@ by the launcher (``tpurun``) providing the job KV space (modex), fences,
 pub/sub events (failure notification rides here), and job control (abort).
 Protocol: length-prefixed pickle frames (trusted within one job, like PMIx's
 unix-socket wire protocol).
+
+**Self-healing client**: every FT path in the stack leans on this
+connection, so a single TCP reset during a fence must not kill the rank.
+Each request carries an idempotent id (client uuid + monotonic rid); on a
+connection error the client reconnects with exponential backoff + jitter
+and retries the SAME request.  The server keeps a small per-client replay
+cache — a request whose processing completed before the reset is answered
+from the cache, one still in flight is adopted (the retry waits for the
+original's result) — so a fence or fetch_add interrupted mid-RPC is
+applied exactly once.  Timeouts are MCA vars (``otpu_coord_*``) and expire
+with a loud ``show_help`` naming the rank, the op, and how long it waited
+— never a bare socket timeout or an indefinite hang.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from typing import Any, Optional
 
+from ompi_tpu.base.var import VarType, registry
+
 _LEN = struct.Struct("!I")
+
+_connect_timeout_var = registry.register(
+    "coord", None, "connect_timeout", vtype=VarType.FLOAT, default=120.0,
+    help="Seconds a rank waits dialing (or re-dialing) the coordination "
+         "service before the attempt counts as failed")
+_rpc_timeout_var = registry.register(
+    "coord", None, "rpc_timeout", vtype=VarType.FLOAT, default=120.0,
+    help="Socket-level ceiling on one coordination RPC (fences block "
+         "server-side, so this bounds how long a rank may sit inside "
+         "one); expiry is a loud show_help error naming rank and op")
+_get_timeout_var = registry.register(
+    "coord", None, "get_timeout", vtype=VarType.FLOAT, default=60.0,
+    help="Default server-side wait for a blocking KV get (modex key "
+         "not yet published)")
+_final_timeout_var = registry.register(
+    "coord", None, "final_timeout", vtype=VarType.FLOAT, default=10.0,
+    help="Timeout of the one-shot finalize fence's dedicated "
+         "connection — a peer that exited without fencing costs at "
+         "most this long")
+_retry_max_var = registry.register(
+    "coord", None, "retry_max", vtype=VarType.INT, default=8,
+    help="Reconnect-and-retry attempts after a connection error before "
+         "the RPC fails loudly (0 disables self-healing: components "
+         "with their own fallback carrier — detector, event poller — "
+         "opt out so a dead coord never stalls them)")
+_backoff_var = registry.register(
+    "coord", None, "retry_backoff", vtype=VarType.FLOAT, default=0.05,
+    help="Base of the reconnect exponential backoff in seconds "
+         "(doubled per attempt, jittered, capped at 2s)")
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -61,7 +107,13 @@ class CoordServer:
         "_failed": "_fence_cond",
         "_events": "_event_cond", "_event_seq": "_event_cond",
         "_conns": "_conns_lock",
+        "_rpc_cache": "_rpc_cond", "_inflight": "_rpc_cond",
     }
+
+    #: replay-cache depth per client: the client serializes requests, so
+    #: only the newest rid can be retried — a couple of spares absorb
+    #: the abandoned-timeout-then-reset corner without unbounded growth
+    _REPLAY_DEPTH = 4
 
     def __init__(self, nprocs: int, host: str = "127.0.0.1", port: int = 0):
         self.nprocs = nprocs
@@ -85,6 +137,13 @@ class CoordServer:
         self._next_rank = nprocs          # global rank allocator (dpm spawn)
         self._spawn_handler = None        # set by the launcher (tpurun)
         self._spawn_seq = 0
+        # idempotent-retry replay cache: client uuid -> {rid: response}.
+        # A retried rid already processed is answered from here; one
+        # still being processed is adopted (the retry thread waits for
+        # the original's stored result instead of re-applying the op).
+        self._rpc_cache: "OrderedDict[str, OrderedDict]" = OrderedDict()
+        self._inflight: dict[str, int] = {}
+        self._rpc_cond = threading.Condition()
         self._srv = socket.create_server((host, port))
         self.addr = self._srv.getsockname()
         self._threads: list[threading.Thread] = []
@@ -130,150 +189,200 @@ class CoordServer:
         try:
             while True:
                 req = _recv_frame(conn)
-                op = req["op"]
-                if op == "put":
-                    with self._kv_cond:
-                        self._kv[(req["rank"], req["key"])] = req["value"]
-                        self._kv_cond.notify_all()
-                    _send_frame(conn, {"ok": True})
-                elif op == "del":
-                    with self._kv_cond:
-                        self._kv.pop((req["rank"], req["key"]), None)
-                    _send_frame(conn, {"ok": True})
-                elif op == "put_new":
-                    # atomic put-if-absent: first writer wins, everyone gets
-                    # the winning value back (consensus decision slots)
-                    with self._kv_cond:
-                        k = (req["rank"], req["key"])
-                        if k not in self._kv:
-                            self._kv[k] = req["value"]
-                            self._kv_cond.notify_all()
-                        val = self._kv[k]
-                    _send_frame(conn, {"ok": True, "value": val})
-                elif op == "fetch_add":
-                    # atomic counter (shared file pointers, spawn ids):
-                    # returns the PRE-add value, like MPI_Fetch_and_op SUM
-                    with self._kv_cond:
-                        k = (req["rank"], req["key"])
-                        old = self._kv.get(k, 0)
-                        self._kv[k] = old + req["delta"]
-                        self._kv_cond.notify_all()
-                    _send_frame(conn, {"ok": True, "value": old})
-                elif op == "get":
-                    deadline = time.monotonic() + req.get("timeout", 60.0)
-                    with self._kv_cond:
-                        while (req["rank"], req["key"]) not in self._kv:
-                            remaining = deadline - time.monotonic()
-                            if remaining <= 0 or not req.get("wait", True):
-                                break
-                            self._kv_cond.wait(min(remaining, 1.0))
-                        val = self._kv.get((req["rank"], req["key"]))
-                    _send_frame(conn, {"ok": True, "value": val})
-                elif op == "fence":
-                    fid = req["id"]
-                    with self._fence_cond:
-                        if "expect" in req and req["expect"] is not None:
-                            self._fence_expect.setdefault(
-                                fid, tuple(req["expect"]))
-                        # per-rank contribution tracking: a fence completes
-                        # when every rank has either arrived or died — a
-                        # dead rank's earlier arrival must not release the
-                        # fence while a live survivor is still outside it
-                        oneshot = bool(req.get("oneshot"))
-                        if oneshot and fid in self._fence_done:
-                            # late arrival to a completed one-shot round:
-                            # fall through to the reply OUTSIDE the cond —
-                            # otpu-lint found the blocking sendall here
-                            # while _fence_cond was held, where one
-                            # slow-reading late client stalled every
-                            # fence/failure operation job-wide
-                            pass
-                        else:
-                            arrived = self._fence_ranks.setdefault(
-                                fid, set())
-                            arrived.add(req.get("rank", -1))
-                            if self._fence_satisfied(fid):
-                                self._complete_fence_locked(fid, oneshot)
-                            else:
-                                gen = self._fence_gen.get(fid, 0)
-                                while self._fence_gen.get(fid, 0) == gen:
-                                    self._fence_cond.wait(1.0)
-                                    if self._aborted is not None:
-                                        break
-                                    # a failure may have lowered the bar
-                                    if self._fence_satisfied(fid):
-                                        self._complete_fence_locked(
-                                            fid, oneshot)
-                                        break
-                    _send_frame(conn, {"ok": True})
-                elif op == "event_pub":
-                    # routed through publish() so in-band failure reports
-                    # (heartbeat detector) also update fence bookkeeping
-                    self.publish(req["name"], req["payload"])
-                    _send_frame(conn, {"ok": True})
-                elif op == "event_poll":
-                    since = req["since"]
-                    with self._event_cond:
-                        out = [e for e in self._events if e[0] > since]
-                    _send_frame(conn, {"ok": True, "events": out})
-                elif op == "abort":
-                    self._aborted = req.get("code", 1)
-                    with self._fence_cond:
-                        self._fence_cond.notify_all()
-                    _send_frame(conn, {"ok": True})
-                elif op == "spawn":
-                    # MPI_Comm_spawn's PMIx_Spawn analog: allocate fresh
-                    # global ranks, hand the launch to the launcher's
-                    # registered handler (it owns process management)
-                    if self._spawn_handler is None:
-                        _send_frame(conn, {"ok": False,
-                                           "error": "no spawn support "
-                                                    "(launcher too old?)"})
-                        continue
-                    n = int(req["n"])
-                    with self._kv_cond:
-                        ranks = list(range(self._next_rank,
-                                           self._next_rank + n))
-                        self._next_rank += n
-                        self._spawn_seq += 1
-                        job = f"job{self._spawn_seq}"
-                    try:
-                        self._spawn_handler(
-                            req["cmd"], ranks, job,
-                            req.get("env") or {})
-                        # dynamic pset: the new job is addressable by
-                        # name before it builds any communicator
-                        self.publish_pset(f"mpi://job/{job}", ranks,
-                                          source="spawn")
-                        _send_frame(conn, {"ok": True, "ranks": ranks,
-                                           "job": job})
-                    except Exception as exc:
-                        _send_frame(conn, {"ok": False, "error": str(exc)})
-                elif op == "pset_pub":
-                    self.publish_pset(req["name"], req["members"],
-                                      req.get("source", "user"))
-                    _send_frame(conn, {"ok": True})
-                elif op == "pset_list":
-                    with self._kv_cond:
-                        rows = [{"name": n, "size": len(e["members"]),
-                                 "source": e["source"]}
-                                for n, e in sorted(self._psets.items())]
-                    _send_frame(conn, {"ok": True, "psets": rows})
-                elif op == "pset_get":
-                    with self._kv_cond:
-                        entry = self._psets.get(req["name"])
-                    _send_frame(conn, {"ok": True, "pset": entry})
-                elif op == "ping":
-                    # "time" is the server's wall clock: ranks estimate
-                    # their offset to it (min-RTT, mpisync estimator) so
-                    # per-rank trace timelines share one timebase
-                    _send_frame(conn, {"ok": True, "nprocs": self.nprocs,
-                                       "aborted": self._aborted,
-                                       "time": time.time()})
+                cid = req.get("_cid")
+                rid = req.get("_rid")
+                if cid is not None and rid is not None:
+                    resp = self._replay_or_claim(cid, rid)
+                    if resp is None:
+                        try:
+                            resp = self._handle(req, conn)
+                        except Exception as exc:
+                            # a malformed/version-skewed request must
+                            # not strand its in-flight claim (a retry
+                            # would spin on it forever) — store a loud
+                            # error response instead
+                            resp = {"ok": False,
+                                    "error": f"server error: {exc!r}"}
+                        self._store_reply(cid, rid, resp)
                 else:
-                    _send_frame(conn, {"ok": False, "error": f"bad op {op}"})
+                    # legacy/anonymous request: process directly
+                    resp = self._handle(req, conn)
+                _send_frame(conn, resp)
         except (ConnectionError, OSError):
             return
+
+    def _replay_or_claim(self, cid: str, rid: int) -> Optional[dict]:
+        """Duplicate-safe entry: a cached rid replays its stored
+        response; an in-flight rid is adopted (wait for the original
+        thread's result); a fresh rid is claimed for processing
+        (returns None)."""
+        with self._rpc_cond:
+            while True:
+                cached = self._rpc_cache.get(cid)
+                if cached is not None and rid in cached:
+                    return cached[rid]
+                if self._inflight.get(cid) != rid:
+                    self._inflight[cid] = rid
+                    return None
+                self._rpc_cond.wait(0.5)
+
+    def _store_reply(self, cid: str, rid: int, resp: dict) -> None:
+        with self._rpc_cond:
+            cache = self._rpc_cache.get(cid)
+            if cache is None:
+                cache = self._rpc_cache[cid] = OrderedDict()
+            cache[rid] = resp
+            while len(cache) > self._REPLAY_DEPTH:
+                cache.popitem(last=False)
+            if self._inflight.get(cid) == rid:
+                del self._inflight[cid]
+            # bound the per-client table count too (dead clients):
+            # move-to-end keeps live clients out of the eviction edge
+            self._rpc_cache.move_to_end(cid)
+            while len(self._rpc_cache) > 4096:
+                self._rpc_cache.popitem(last=False)
+            self._rpc_cond.notify_all()
+
+    def _handle(self, req: dict, conn: socket.socket) -> dict:
+        """Process one request; returns the response frame.  Replies are
+        sent by the caller, never from under a service condition."""
+        op = req["op"]
+        if op == "put":
+            with self._kv_cond:
+                self._kv[(req["rank"], req["key"])] = req["value"]
+                self._kv_cond.notify_all()
+            return {"ok": True}
+        if op == "del":
+            with self._kv_cond:
+                self._kv.pop((req["rank"], req["key"]), None)
+            return {"ok": True}
+        if op == "put_new":
+            # atomic put-if-absent: first writer wins, everyone gets
+            # the winning value back (consensus decision slots)
+            with self._kv_cond:
+                k = (req["rank"], req["key"])
+                if k not in self._kv:
+                    self._kv[k] = req["value"]
+                    self._kv_cond.notify_all()
+                val = self._kv[k]
+            return {"ok": True, "value": val}
+        if op == "fetch_add":
+            # atomic counter (shared file pointers, spawn ids):
+            # returns the PRE-add value, like MPI_Fetch_and_op SUM
+            with self._kv_cond:
+                k = (req["rank"], req["key"])
+                old = self._kv.get(k, 0)
+                self._kv[k] = old + req["delta"]
+                self._kv_cond.notify_all()
+            return {"ok": True, "value": old}
+        if op == "get":
+            deadline = time.monotonic() + req.get("timeout", 60.0)
+            with self._kv_cond:
+                while (req["rank"], req["key"]) not in self._kv:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not req.get("wait", True):
+                        break
+                    self._kv_cond.wait(min(remaining, 1.0))
+                val = self._kv.get((req["rank"], req["key"]))
+            return {"ok": True, "value": val}
+        if op == "fence":
+            fid = req["id"]
+            with self._fence_cond:
+                if "expect" in req and req["expect"] is not None:
+                    self._fence_expect.setdefault(
+                        fid, tuple(req["expect"]))
+                # per-rank contribution tracking: a fence completes
+                # when every rank has either arrived or died — a
+                # dead rank's earlier arrival must not release the
+                # fence while a live survivor is still outside it
+                oneshot = bool(req.get("oneshot"))
+                if oneshot and fid in self._fence_done:
+                    # late arrival to a completed one-shot round:
+                    # fall through to the reply OUTSIDE the cond —
+                    # otpu-lint found the blocking sendall here
+                    # while _fence_cond was held, where one
+                    # slow-reading late client stalled every
+                    # fence/failure operation job-wide
+                    pass
+                else:
+                    arrived = self._fence_ranks.setdefault(
+                        fid, set())
+                    arrived.add(req.get("rank", -1))
+                    if self._fence_satisfied(fid):
+                        self._complete_fence_locked(fid, oneshot)
+                    else:
+                        gen = self._fence_gen.get(fid, 0)
+                        while self._fence_gen.get(fid, 0) == gen:
+                            self._fence_cond.wait(1.0)
+                            if self._aborted is not None:
+                                break
+                            # a failure may have lowered the bar
+                            if self._fence_satisfied(fid):
+                                self._complete_fence_locked(
+                                    fid, oneshot)
+                                break
+            return {"ok": True}
+        if op == "event_pub":
+            # routed through publish() so in-band failure reports
+            # (heartbeat detector) also update fence bookkeeping
+            self.publish(req["name"], req["payload"])
+            return {"ok": True}
+        if op == "event_poll":
+            since = req["since"]
+            with self._event_cond:
+                out = [e for e in self._events if e[0] > since]
+            return {"ok": True, "events": out}
+        if op == "abort":
+            self._aborted = req.get("code", 1)
+            with self._fence_cond:
+                self._fence_cond.notify_all()
+            return {"ok": True}
+        if op == "spawn":
+            # MPI_Comm_spawn's PMIx_Spawn analog: allocate fresh
+            # global ranks, hand the launch to the launcher's
+            # registered handler (it owns process management)
+            if self._spawn_handler is None:
+                return {"ok": False,
+                        "error": "no spawn support (launcher too old?)"}
+            n = int(req["n"])
+            with self._kv_cond:
+                ranks = list(range(self._next_rank,
+                                   self._next_rank + n))
+                self._next_rank += n
+                self._spawn_seq += 1
+                job = f"job{self._spawn_seq}"
+            try:
+                self._spawn_handler(
+                    req["cmd"], ranks, job,
+                    req.get("env") or {})
+                # dynamic pset: the new job is addressable by
+                # name before it builds any communicator
+                self.publish_pset(f"mpi://job/{job}", ranks,
+                                  source="spawn")
+                return {"ok": True, "ranks": ranks, "job": job}
+            except Exception as exc:
+                return {"ok": False, "error": str(exc)}
+        if op == "pset_pub":
+            self.publish_pset(req["name"], req["members"],
+                              req.get("source", "user"))
+            return {"ok": True}
+        if op == "pset_list":
+            with self._kv_cond:
+                rows = [{"name": n, "size": len(e["members"]),
+                         "source": e["source"]}
+                        for n, e in sorted(self._psets.items())]
+            return {"ok": True, "psets": rows}
+        if op == "pset_get":
+            with self._kv_cond:
+                entry = self._psets.get(req["name"])
+            return {"ok": True, "pset": entry}
+        if op == "ping":
+            # "time" is the server's wall clock: ranks estimate
+            # their offset to it (min-RTT, mpisync estimator) so
+            # per-rank trace timelines share one timebase
+            return {"ok": True, "nprocs": self.nprocs,
+                    "aborted": self._aborted, "time": time.time()}
+        return {"ok": False, "error": f"bad op {op}"}
 
     def _fence_satisfied(self, fid: str) -> bool:
         # caller holds _fence_cond
@@ -374,25 +483,148 @@ class CoordServer:
 
 
 class CoordClient:
-    """Per-process client (the PMIx client analog)."""
+    """Per-process client (the PMIx client analog) with idempotent
+    reconnect-retry (see module docstring).
+
+    ``retries``: reconnect attempts after a connection error; None takes
+    ``otpu_coord_retry_max``.  Components with their OWN fallback
+    carrier (heartbeat detector, event poller) pass 0 — a dead coord
+    must fail them fast, not stall their loops through a backoff ladder.
+    """
 
     def __init__(self, addr: Optional[tuple] = None,
-                 timeout: float = 120.0):
+                 timeout: Optional[float] = None,
+                 retries: Optional[int] = None):
         if addr is None:
             spec = os.environ["OTPU_COORD"]
             host, port = spec.rsplit(":", 1)
             addr = (host, int(port))
-        self._sock = socket.create_connection(addr, timeout=timeout)
+        self._addr = (addr[0], int(addr[1]))
+        # an explicit timeout overrides BOTH the connect and RPC vars
+        # (fence_final's throwaway short-timeout connection)
+        self._connect_timeout = (float(timeout) if timeout is not None
+                                 else float(_connect_timeout_var.value))
+        self._rpc_timeout = (float(timeout) if timeout is not None
+                             else float(_rpc_timeout_var.value))
+        self._retry_max = (int(retries) if retries is not None
+                           else int(_retry_max_var.value or 0))
+        self._backoff = float(_backoff_var.value or 0.05)
+        self._rank_label = os.environ.get("OTPU_RANK", "?")
+        self._jitter = random.Random(f"coord-jitter:{self._rank_label}")
+        self._cid = uuid.uuid4().hex      # idempotent-retry identity
+        self._rid = 0
+        self._closed = False
+        self._sock: Optional[socket.socket] = self._dial()
         self._lock = threading.Lock()
         self._event_since = 0
 
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout)
+        sock.settimeout(self._rpc_timeout)
+        return sock
+
     def _rpc(self, **req) -> dict:
         with self._lock:
-            _send_frame(self._sock, req)
-            resp = _recv_frame(self._sock)
+            self._rid += 1
+            req["_cid"] = self._cid
+            req["_rid"] = self._rid
+            resp = self._rpc_locked(req)
         if not resp.get("ok"):
             raise RuntimeError(f"coordination error: {resp.get('error')}")
         return resp
+
+    def _rpc_locked(self, req: dict) -> dict:
+        """One idempotent RPC round: send → (maybe injected fault) →
+        recv; connection errors reconnect with exponential backoff +
+        jitter and retry the SAME request (the server's replay cache
+        makes the retry duplicate-safe)."""
+        from ompi_tpu.base.output import show_help
+        from ompi_tpu.ft import chaos
+        from ompi_tpu.runtime import spc
+
+        op = str(req.get("op"))
+        attempts = 0
+        while True:
+            dialing = self._sock is None
+            try:
+                if dialing:
+                    # reconnect: dial failures (refused, connect
+                    # timeout) take the backoff ladder below, never the
+                    # rpc-timeout path — the server may be restarting
+                    self._sock = self._dial()
+                    spc.record("coord_reconnects")
+                    # past here a timeout is an RPC timeout again: the
+                    # dial succeeded, the server is reachable
+                    dialing = False
+                if chaos.enabled:
+                    rule = chaos.coord_stall(op)
+                    if rule is not None:
+                        chaos.sleep_ms(rule)
+                _send_frame(self._sock, req)
+                if chaos.enabled and chaos.coord_disconnect(op):
+                    # injected mid-RPC reset: the request reached the
+                    # server, the reply is lost — the retry below must
+                    # be answered duplicate-safe from the replay cache
+                    try:
+                        self._sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self._sock.close()
+                return _recv_frame(self._sock)
+            except TimeoutError:
+                if not dialing:
+                    # the server is reachable but the op never finished
+                    # within otpu_coord_rpc_timeout: loud, not retried
+                    # (retrying a stuck fence would just wait again).
+                    # The socket is CLOSED first — the server's handler
+                    # may still be blocked inside the op, and a later
+                    # RPC on this client must not queue behind it (or
+                    # mis-read the stale reply as its own: replies
+                    # carry no correlation on the stream itself)
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    show_help("help-coord", "rpc-timeout",
+                              rank=self._rank_label, op=op,
+                              seconds=self._rpc_timeout)
+                    raise RuntimeError(
+                        f"coordination RPC {op!r} timed out after "
+                        f"{self._rpc_timeout:g}s at rank "
+                        f"{self._rank_label} (otpu_coord_rpc_timeout)")
+                self._retry_or_raise(op, attempts)
+                attempts += 1
+            except (ConnectionError, OSError):
+                self._retry_or_raise(op, attempts)
+                attempts += 1
+
+    def _retry_or_raise(self, op: str, attempts: int) -> None:
+        """Connection-error path: close, back off (exponential +
+        deterministic jitter), let the caller retry — or fail loudly
+        once the ladder (otpu_coord_retry_max) is exhausted."""
+        from ompi_tpu.base.output import show_help
+        from ompi_tpu.runtime import spc
+
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        if self._closed or attempts >= self._retry_max:
+            if self._retry_max > 0 and not self._closed:
+                # only the self-healing path announces exhaustion;
+                # retries=0 components (detector, poller, finalize
+                # fence) opted out and handle the error themselves
+                show_help("help-coord", "reconnect-failed",
+                          rank=self._rank_label, op=op,
+                          attempts=attempts)
+            raise
+        spc.record("coord_rpc_retries")
+        delay = min(self._backoff * (1 << attempts), 2.0)
+        time.sleep(delay * (0.5 + self._jitter.random()))
 
     def put(self, rank: int, key: str, value: Any) -> None:
         self._rpc(op="put", rank=rank, key=key, value=value)
@@ -411,7 +643,9 @@ class CoordClient:
         self._rpc(op="del", rank=rank, key=key)
 
     def get(self, rank: int, key: str, wait: bool = True,
-            timeout: float = 60.0) -> Any:
+            timeout: Optional[float] = None) -> Any:
+        if timeout is None:
+            timeout = float(_get_timeout_var.value)
         return self._rpc(op="get", rank=rank, key=key, wait=wait,
                          timeout=timeout)["value"]
 
@@ -475,7 +709,24 @@ class CoordClient:
         self._rpc(op="abort", code=code)
 
     def close(self) -> None:
+        self._closed = True      # no reconnect ladder during teardown
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
+
+
+from ompi_tpu.base.output import register_help as _rh
+
+_rh("help-coord", "rpc-timeout",
+    "Coordination RPC {op!r} at rank {rank} expired after {seconds}s "
+    "(otpu_coord_rpc_timeout).  The coordination service is alive but "
+    "the operation never completed — a peer this fence/get waits on is "
+    "probably hung without having died.")
+_rh("help-coord", "reconnect-failed",
+    "Rank {rank} lost its coordination-service connection during "
+    "{op!r} and could not re-establish it after {attempts} "
+    "reconnect attempt(s) (otpu_coord_retry_max).  The launcher (and "
+    "its coordination service) is gone; out-of-band operations cannot "
+    "continue.")

@@ -35,6 +35,12 @@ class ProcRte(Rte):
     def __init__(self) -> None:
         self.my_world_rank = int(os.environ["OTPU_RANK"])
         self.world_size = int(os.environ["OTPU_NPROCS"])
+        # arm deterministic fault injection BEFORE the first coord RPC:
+        # a chaos spec must cover the wire-up fences too, not just the
+        # post-boot steady state (no-op when otpu_chaos_spec is empty)
+        from ompi_tpu.ft import chaos
+
+        chaos.install(rank=self.my_world_rank)
         # dpm job identity: a spawned job has its own COMM_WORLD built from
         # GLOBAL ranks allocated by the coord server (OTPU_JOB_RANKS); the
         # primary job is job "0" with ranks 0..nprocs-1
@@ -75,18 +81,21 @@ class ProcRte(Rte):
         self.client.fence(f"{self.job}:f{self._fence_counter}",
                           rank=self.my_world_rank, expect=self.job_ranks)
 
-    def fence_final(self, timeout: float = 10.0) -> None:
+    def fence_final(self, timeout: Optional[float] = None) -> None:
         """Pre-teardown synchronisation (ompi_mpi_finalize's barrier).
 
         One-shot semantics (a rank arriving after peers were released by
         its presumed failure passes immediately) on a DEDICATED short-
         timeout connection: a peer that exited without fencing must cost
-        at most ``timeout`` seconds and must not desynchronise the shared
-        client's request/reply stream — the throwaway connection is
-        closed either way."""
-        from ompi_tpu.rte.coord import CoordClient
+        at most ``otpu_coord_final_timeout`` seconds and must not
+        desynchronise the shared client's request/reply stream — the
+        throwaway connection is closed either way.  No reconnect ladder:
+        at teardown a dead coord means the job is ending anyway."""
+        from ompi_tpu.rte.coord import CoordClient, _final_timeout_var
 
-        c = CoordClient(timeout=timeout)
+        if timeout is None:
+            timeout = float(_final_timeout_var.value)
+        c = CoordClient(timeout=timeout, retries=0)
         try:
             c.fence_oneshot(f"{self.job}:final", rank=self.my_world_rank,
                             expect=self.job_ranks)

@@ -26,6 +26,13 @@ _COUNTERS = (
     # streaming epochs, and requests requeued by serve-through-failure
     "serve_requests", "serve_tokens", "serve_ticks", "serve_admitted",
     "serve_evicted", "serve_requeued", "serve_kv_epochs", "serve_scaleups",
+    # chaos counters (ompi_tpu/ft/chaos): every injected fault is
+    # counted, so a chaos run self-documents what it actually injected
+    "chaos_drop", "chaos_delay", "chaos_dup", "chaos_corrupt",
+    "chaos_reset", "chaos_stall", "chaos_disconnect", "chaos_kill",
+    # self-healing coord/wire layer: reconnect-retry activity and
+    # detected (checksummed) wire corruption
+    "coord_reconnects", "coord_rpc_retries", "wire_cksum_fail",
 )
 
 _pvars = {}

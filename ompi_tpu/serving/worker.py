@@ -132,6 +132,13 @@ class ShardWorker:
     def _on_work(self, batch, free_rids) -> None:
         """Colocated/decode micro-batch: (rid, prompt_len, tokens_done,
         n) per entry; results are one coalesced reply."""
+        from ompi_tpu.ft import chaos
+
+        if chaos.enabled:
+            # serve-through-failure drills: 'kill:site=serve_work,
+            # count=k' dies on the (k+1)-th micro-batch, mid-load with
+            # results unsent (tests/test_serving.py's victim schedule)
+            chaos.kill_point("serve_work")
         results = []
         for rid, prompt_len, tokens_done, n in batch:
             if rid not in self._kv:

@@ -8,7 +8,11 @@ two-comm round), runs the rounds, and prints one ``FUZZ <key> <rank>
 uniformity property (all printed values for a key are equal) and
 liveness (every planned survivor printed).
 
-The precise kill triggers intercept ``agreement._p2p_send``:
+The precise kill triggers ride the shared ``ft/chaos`` kill points
+planted in ``agreement._p2p_send`` (this file used to hand-roll its own
+``_p2p_send`` interceptor + timer rig; each victim now just arms a
+chaos schedule for its round — ``kill:site=agree_prepare,count=k`` /
+``kill:site=agree_decision,count=k`` plus a ``kill:after=T`` watchdog):
 
 * ``prepare_partial k`` — die before sending prepare frame #(k+1): some
   survivors hold the prepared value, others don't; the takeover root
@@ -20,7 +24,7 @@ The precise kill triggers intercept ``agreement._p2p_send``:
   must adopt via a 'decision' query reply.
 * ``delay`` — the watchdog alone (mid-protocol at a random moment).
 
-A watchdog thread always backstops every victim (a root-specific
+The watchdog always backstops every victim (a root-specific
 trigger never fires on a rank that never roots), so every planned
 victim really dies and the plan's alive-set bookkeeping stays true.
 Reference corners: ``coll_ftagree_earlyreturning.c:34-36`` (ERA keeps
@@ -109,7 +113,7 @@ def main():
     import ompi_tpu
     from ompi_tpu.api.errhandler import ERRORS_RETURN
     from ompi_tpu.api.errors import ProcFailedError
-    from ompi_tpu.ft import agreement, propagator
+    from ompi_tpu.ft import propagator
     from ompi_tpu.ft import state as ft_state
 
     plan = build_plan(int(os.environ["FUZZ_SEED"]),
@@ -123,24 +127,19 @@ def main():
     d1.set_errhandler(ERRORS_RETURN)
     d2.set_errhandler(ERRORS_RETURN)
 
-    # -- precise-kill interceptor on the agreement's CTL sends ----------
-    kill = {"mode": None, "arg": 0, "sent": {"prepare": 0, "decision": 0}}
-    orig_send = agreement._p2p_send
+    from ompi_tpu.ft import chaos
 
-    def fuzz_send(rte, dst_world, op, instance, payload=None, extra=None):
-        mode = kill["mode"]
-        if mode == "prepare_partial" and op == "prepare":
-            if kill["sent"]["prepare"] >= kill["arg"]:
-                os._exit(7)
-            kill["sent"]["prepare"] += 1
-        elif mode == "commit_partial" and op == "decision":
-            if kill["sent"]["decision"] >= kill["arg"]:
-                os._exit(7)
-            kill["sent"]["decision"] += 1
-        return orig_send(rte, dst_world, op, instance, payload,
-                         extra=extra)
-
-    agreement._p2p_send = fuzz_send
+    def arm_victim(mode, arg, delay):
+        """Per-round chaos schedule: the protocol-phase trigger plus
+        the wall-clock watchdog (behavior-identical to the old
+        hand-rolled _p2p_send interceptor + Timer rig)."""
+        parts = [f"kill:rank={me},after={delay}"]
+        if mode == "prepare_partial":
+            parts.append(f"kill:rank={me},site=agree_prepare,count={arg}")
+        elif mode == "commit_partial":
+            parts.append(
+                f"kill:rank={me},site=agree_decision,count={arg}")
+        chaos.install_spec(";".join(parts), rank=me)
 
     def agree_value(comm, flag):
         """One agreement; a uniform ProcFailedError carries the agreed
@@ -164,10 +163,7 @@ def main():
         my_flag = spec["flags"][me]
         if me in spec["victims"]:
             mode, arg, delay = spec["victims"][me]
-            kill["mode"] = mode
-            kill["arg"] = arg
-            kill["sent"] = {"prepare": 0, "decision": 0}
-            threading.Timer(delay, lambda: os._exit(7)).start()
+            arm_victim(mode, arg, delay)
         if spec["suspect"] and spec["suspect"][0] == me:
             # false suspicion: announce a LIVE peer dead on the real
             # propagation carriers (event bus + p2p flood) mid-agreement

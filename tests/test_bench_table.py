@@ -118,6 +118,30 @@ def test_serving_rows_pinned(pins):
         assert r["p99_exact_ms"] <= 2.0 * r["p99_ms"] + 1.0
 
 
+def test_recovery_rows_pinned(pins):
+    """The recovery benchmark row (bench.py --recovery: elastic
+    train-through-failure, detect→resume latency over 3 chaos-scheduled
+    rank kills) must stay in the committed sweep with sane latency.
+    Very wide tolerance — the agree/shrink phases carry scheduler
+    throttles and CI-host noise — but an order-of-magnitude collapse
+    (a recovery path that started blocking on a timeout) fails."""
+    sweep = _load("BENCH_SWEEP.json")
+    rows = {r.get("coll"): r for r in sweep["results"]}
+    for key, pin in pins["recovery_p99_ms"].items():
+        r = rows.get(key)
+        assert r is not None, f"pinned recovery row {key} vanished"
+        assert r.get("ok", True), f"{key}: recovery bench FAILED"
+        assert r["nbytes"] >= 3, f"{key}: fewer than 3 recovery samples"
+        got = r["p99_ms"]
+        assert got <= 25.0 * pin, (
+            f"{key}: p99 {got}ms vs pin {pin}ms — recovery latency "
+            "collapsed by >25x (a recovery phase is blocking on a "
+            "timeout instead of completing)")
+        # phase accounting must cover the recovery it reports
+        assert set(r.get("phase_median_ms", {})) >= {
+            "revoke", "agree", "shrink", "restore"}
+
+
 def test_mfu_rows_structure():
     """The MFU section (single-chip FLOPs utilization) must exist with
     all three rows once a sweep has been produced by a bench new enough

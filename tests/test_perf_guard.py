@@ -200,6 +200,37 @@ def test_sanitizer_off_zero_overhead():
         assert any(q.endswith(qual) for q in regs), qual
 
 
+def test_chaos_disabled_zero_overhead():
+    """An empty otpu_chaos_spec must cost the wire NOTHING: chaos is a
+    module bool the hot paths read in one cold branch (the
+    trace/sanitizer discipline), no engine exists, the frame checksum
+    stays unarmed, and every hook is an immediate return."""
+    from ompi_tpu.ft import chaos
+    from ompi_tpu.mca.btl import tcp as tcp_mod
+    from ompi_tpu.runtime import spc
+
+    assert chaos.enabled is False              # default off
+    assert chaos._engine is None               # nothing armed
+    assert tcp_mod._cksum_armed() is False     # no crc on the wire
+    # every hook is inert without an engine — no draws, no counters
+    before = {k: spc.read(k) for k in
+              ("chaos_drop", "chaos_delay", "chaos_dup", "chaos_corrupt",
+               "chaos_reset", "chaos_stall", "chaos_disconnect",
+               "chaos_kill")}
+    assert chaos.wire_send("tcp", True) is None
+    assert chaos.wire_recv("tcp", True) is None
+    assert chaos.coord_stall("put") is None
+    assert chaos.coord_disconnect("put") is False
+    chaos.kill_point("step", n=0)
+    assert {k: spc.read(k) for k in before} == before
+    # install/uninstall restores the zero-cost identity
+    chaos.install_spec("delay:ms=1,p=1", rank=0)
+    assert chaos.enabled is True
+    chaos.uninstall()
+    assert chaos.enabled is False and chaos._engine is None
+    assert tcp_mod._cksum_armed() is False
+
+
 def test_small_pack_skips_pool_dispatch(monkeypatch):
     """fastpath satellite: packs below ``_POOL_PACK_MIN`` must never
     reach the worker pool — the threads_pool_pack_4MB bench measured

@@ -431,14 +431,13 @@ def test_serve_through_failure_zero_dropped(tmp_path):
             r.shutdown()
             print(f"ROUTER OK requeued={r.lost_and_requeued}", flush=True)
         elif w.rank == 2:
-            class Victim(ShardWorker):
-                _n = 0
-                def _on_work(self, batch, free_rids):
-                    Victim._n += 1
-                    if Victim._n == 3:
-                        os._exit(1)        # die mid-load, results unsent
-                    super()._on_work(batch, free_rids)
-            Victim(w, router=0).serve()
+            # chaos kill schedule replaces the old hand-rolled Victim
+            # subclass: permit 2 micro-batches, die on the 3rd —
+            # mid-load, results unsent (ShardWorker._on_work hosts the
+            # serve_work kill point)
+            from ompi_tpu.ft import chaos
+            chaos.install_spec("kill:rank=2,site=serve_work,count=2")
+            ShardWorker(w, router=0).serve()
         else:
             ShardWorker(w, router=0).serve()
             print(f"WORKER {w.rank} OK", flush=True)
