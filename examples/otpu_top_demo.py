@@ -1,0 +1,68 @@
+"""otpu-top demo — watch a live job from outside it.
+
+Self-launching: run this script directly (no tpurun needed) and it
+
+1. starts a 3-rank tpurun job on a fixed coord port with the telemetry
+   sampler on (``--mca otpu_telemetry_interval_ms 150``) and a
+   rank-scoped chaos delay so rank 2 is a designed straggler,
+2. attaches ``otpu_top`` to the running job and prints a few live
+   per-rank tables (msg/s, bytes/s, allreduce p50/p99, queue depths,
+   chaos fault totals, stale flags),
+3. after the job ends, runs ``otpu_analyze`` over the merged timeline
+   and prints the straggler/skew report — which names rank 2.
+
+Inside a job you can instead attach by hand::
+
+    python -m ompi_tpu.tools.otpu_top --coord 127.0.0.1:PORT --watch
+"""
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> int:
+    from ompi_tpu.tools import otpu_analyze, otpu_top
+
+    port = _free_port()
+    tdir = tempfile.mkdtemp(prefix="otpu-top-demo-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TW_SECS="5.0")
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    worker = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "telemetry_worker.py")
+    job = subprocess.Popen(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "3",
+         "--coord-port", str(port),
+         "--mca", "otpu_telemetry_interval_ms", "150",
+         "--mca", "otpu_chaos_spec", "delay:ms=5,p=1,rank=2,site=step",
+         "--mca", "otpu_trace_enable", "1",
+         "--mca", "otpu_trace_dir", tdir,
+         sys.executable, worker],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    print(f"job launched (coord 127.0.0.1:{port}); attaching otpu_top…")
+    time.sleep(1.5)                       # let the samplers warm up
+    for _ in range(3):
+        otpu_top.main(["--coord", f"127.0.0.1:{port}"])
+        print()
+        time.sleep(0.8)
+    job.wait(timeout=120)
+    merged = os.path.join(tdir, "trace_merged.json")
+    if os.path.exists(merged):
+        print("job ended; otpu_analyze over the merged timeline:")
+        otpu_analyze.main([merged])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,17 @@ Three contracts the observability stack depends on:
    consumed by a ``trace.span(...)``/``trace.hist_record(...)`` in the
    same function on some path — an unconsumed begin is a span that never
    closes (the PR 1 family: the timeline silently loses the operation).
+
+4. **Telemetry keys come from the schema**: every literal name passed to
+   ``telemetry.register_source`` must be a key of the ``SCHEMA``
+   constant in ``runtime/telemetry.py`` — an undeclared source key
+   would publish samples ``otpu_top``/``otpu_analyze`` cannot interpret
+   (and the runtime rejects it loudly; this catches it before it runs).
+
+5. **Flight-recorder reasons register**: every literal reason passed to
+   ``flight.dump`` must have a registered ``help-flight`` template —
+   the dump announcement IS the user-facing diagnostic, and an
+   unregistered reason would crash-dump with the raw fallback.
 """
 from __future__ import annotations
 
@@ -43,12 +54,17 @@ class ObservabilityPass(AnalysisPass):
     name = "observability"
     description = ("show_help keys resolve to registered templates, SPC "
                    "counter names are declared in runtime/spc.py, "
-                   "trace.now() begins are consumed by a span")
+                   "trace.now() begins are consumed by a span, "
+                   "telemetry source names come from the declared "
+                   "SCHEMA, flight-recorder dump reasons are "
+                   "help-flight-registered")
 
     def run(self, pkg: Package) -> list[Finding]:
         registered: set[tuple] = set()
         counters: set[str] = set()
         counters_declared = False
+        schema: set[str] = set()
+        schema_declared = False
         for mod in pkg.modules:
             aliases = _register_aliases(mod)
             for node in ast.walk(mod.tree):
@@ -72,15 +88,35 @@ class ObservabilityPass(AnalysisPass):
                             s = const_str(elt)
                             if s:
                                 counters.add(s)
+            if mod.path.replace("\\", "/").endswith("telemetry.py"):
+                for stmt in mod.tree.body:
+                    if isinstance(stmt, ast.Assign) \
+                            and any(isinstance(t, ast.Name)
+                                    and t.id in ("SCHEMA", "_SCHEMA")
+                                    for t in stmt.targets):
+                        if isinstance(stmt.value, ast.Dict):
+                            schema_declared = True
+                            for k in stmt.value.keys:
+                                s = const_str(k)
+                                if s:
+                                    schema.add(s)
+                        elif isinstance(stmt.value,
+                                        (ast.Tuple, ast.List)):
+                            schema_declared = True
+                            for elt in stmt.value.elts:
+                                s = const_str(elt)
+                                if s:
+                                    schema.add(s)
         out: list[Finding] = []
         for mod in pkg.modules:
             for fn, qual in mod.functions():
                 out.extend(self._check_fn(mod, fn, qual, registered,
-                                          counters, counters_declared))
+                                          counters, counters_declared,
+                                          schema, schema_declared))
         return out
 
     def _check_fn(self, mod, fn, qual, registered, counters,
-                  counters_declared) -> list:
+                  counters_declared, schema, schema_declared) -> list:
         out = []
         begins: dict[str, ast.AST] = {}
         consumed: set[str] = set()
@@ -112,6 +148,30 @@ class ObservabilityPass(AnalysisPass):
                         f"SPC counter '{cname}' is not declared in "
                         "runtime/spc.py _COUNTERS — record() silently "
                         "drops unknown names", qual))
+            elif short == "register_source" and node.args \
+                    and schema_declared:
+                sname = const_str(node.args[0])
+                if sname and sname not in schema:
+                    out.append(Finding(
+                        self.name, mod.path, node.lineno,
+                        node.col_offset,
+                        f"telemetry source {sname!r} is not a key of "
+                        "runtime/telemetry.py SCHEMA — published sample "
+                        "keys must come from the declared schema",
+                        qual))
+            elif (name.endswith("flight.dump")
+                  or (short == "dump"
+                      and mod.path.replace("\\", "/")
+                      .endswith("flight.py"))) and node.args:
+                reason = const_str(node.args[0])
+                if reason and ("help-flight", reason) not in registered:
+                    out.append(Finding(
+                        self.name, mod.path, node.lineno,
+                        node.col_offset,
+                        f"flight-recorder dump reason {reason!r} has no "
+                        "registered help-flight template — the crash "
+                        "announcement would be the raw fallback",
+                        qual))
             elif short in ("span", "hist_record"):
                 for arg in list(node.args) + [kw.value for kw in
                                               node.keywords]:

@@ -54,6 +54,7 @@ import os
 import random
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from ompi_tpu.base.var import VarType, registry
@@ -69,7 +70,9 @@ _spec_var = registry.register(
          "'drop:p=0.01;delay:ms=5,p=0.05;kill:rank=2,step=7' — empty "
          "(the default) disables chaos entirely (zero-cost identity). "
          "Faults: drop/delay/dup/corrupt/reset (btl wire), "
-         "stall/disconnect (coord client), kill (process level)")
+         "stall/disconnect (coord client), kill (process level); "
+         "every fault takes an optional rank= scope (e.g. "
+         "'delay:ms=5,rank=2' designs one slow rank)")
 
 #: module bool: the ONLY thing a hook site reads when chaos is off
 enabled = False
@@ -81,14 +84,21 @@ KILL_EXIT_CODE = 7
 
 _WIRE_FAULTS = ("drop", "delay", "dup", "corrupt", "reset")
 _COORD_FAULTS = ("stall", "disconnect")
+#: every fault takes an optional ``rank=`` scope (the rule only arms on
+#: that world rank) — a designed-slow straggler (``delay:ms=5,rank=2``)
+#: is what the otpu_analyze acceptance run injects
 _ALLOWED = {
-    "drop": {"p", "n"},
-    "delay": {"p", "ms", "n"},
-    "dup": {"p", "n"},
-    "corrupt": {"p", "n"},
-    "reset": {"p", "n"},
-    "stall": {"p", "ms", "n"},
-    "disconnect": {"p", "n"},
+    "drop": {"p", "n", "rank"},
+    # a site= delay moves off the wire onto a named pacing point
+    # (chaos.pace — the trainer's per-step hook): 'delay:ms=8,rank=2,
+    # site=step' designs ONE slow rank arriving late at every
+    # collective, the straggler otpu_analyze must localize
+    "delay": {"p", "ms", "n", "rank", "site"},
+    "dup": {"p", "n", "rank"},
+    "corrupt": {"p", "n", "rank"},
+    "reset": {"p", "n", "rank"},
+    "stall": {"p", "ms", "n", "rank"},
+    "disconnect": {"p", "n", "rank"},
     "kill": {"rank", "step", "after", "site", "count"},
 }
 _PARAM_TYPES = {"p": float, "ms": float, "after": float,
@@ -178,9 +188,25 @@ class _Engine:
     def __init__(self, rules: list, seed: int, rank: int) -> None:
         self.seed, self.rank = int(seed), int(rank)
         self.rules = list(rules)
-        self.wire_rules = [r for r in rules if r["fault"] in _WIRE_FAULTS]
+
+        def mine(r: dict) -> bool:
+            # rank-scoped rules arm only on their rank; the draw-stream
+            # contract is preserved — a filtered-out rule consumes no
+            # draws anywhere, so every rank's sequence stays a pure
+            # function of (seed, rank, site, event index)
+            return int(r.get("rank", rank)) == rank
+
+        self.wire_rules = [r for r in rules
+                           if r["fault"] in _WIRE_FAULTS and mine(r)
+                           and not ("site" in r
+                                    and r["fault"] == "delay")]
         self.coord_rules = [r for r in rules
-                            if r["fault"] in _COORD_FAULTS]
+                            if r["fault"] in _COORD_FAULTS and mine(r)]
+        # site-scoped delays: fire at chaos.pace(site) points, not on
+        # the wire
+        self.pace_rules = [r for r in rules
+                           if r["fault"] == "delay" and "site" in r
+                           and mine(r)]
         self.kills = [r for r in rules if r["fault"] == "kill"
                       and int(r.get("rank", rank)) == rank]
         self._lock = threading.Lock()
@@ -257,11 +283,48 @@ class _Engine:
         return None
 
 
+#: rolling injected-fault log (wall time, fault, site) — the flight
+#: recorder's "what was being injected when we died" tail; appended only
+#: when a fault actually fires, so the disabled path never touches it.
+#: Guarded: injector threads append while a crash-time snapshot
+#: iterates, and a deque mutated mid-iteration raises — which would
+#: silently cost the post-mortem dump in exactly the busy-fault runs
+#: the recorder exists for.
+_log: deque = deque(maxlen=256)
+_log_lock = threading.Lock()
+
+_GUARDED_BY = {"_log": "_log_lock"}
+
+
+def event_log() -> list:
+    """Last-N injected faults as ``[t_wall, fault, site]`` rows."""
+    with _log_lock:
+        return [list(e) for e in _log]
+
+
+def fault_totals() -> dict:
+    """{fault: times injected} — the telemetry sampler's ``chaos``
+    source (registered only while an engine is armed).  Read from the
+    cumulative SPC counters, NOT the bounded event log: the log is a
+    256-entry flight-recorder tail and would undercount a long soak."""
+    from ompi_tpu.runtime import spc
+
+    totals: dict = {}
+    for fault, counter in _SPC_NAME.items():
+        n = spc.read(counter)
+        if n:
+            totals[fault] = int(n)
+    return totals
+
+
 def _note(fault: str, site: str, extra: Optional[dict] = None) -> None:
-    """Every injected fault is SPC-counted and trace-instant'ed."""
+    """Every injected fault is SPC-counted, trace-instant'ed, and
+    appended to the flight-recorder event log."""
     from ompi_tpu.runtime import spc, trace
 
     spc.record(_SPC_NAME[fault])
+    with _log_lock:
+        _log.append((time.time(), fault, site))
     if trace.enabled:
         args = {"site": site}
         if extra:
@@ -277,6 +340,14 @@ def _kill(rule: dict) -> None:
     _note("kill", str(rule.get("site", rule)))
     print(f"[chaos] rank {rank} killed by schedule "
           f"{format_spec([rule])!r}", file=sys.stderr, flush=True)
+    try:
+        # the flight recorder's last chance: os._exit below skips
+        # atexit/finalize, so the post-mortem dump happens HERE
+        from ompi_tpu.runtime import flight
+
+        flight.dump("chaos-kill", detail=format_spec([rule]))
+    except Exception:
+        pass
     _exit(KILL_EXIT_CODE)
 
 
@@ -348,6 +419,23 @@ def coord_disconnect(op: str) -> bool:
     return False
 
 
+def pace(site: str) -> None:
+    """Named process-level pacing point (the compute-slowness twin of
+    :func:`kill_point`): a ``delay`` rule carrying ``site=`` sleeps
+    here instead of on the wire.  Planted in the elastic trainer's
+    step loop — ``delay:ms=8,rank=2,site=step`` turns rank 2 into a
+    designed straggler that arrives late at every collective, the
+    scenario ``otpu_analyze`` must localize."""
+    eng = _engine
+    if eng is None or not eng.pace_rules:
+        return
+    rule = eng.match([r for r in eng.pace_rules
+                      if str(r["site"]) == site], "pace:" + site)
+    if rule is not None:
+        _note("delay", "pace:" + site)
+        sleep_ms(rule)
+
+
 def kill_point(site: str, n: Optional[int] = None) -> None:
     """Named process-kill site.  ``n`` carries an index for indexed
     schedules (the trainer passes its step number); un-indexed sites
@@ -387,6 +475,11 @@ def install_spec(spec: str, rank: Optional[int] = None,
     _engine = _Engine(rules, seed, int(rank))
     enabled = True
     _engine.arm_timers()
+    # live fault totals for otpu_top — registered only while armed, so
+    # the chaos-off identity (no engine, no sources) stays intact
+    from ompi_tpu.runtime import telemetry
+
+    telemetry.register_source("chaos", fault_totals)
     return True
 
 
@@ -397,6 +490,9 @@ def uninstall() -> None:
     eng, _engine = _engine, None
     if eng is not None:
         eng.cancel_timers()
+        from ompi_tpu.runtime import telemetry
+
+        telemetry.unregister_source("chaos")
 
 
 def sleep_ms(rule: dict, default_ms: float = 1.0) -> None:

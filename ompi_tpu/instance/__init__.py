@@ -150,6 +150,15 @@ class Instance:
         _rt.reserve_cid(1)
 
         mark_runtime_initialized(True)
+
+        # live telemetry plane + crash-time flight recorder: both are
+        # no-ops unless their vars/triggers arm them, and both need the
+        # coord client this boot just established
+        from ompi_tpu.runtime import flight, telemetry
+
+        if getattr(self.rte, "client", None) is not None:
+            flight.arm(self.rte)
+            telemetry.start(self.rte)
         trace.span("instance_boot", "boot", t_boot)
 
     def _boot_device_world(self) -> None:
@@ -228,12 +237,31 @@ class Instance:
             self._fence_final()
             # trace export needs the coord client (KV publish + clock
             # offset), so it runs before rte.finalize tears it down
+            from ompi_tpu.runtime import flight as _flight
+            from ompi_tpu.runtime import monitoring as _monitoring
+            from ompi_tpu.runtime import telemetry as _telemetry
             from ompi_tpu.runtime import trace as _trace
 
             try:
                 _trace.finalize_export(self.rte)
             except Exception:
                 pass   # observability must never break teardown
+            try:
+                # survivor post-mortem: if this job saw peer failures,
+                # the ring now holds the whole recovery — dump it for
+                # the launcher's flight bundle
+                _flight.maybe_dump_postmortem(self.rte)
+            except Exception:
+                pass
+            try:
+                _monitoring.finalize_publish(self.rte)
+            except Exception:
+                pass
+            try:
+                _telemetry.stop()
+                _flight.disarm()
+            except Exception:
+                pass
             # release per-comm coll resources of any communicator the
             # user never freed (ompi_mpi_finalize destroys remaining
             # comms the same way) — shared segments must unmap here, not

@@ -309,8 +309,20 @@ class _StagingPool:
             self._bytes = 0
             self.hits = self.misses = 0
 
+    def stats(self) -> dict:
+        """Occupancy snapshot for the telemetry sampler: pooled bytes,
+        outstanding checkouts, lifetime hit/miss counts."""
+        with self._lock:
+            return {"bytes": self._bytes, "out": len(self._out),
+                    "hits": self.hits, "misses": self.misses}
+
 
 staging = _StagingPool()
+
+# staging-pool occupancy for otpu_top (sampler-thread-only provider)
+from ompi_tpu.runtime import telemetry as _telemetry
+
+_telemetry.register_source("staging", staging.stats)
 
 
 def staging_acquire(shape, dtype) -> np.ndarray:

@@ -183,6 +183,25 @@ class TcpBtl(Btl):
         # hand-off to the FT detector as suspicions — filled under
         # _conns_lock in _drop_conn, drained lock-free by send/progress
         self._suspects: list[int] = []
+        # live out-queue depth for otpu_top (one dict insert here; the
+        # provider runs only on the sampler thread, never on a hot path)
+        from ompi_tpu.runtime import telemetry
+
+        telemetry.register_source("tcp", self._telemetry_stats)
+
+    def _telemetry_stats(self) -> dict:
+        """Sampler-thread source: aggregate out-queue depth/bytes and
+        connection count.  Racy unlocked reads of per-conn counters —
+        telemetry is an approximation, and the lock contract only
+        covers mutation."""
+        frags = qbytes = nconns = 0
+        for conns in list(self._by_rank.values()):
+            for conn in list(conns):
+                nconns += 1
+                frags += len(conn.outq)
+                qbytes += conn.out_bytes
+        return {"outq_frags": frags, "outq_bytes": qbytes,
+                "conns": nconns}
 
     def register_vars(self, fw) -> None:
         self.register_var(
@@ -821,6 +840,12 @@ class TcpBtl(Btl):
             "corruption detected")
 
     def close(self) -> None:
+        # a closed btl must stop publishing telemetry: the sampler may
+        # outlive this object's usefulness and would report frozen
+        # queue depths as live data (chaos.uninstall's discipline)
+        from ompi_tpu.runtime import telemetry
+
+        telemetry.unregister_source("tcp")
         # flush queued outbound bytes before closing (same delivered-but-
         # unsent exit hazard as btl/sm — see its close())
         deadline = time.monotonic() + 30.0

@@ -179,8 +179,11 @@ class ElasticTrainer:
         while self.step < self._total_steps:
             if chaos.enabled:
                 # the kill-at-step schedule (tpurun --mca
-                # otpu_chaos_spec 'kill:rank=R,step=S')
+                # otpu_chaos_spec 'kill:rank=R,step=S') and the
+                # designed-straggler pacing point ('delay:ms=8,rank=R,
+                # site=step')
                 chaos.kill_point("step", n=self.step)
+                chaos.pace("step")
             try:
                 if self.step % self.ckpt_every == 0:
                     self._checkpoint()

@@ -30,7 +30,7 @@ import struct
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Optional
 
 from ompi_tpu.base.var import VarType, registry
@@ -106,6 +106,7 @@ class CoordServer:
         "_fence_done": "_fence_cond", "_fence_expect": "_fence_cond",
         "_failed": "_fence_cond",
         "_events": "_event_cond", "_event_seq": "_event_cond",
+        "_event_times": "_event_cond",
         "_conns": "_conns_lock",
         "_rpc_cache": "_rpc_cond", "_inflight": "_rpc_cond",
     }
@@ -124,6 +125,10 @@ class CoordServer:
         self._fence_cond = threading.Condition()
         self._events: list[tuple[int, str, Any]] = []
         self._event_seq = 0
+        # wall-clock stamp per event seq — the poll wire format stays
+        # (seq, name, payload); the flight-recorder bundle reads the
+        # times through flight_view() instead
+        self._event_times: dict[int, float] = {}
         self._event_cond = threading.Condition()
         self._aborted: Optional[int] = None
         self._failed: set[int] = set()
@@ -446,11 +451,28 @@ class CoordServer:
         with self._event_cond:
             self._event_seq += 1
             self._events.append((self._event_seq, name, payload))
+            self._event_times[self._event_seq] = time.time()
             self._event_cond.notify_all()
 
     @property
     def aborted(self) -> Optional[int]:
         return self._aborted
+
+    def flight_view(self) -> dict:
+        """The coord service's own post-mortem view — timestamped event
+        log, known-failed ranks, advertised psets — merged into the
+        flight-recorder bundle next to the per-rank dumps."""
+        with self._event_cond:
+            events = [{"seq": s, "name": n, "payload": p,
+                       "t": self._event_times.get(s)}
+                      for s, n, p in self._events]
+        with self._fence_cond:
+            failed = sorted(self._failed)
+        with self._kv_cond:
+            psets = {n: e["members"] for n, e in self._psets.items()}
+        return {"events": events, "failed": failed, "psets": psets,
+                "nprocs": self.nprocs, "aborted": self._aborted,
+                "t": time.time()}
 
     def collect(self, key: str) -> dict:
         """{rank: value} of every KV entry published under ``key`` — the
@@ -517,6 +539,9 @@ class CoordClient:
         self._sock: Optional[socket.socket] = self._dial()
         self._lock = threading.Lock()
         self._event_since = 0
+        # rolling last-N RPC ring for the flight recorder: (wall time,
+        # op, rid, ok) — one deque append per RPC, read at crash time
+        self._recent: deque = deque(maxlen=64)
 
     def _dial(self) -> socket.socket:
         sock = socket.create_connection(self._addr,
@@ -529,10 +554,22 @@ class CoordClient:
             self._rid += 1
             req["_cid"] = self._cid
             req["_rid"] = self._rid
-            resp = self._rpc_locked(req)
+            try:
+                resp = self._rpc_locked(req)
+            except BaseException:
+                self._recent.append((time.time(), str(req.get("op")),
+                                     self._rid, False))
+                raise
+            self._recent.append((time.time(), str(req.get("op")),
+                                 self._rid, bool(resp.get("ok"))))
         if not resp.get("ok"):
             raise RuntimeError(f"coordination error: {resp.get('error')}")
         return resp
+
+    def recent_rpcs(self) -> list:
+        """Last-N completed/failed RPCs as ``[t_wall, op, rid, ok]``
+        rows (the flight recorder's coord-activity tail)."""
+        return [list(e) for e in self._recent]
 
     def _rpc_locked(self, req: dict) -> dict:
         """One idempotent RPC round: send → (maybe injected fault) →

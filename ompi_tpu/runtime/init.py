@@ -336,6 +336,12 @@ def abort(obj, errorcode: int = 1) -> None:
     """``MPI_Abort``: tear down the job."""
     print(f"[ompi_tpu] MPI_Abort on {obj!r} with code {errorcode}",
           file=sys.stderr, flush=True)
+    try:
+        from ompi_tpu.runtime import flight
+
+        flight.dump("abort", detail=f"code {errorcode} on {obj!r}")
+    except Exception:
+        pass
     if _rte is not None:
         _rte.event_notify("abort", {"code": errorcode})
     sys.exit(errorcode)

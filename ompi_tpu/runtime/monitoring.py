@@ -174,6 +174,62 @@ def maybe_wrap_pml(pml_module):
     return pml_module
 
 
+_KV_KEY = "otpu_monitoring"
+
+
+def finalize_publish(rte) -> None:
+    """Publish this rank's monitoring matrices into the coord KV at
+    finalize (instance teardown, while the client is still alive) so
+    the launcher can print ONE job-wide communication matrix instead of
+    requiring N interleaved per-rank atexit dumps.  The explicit
+    ``monitoring_dump_at_exit`` dump is NOT suppressed by the publish:
+    only a launcher that actually gathers the KV prints the merged
+    view, and a non-tpurun embedding must not lose its matrices."""
+    if not enabled():
+        return
+    client = getattr(rte, "client", None)
+    if client is None:
+        return
+    import json
+
+    rank = int(getattr(rte, "my_world_rank", 0) or 0)
+    with _lock:
+        payload = {
+            "rank": rank,
+            "p2p": [[s, d, m, b] for (s, d), (m, b) in
+                    sorted(_p2p.items())],
+            "coll": {k: list(v) for k, v in _coll.items()},
+            "osc": {k: list(v) for k, v in _osc.items()},
+        }
+    client.put(rank, _KV_KEY, json.dumps(payload))
+
+
+def merged_summary(payloads: list, nprocs: int) -> str:
+    """Launcher-side job-wide view: sum every rank's published p2p
+    matrix into one ``src -> dst`` table plus per-collective totals
+    (``tpurun`` prints this at job end when monitoring ran)."""
+    p2p: dict = {}
+    coll: dict = {}
+    for p in payloads:
+        for s, d, m, b in p.get("p2p", []):
+            cell = p2p.setdefault((int(s), int(d)), [0, 0])
+            cell[0] += int(m)
+            cell[1] += int(b)
+        for name, (c, b) in p.get("coll", {}).items():
+            cell = coll.setdefault(name, [0, 0])
+            cell[0] += int(c)
+            cell[1] += int(b)
+    lines = [f"monitoring: job-wide p2p matrix ({nprocs} ranks, "
+             f"{len(payloads)} reporting; src -> dst: msgs/bytes)"]
+    for (s, d) in sorted(p2p):
+        m, b = p2p[(s, d)]
+        lines.append(f"  {s} -> {d}: {m} msgs, {b} bytes")
+    for name in sorted(coll):
+        c, b = coll[name]
+        lines.append(f"  coll {name}: {c} calls, {b} bytes")
+    return "\n".join(lines)
+
+
 def _atexit_dump() -> None:
     if enabled() and bool(_dump_var.value):
         import sys

@@ -135,3 +135,16 @@ from ompi_tpu.base.output import register_help as _rh
 
 _rh("help-progress", "callback-failed",
     "A progress callback raised and was unregistered:\n{detail}")
+
+# progress-engine depth for otpu_top (sampler-thread-only provider)
+from ompi_tpu.runtime import telemetry as _telemetry
+
+
+def _telemetry_stats() -> dict:
+    with _lock:
+        return {"callbacks": len(_callbacks) + len(_lp_callbacks),
+                "low_priority": len(_lp_callbacks),
+                "waiters": _waiter_count}
+
+
+_telemetry.register_source("progress", _telemetry_stats)

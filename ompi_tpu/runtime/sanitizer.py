@@ -34,4 +34,23 @@ class SanitizeError(AssertionError):
 
 
 def fail(msg: str) -> None:
+    try:
+        # crash-time post-mortem: a sanitizer trip is exactly the
+        # moment the trace tail / SPC snapshot explain the broken
+        # invariant (no-op unless the flight recorder is armed).  On
+        # its OWN short-lived thread (the propagator.wire_suspicion
+        # pattern): fail() fires inside hot paths holding declared
+        # locks (tcp send_lock), and the dump dials the coord service
+        # for a clock offset — seconds of blocking I/O that must not
+        # stall the connection, and must not run under the lock.
+        import threading
+
+        from ompi_tpu.runtime import flight
+
+        threading.Thread(target=flight.dump, args=("sanitize",),
+                         kwargs={"detail": msg},
+                         name="otpu-flight-sanitize",
+                         daemon=True).start()
+    except Exception:
+        pass
     raise SanitizeError(msg)

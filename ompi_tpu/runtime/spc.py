@@ -33,6 +33,9 @@ _COUNTERS = (
     # self-healing coord/wire layer: reconnect-retry activity and
     # detected (checksummed) wire corruption
     "coord_reconnects", "coord_rpc_retries", "wire_cksum_fail",
+    # live-telemetry plane (runtime/telemetry + runtime/flight):
+    # samples published into the coord KV, crash dumps written
+    "telemetry_samples", "flight_dumps",
 )
 
 _pvars = {}

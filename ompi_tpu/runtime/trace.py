@@ -240,6 +240,65 @@ def _key_percentile_us(key, q: float) -> float:
         return _interp_percentile_ns(cell[6], q, cell[2], cell[3]) / 1000.0
 
 
+def hist_snapshot() -> dict:
+    """Deep-copied histogram state for delta consumers (the telemetry
+    sampler): ``{(coll, size_bin): (count, sum_ns, min_ns, max_ns,
+    {log2 dur bin: count})}``.  Pure read — the live populations are
+    NEVER reset or otherwise disturbed, so a sampler can snapshot at
+    its own cadence while percentile pvars, ``hist_percentile`` and the
+    finalize export keep seeing the full-run populations."""
+    with _hist_lock:
+        return {k: (c[0], c[1], c[2], c[3], dict(c[6]))
+                for k, c in _hist.items()}
+
+
+def hist_delta_stats(prev: dict, cur: dict) -> dict:
+    """Per-collective interval statistics between two
+    :func:`hist_snapshot` results: ``{coll: {"n": invocations,
+    "sum_us": total latency, "p50_us": ..., "p99_us": ...}}`` computed
+    from the BIN-COUNT DELTAS (size bins merged per collective), so the
+    percentiles describe only the interval's population.  Collectives
+    with no new invocations are omitted — the samples stay compact.
+    ``bytes`` is a payload-volume estimate (count x size-bin lower
+    bound, exact to within one log2 bin) — the live-rate signal for
+    traffic that never touches the pml SPC counters (sm collectives)."""
+    merged: dict = {}   # coll -> [dn, dsum_ns, {dur bin: dcount}, bytes]
+    clamps: dict = {}        # coll -> [lo_ns, hi_ns] (from cur cells)
+    for key, cell in cur.items():
+        coll = key[0]
+        old = prev.get(key)
+        dn = cell[0] - (old[0] if old else 0)
+        if dn <= 0:
+            continue
+        dsum = cell[1] - (old[1] if old else 0)
+        acc = merged.setdefault(coll, [0, 0, {}, 0])
+        acc[0] += dn
+        acc[1] += dsum
+        b = key[1]
+        acc[3] += dn * (0 if b == 0 else (1 << (b - 1)))
+        old_bins = old[4] if old else {}
+        for db, cnt in cell[4].items():
+            d = cnt - old_bins.get(db, 0)
+            if d > 0:
+                acc[2][db] = acc[2].get(db, 0) + d
+        cl = clamps.setdefault(coll, [cell[2], cell[3]])
+        cl[0] = min(cl[0], cell[2])
+        cl[1] = max(cl[1], cell[3])
+    out = {}
+    for coll, (dn, dsum, dbins, dbytes) in merged.items():
+        lo, hi = clamps[coll]
+        out[coll] = {
+            "n": dn,
+            "bytes": dbytes,
+            "sum_us": round(dsum / 1000.0, 1),
+            "p50_us": round(
+                _interp_percentile_ns(dbins, 0.5, lo, hi) / 1000.0, 1),
+            "p99_us": round(
+                _interp_percentile_ns(dbins, 0.99, lo, hi) / 1000.0, 1),
+        }
+    return out
+
+
 def hist_reset(coll: str) -> None:
     """Drop every histogram cell of ``coll`` so the next records start
     a fresh population — measurement harnesses (the serving driver) use

@@ -110,6 +110,21 @@ class ContinuousBatchScheduler:
         self._done: list = []
         self._free_slots = list(range(self.slots - 1, -1, -1))
         self._used_tokens = 0
+        # scheduler depth for otpu_top (latest-constructed scheduler
+        # wins the slot; the provider runs on the sampler thread only)
+        from ompi_tpu.runtime import telemetry
+
+        telemetry.register_source("serving", self.stats)
+
+    def stats(self) -> dict:
+        """Queue/batch depth snapshot (the telemetry ``serving`` source
+        and the autoscaler's richer sibling of :meth:`depth`)."""
+        with self._slock:
+            return {"queued": len(self._sq),
+                    "running": len(self._running),
+                    "done": len(self._done),
+                    "used_tokens": self._used_tokens,
+                    "free_slots": len(self._free_slots)}
 
     # -- submission (any thread) -----------------------------------------
     def submit(self, req: ServeRequest) -> ServeRequest:

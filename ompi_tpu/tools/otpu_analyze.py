@@ -1,0 +1,332 @@
+"""otpu_analyze — cross-rank straggler / critical-path analysis.
+
+Consumes the clock-aligned timelines the tracing stack already produces
+(``trace_merged.json`` from ``tpurun``, per-rank ``trace_rank<r>.json``
+payloads, or a directory holding either) and answers the questions a
+skew report's eyeball pass cannot:
+
+- **Last-arrival attribution**: for every matched collective round,
+  which rank entered last?  The rank that is last most often IS the
+  straggler — on a synchronizing collective everyone else's wait time
+  is attributable to it.  Rounds are matched per (collective, cid) by
+  occurrence index from the tail (the ring-overwrite convention
+  ``trace.skew_report`` established).
+- **Inter-rank skew distributions**: per (collective, cid) and overall,
+  the mean/p50/p99/max spread between first and last arrival — the
+  measured input a HiCCL-style topology composer needs to justify its
+  schedule choices.
+- **Exposed-communication fraction**: per rank, the fraction of its
+  observed timeline spent inside collective spans (interval-union, so
+  nested/overlapping spans don't double-count) — the number the
+  fused-overlap work (ROADMAP item 4) must drive toward zero.  When
+  step spans exist (``cat == "step"`` or a ``--step-span`` name), the
+  fraction is also reported per step.
+
+The report is a regression-friendly JSON document (stable key order,
+rounded numbers); ``--diff OLD.json`` compares two runs the way
+``bench.py`` diffs its sweep rows and flags straggler/skew movement.
+"""
+from __future__ import annotations
+
+import argparse
+import bisect
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+# THE percentile and clock-alignment implementations (the offset sign
+# convention must live in exactly one place — trace.py)
+from ompi_tpu.runtime.trace import _percentile, merge_timelines
+
+
+def load_events(paths: list) -> list:
+    """Normalize any input form into one clock-aligned event list.
+
+    Accepts merged-timeline files (events already aligned, ``pid`` =
+    rank), per-rank payload files (aligned here via each payload's
+    ``clock_offset_us``), flight-recorder bundles (``merged_tail``),
+    and directories (prefer ``trace_merged.json``, else every
+    ``trace_rank*.json``)."""
+    files: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            merged = os.path.join(p, "trace_merged.json")
+            if os.path.exists(merged):
+                files.append(merged)
+            else:
+                files.extend(sorted(glob.glob(
+                    os.path.join(p, "trace_rank*.json"))))
+        else:
+            files.append(p)
+    if not files:
+        raise SystemExit("otpu_analyze: no timeline files found")
+    events: list = []
+    payloads: list = []       # per-rank payloads: align via THE merger
+    for path in files:
+        with open(path) as f:
+            doc = json.load(f)
+        if "merged_tail" in doc:                  # flight bundle
+            events.extend(doc["merged_tail"])
+        elif "traceEvents" in doc:
+            if doc.get("metadata", {}).get("rank") is not None:
+                payloads.append(doc)              # per-rank payload
+            else:
+                events.extend(doc["traceEvents"])  # already merged
+        else:
+            raise SystemExit(f"otpu_analyze: {path!r} is not a trace "
+                             "timeline, payload, or flight bundle")
+    if payloads:
+        events.extend(merge_timelines(payloads))
+    events.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return events
+
+
+def _coll_rounds(events: list) -> dict:
+    """(name, cid) -> {rank: [(ts, dur)]} for collective X-spans."""
+    table: dict = {}
+    for ev in events:
+        if ev.get("cat") != "coll" or ev.get("ph") != "X":
+            continue
+        eargs = ev.get("args") or {}
+        key = (ev.get("name"), eargs.get("cid"))
+        table.setdefault(key, {}).setdefault(
+            int(ev.get("pid", 0)), []).append(
+            (float(ev["ts"]), float(ev.get("dur", 0.0))))
+    return table
+
+
+def _union_us(intervals: list) -> float:
+    """Total covered microseconds of possibly-overlapping (start, dur)
+    intervals."""
+    if not intervals:
+        return 0.0
+    intervals = sorted(intervals)
+    total = 0.0
+    cur_lo, cur_hi = intervals[0][0], intervals[0][0] + intervals[0][1]
+    for lo, dur in intervals[1:]:
+        hi = lo + dur
+        if lo > cur_hi:
+            total += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    return total + (cur_hi - cur_lo)
+
+
+def analyze(events: list, step_span: Optional[str] = None) -> dict:
+    """The full report over one clock-aligned event list (see module
+    docstring for the sections)."""
+    ranks = sorted({int(e.get("pid", 0)) for e in events})
+    per_coll: dict = {}
+    last_arrival: dict = {r: 0 for r in ranks}
+    all_spreads: list = []
+    rounds_total = 0
+    for (name, cid), by_rank in sorted(
+            _coll_rounds(events).items(),
+            key=lambda kv: (str(kv[0][0]), str(kv[0][1]))):
+        members = sorted(by_rank)
+        if len(members) < 2:
+            continue
+        rounds = min(len(by_rank[r]) for r in members)
+        if rounds == 0:
+            continue
+        tails = {r: by_rank[r][len(by_rank[r]) - rounds:]
+                 for r in members}
+        spreads: list = []
+        last_count: dict = {}
+        for k in range(rounds):
+            starts = {r: tails[r][k][0] for r in members}
+            last = max(starts, key=starts.get)
+            last_count[last] = last_count.get(last, 0) + 1
+            last_arrival[last] = last_arrival.get(last, 0) + 1
+            spreads.append(max(starts.values()) - min(starts.values()))
+        rounds_total += rounds
+        all_spreads.extend(spreads)
+        spreads.sort()
+        slowest = max(last_count, key=last_count.get)
+        per_coll[f"{name}/cid{cid}"] = {
+            "rounds": rounds,
+            "ranks": members,
+            "straggler_rank": slowest,
+            "straggler_fraction": round(last_count[slowest] / rounds, 3),
+            "last_arrivals": {str(r): last_count.get(r, 0)
+                              for r in members},
+            "skew_us": {
+                "mean": round(sum(spreads) / rounds, 1),
+                "p50": round(_percentile(spreads, 0.50), 1),
+                "p99": round(_percentile(spreads, 0.99), 1),
+                "max": round(spreads[-1], 1),
+            },
+        }
+    # one grouping pass (events are large; steps can be many — never
+    # rescan the whole list per rank or per step)
+    spans_by_rank: dict = {}     # rank -> [(ts, ts+dur)] of X-spans
+    coll_by_rank: dict = {}      # rank -> sorted [(ts, dur)] of colls
+    step_spans: list = []        # (rank, ts, dur, args)
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        r = int(ev.get("pid", 0))
+        ts, dur = float(ev["ts"]), float(ev.get("dur", 0.0))
+        spans_by_rank.setdefault(r, []).append((ts, ts + dur))
+        if ev.get("cat") == "coll":
+            coll_by_rank.setdefault(r, []).append((ts, dur))
+        if ev.get("cat") == "step" \
+                or ev.get("name") == (step_span or "step"):
+            step_spans.append((r, ts, dur, ev.get("args") or {}))
+    for spans in coll_by_rank.values():
+        spans.sort()
+    # exposed-communication fraction per rank (interval union)
+    exposed: dict = {}
+    for r in ranks:
+        mine = spans_by_rank.get(r)
+        if not mine:
+            continue
+        lo = min(t0 for t0, _t1 in mine)
+        hi = max(t1 for _t0, t1 in mine)
+        comm = _union_us(coll_by_rank.get(r, []))
+        exposed[str(r)] = round(comm / (hi - lo), 3) if hi > lo else 0.0
+    # per-step breakdown when step spans exist (bisect into the rank's
+    # sorted coll starts instead of rescanning the event list)
+    steps: dict = {}
+    for r, lo, dur, eargs in step_spans:
+        colls = coll_by_rank.get(r, [])
+        i = bisect.bisect_left(colls, (lo, float("-inf")))
+        j = bisect.bisect_left(colls, (lo + dur, float("-inf")))
+        comm = _union_us(colls[i:j])
+        idx = eargs.get("step", len(steps.get(str(r), [])))
+        steps.setdefault(str(r), []).append(
+            {"step": idx, "exposed_comm": round(comm / dur, 3)
+             if dur > 0 else 0.0})
+    all_spreads.sort()
+    straggler = (max(last_arrival, key=last_arrival.get)
+                 if rounds_total else None)
+    report = {
+        "ranks": ranks,
+        "rounds_total": rounds_total,
+        "straggler": {
+            "rank": straggler,
+            "fraction": round(last_arrival.get(straggler, 0)
+                              / rounds_total, 3) if rounds_total else 0.0,
+            "last_arrivals": {str(r): last_arrival.get(r, 0)
+                              for r in ranks},
+        },
+        "skew_us": {
+            "mean": round(sum(all_spreads) / len(all_spreads), 1)
+            if all_spreads else 0.0,
+            "p50": round(_percentile(all_spreads, 0.50), 1),
+            "p99": round(_percentile(all_spreads, 0.99), 1),
+            "max": round(all_spreads[-1], 1) if all_spreads else 0.0,
+        },
+        "collectives": per_coll,
+        "exposed_comm": exposed,
+        "steps": steps,
+    }
+    return report
+
+
+def diff_reports(old: dict, new: dict) -> dict:
+    """Regression-friendly comparison of two reports (what bench.py
+    diffs across runs): straggler movement, skew deltas, exposed-comm
+    deltas per rank."""
+    out: dict = {"straggler_changed":
+                 old.get("straggler", {}).get("rank")
+                 != new.get("straggler", {}).get("rank"),
+                 "straggler": [old.get("straggler", {}).get("rank"),
+                               new.get("straggler", {}).get("rank")]}
+    for field in ("mean", "p50", "p99", "max"):
+        a = float(old.get("skew_us", {}).get(field, 0.0))
+        b = float(new.get("skew_us", {}).get(field, 0.0))
+        out[f"skew_{field}_us_delta"] = round(b - a, 1)
+    exp: dict = {}
+    for r in sorted(set(old.get("exposed_comm", {}))
+                    | set(new.get("exposed_comm", {}))):
+        a = float(old.get("exposed_comm", {}).get(r, 0.0))
+        b = float(new.get("exposed_comm", {}).get(r, 0.0))
+        exp[r] = round(b - a, 3)
+    out["exposed_comm_delta"] = exp
+    return out
+
+
+def render_text(report: dict, parsable: bool = False) -> str:
+    if parsable:
+        lines = []
+        s = report["straggler"]
+        lines.append(f"straggler:{s['rank']}:{s['fraction']}")
+        sk = report["skew_us"]
+        lines.append(f"skew_us:{sk['mean']}:{sk['p50']}:{sk['p99']}:"
+                     f"{sk['max']}")
+        for key, c in report["collectives"].items():
+            lines.append(
+                f"coll:{key}:{c['rounds']}:{c['straggler_rank']}:"
+                f"{c['straggler_fraction']}:{c['skew_us']['p99']}")
+        for r, f in report["exposed_comm"].items():
+            lines.append(f"exposed_comm:{r}:{f}")
+        return "\n".join(lines)
+    s = report["straggler"]
+    lines = [f"otpu-analyze — {len(report['ranks'])} ranks, "
+             f"{report['rounds_total']} matched collective rounds"]
+    if s["rank"] is not None:
+        lines.append(
+            f"straggler: rank {s['rank']} arrived last in "
+            f"{100 * s['fraction']:.0f}% of rounds "
+            f"({s['last_arrivals']})")
+    sk = report["skew_us"]
+    lines.append(f"inter-rank skew (us): mean {sk['mean']}  "
+                 f"p50 {sk['p50']}  p99 {sk['p99']}  max {sk['max']}")
+    lines.append("")
+    lines.append(f"{'collective':<24} {'rounds':>6} {'straggler':>9} "
+                 f"{'fraction':>8} {'skew p99':>9}")
+    for key, c in report["collectives"].items():
+        lines.append(f"{key:<24} {c['rounds']:>6} "
+                     f"{c['straggler_rank']:>9} "
+                     f"{c['straggler_fraction']:>8} "
+                     f"{c['skew_us']['p99']:>9}")
+    lines.append("")
+    lines.append("exposed-communication fraction per rank:")
+    for r, f in report["exposed_comm"].items():
+        lines.append(f"  rank {r}: {100 * f:.1f}%")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="otpu_analyze",
+        description="Straggler/critical-path analysis over merged "
+                    "otpu-trace timelines")
+    ap.add_argument("paths", nargs="+",
+                    help="trace_merged.json, per-rank trace_rank*.json "
+                         "files, a flight bundle, or a trace directory")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    dest="json_out",
+                    help="Write the JSON report here ('-' = stdout)")
+    ap.add_argument("--parsable", action="store_true",
+                    help="Colon-separated text output")
+    ap.add_argument("--step-span", default=None,
+                    help="Span name marking one training step (per-step "
+                         "exposed-comm breakdown)")
+    ap.add_argument("--diff", default=None, metavar="OLD",
+                    help="Compare against a previous JSON report and "
+                         "print the deltas")
+    args = ap.parse_args(argv)
+    report = analyze(load_events(args.paths), step_span=args.step_span)
+    if args.json_out:
+        encoded = json.dumps(report, indent=1, sort_keys=False)
+        if args.json_out == "-":
+            print(encoded)
+        else:
+            with open(args.json_out, "w") as f:
+                f.write(encoded)
+    if args.diff:
+        with open(args.diff) as f:
+            old = json.load(f)
+        print(json.dumps(diff_reports(old, report), indent=1))
+    if not (args.json_out == "-" or args.diff):
+        print(render_text(report, parsable=args.parsable))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,11 @@ def main(argv=None) -> int:
                          "(the invariant families the static analyzer "
                          "enforces; run them with ompi_tpu.tools"
                          ".otpu_lint)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="Show the live-telemetry plane: every "
+                         "published sample key (the declared schema "
+                         "otpu_top renders), the sampler's MCA vars, "
+                         "and the flight-recorder settings")
     ap.add_argument("--psets", action="store_true",
                     help="Show the process sets the coordination service "
                          "advertises (name, size, membership source) — "
@@ -178,6 +183,20 @@ def main(argv=None) -> int:
         for lint_pass in analysis.all_passes():
             out.append(_fmt(f"lint pass {lint_pass.name}",
                             lint_pass.description, p))
+
+    if args.all or args.telemetry:
+        # registry-enumerated like --lint/--psets: the schema constant
+        # and the telemetry/flight var groups, never a hand-kept list
+        from ompi_tpu.runtime import flight as _flight  # noqa: F401
+        from ompi_tpu.runtime import telemetry as _telemetry
+
+        for key, desc in _telemetry.SCHEMA.items():
+            out.append(_fmt(f"telemetry key {key}", desc, p))
+        for group in ("telemetry", "flight"):
+            for var in registry.all_vars(group):
+                out.append(_fmt(
+                    f"telemetry var {var.name}",
+                    f"{var.value!r} — {var.help}", p))
 
     if args.all or args.psets:
         for pname, size, source in _pset_rows():

@@ -182,10 +182,11 @@ def _merge_traces(server) -> None:
     text skew report next to the per-rank files."""
     import json
 
-    raw = server.collect("otpu_trace")
+    from ompi_tpu.runtime import trace
+
+    raw = server.collect(trace._KV_KEY)
     if not raw:
         return
-    from ompi_tpu.runtime import trace
 
     payloads = []
     for rank in sorted(raw):
@@ -213,6 +214,83 @@ def _merge_traces(server) -> None:
         return
     print(f"tpurun: merged timeline of {len(payloads)} ranks -> "
           f"{merged_path}; skew report -> {report_path}", file=sys.stderr)
+
+
+def _merge_monitoring(server) -> None:
+    """Job-wide communication matrix: ranks publish their monitoring
+    matrices into the coord KV at finalize; the head sums them and
+    prints ONE table (superseding the per-rank atexit dumps)."""
+    import json
+
+    from ompi_tpu.runtime import monitoring
+
+    raw = server.collect(monitoring._KV_KEY)
+    if not raw:
+        return
+
+    payloads = []
+    for rank in sorted(raw):
+        try:
+            payloads.append(json.loads(raw[rank]))
+        except (TypeError, ValueError):
+            pass
+    if payloads:
+        print("tpurun: " + monitoring.merged_summary(
+            payloads, server.nprocs), file=sys.stderr)
+
+
+def _gather_flight(server) -> None:
+    """Flight-recorder gather: crashing/surviving ranks publish their
+    post-mortem dumps into the coord KV; the head merges them with the
+    coord service's own timestamped event view into one clock-aligned
+    bundle (victim's last trace events ordered against the survivors'
+    recovery spans on the coord clock)."""
+    import json
+
+    from ompi_tpu.runtime import flight as flight_mod
+
+    raw = server.collect(flight_mod._KV_KEY)
+    if not raw:
+        return
+    dumps = {}
+    for rank in sorted(raw):
+        try:
+            dumps[rank] = json.loads(raw[rank])
+        except (TypeError, ValueError):
+            print(f"tpurun: rank {rank} published an unreadable flight "
+                  "dump", file=sys.stderr)
+    if not dumps:
+        return
+    # clock-aligned merged event tail: each dump's trace tail wrapped
+    # as a per-rank payload and run through THE timeline merger (one
+    # alignment implementation, shared with _merge_traces)
+    from ompi_tpu.runtime import trace
+
+    merged = trace.merge_timelines([
+        {"traceEvents": d.get("trace_tail", []),
+         "metadata": {"rank": rank,
+                      "clock_offset_us": d.get("clock_offset_us", 0.0)}}
+        for rank, d in dumps.items()])
+    bundle = {
+        "dumps": {str(r): d for r, d in dumps.items()},
+        "coord": server.flight_view(),
+        "merged_tail": merged,
+        "clock": "coord-server",
+    }
+    fdir = next(iter(dumps.values())).get("flight_dir", "otpu-crash")
+    try:
+        os.makedirs(fdir, exist_ok=True)
+        path = os.path.join(fdir, "bundle.json")
+        with open(path, "w") as f:
+            json.dump(bundle, f)
+    except OSError as exc:
+        print(f"tpurun: cannot write flight bundle: {exc}",
+              file=sys.stderr)
+        return
+    reasons = ", ".join(f"rank {r}: {d.get('reason')}"
+                        for r, d in sorted(dumps.items()))
+    print(f"tpurun: flight-recorder bundle of {len(dumps)} dump(s) "
+          f"({reasons}) -> {path}", file=sys.stderr)
 
 
 def _teardown(procs_list, pumps, exit_code: int) -> None:
@@ -632,6 +710,8 @@ def main(argv=None) -> int:
         abort_check=lambda: server.aborted)
     _teardown(procs, pumps, exit_code)
     _merge_traces(server)
+    _merge_monitoring(server)
+    _gather_flight(server)
     server.close()
     if exit_code:
         print(f"tpurun: job terminated with exit code {exit_code}",

@@ -17,3 +17,11 @@ def timed(comm, buf):
     t0 = trace.now()                          # BAD: never reaches a span
     comm.allreduce(buf)
     return buf
+
+
+def publish(telemetry):
+    telemetry.register_source("mystery", dict)  # BAD: not a SCHEMA key
+
+
+def crash(flight):
+    flight.dump("mystery-reason")             # BAD: no help-flight key

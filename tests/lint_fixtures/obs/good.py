@@ -31,3 +31,14 @@ def timed(comm, buf):
 def timed_deferred(req):
     t0 = trace.now()
     req.on_complete(lambda r: trace.span("send", "pml", t0))
+
+
+_rh("help-flight", "good-reason", "Dump at {path}.")
+
+
+def publish(telemetry):
+    telemetry.register_source("tcp", dict)    # declared in SCHEMA
+
+
+def crash(flight):
+    flight.dump("good-reason")                # registered help-flight key

@@ -1,0 +1,7 @@
+"""Fixture stand-in for runtime/telemetry.py: the declared sample
+schema the register_source rule checks literal names against."""
+
+SCHEMA = {
+    "tcp": "transport out-queue depth",
+    "serving": "scheduler queue depth",
+}

@@ -424,3 +424,49 @@ def test_host_persistent_collective_and_ext_queries(tmp_path):
     r = _tpurun(3, script)
     assert r.stdout.count("PCOLL OK") == 3, r.stdout + r.stderr
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_mpi_t_pvar_discoverability_complete():
+    """MPI_T completeness (otpu-top satellite): every SPC counter and
+    every otpu-trace histogram pvar must be discoverable AND readable
+    through an ``api/tool.py`` PvarSession — the contract otpu_top and
+    external MPI_T tools rely on.  The histogram pvars register lazily
+    per touched (coll, size-bin) cell, so the test records one cell
+    first, then demands the full family (count/sum/p50/p99)."""
+    from ompi_tpu.api import tool
+    from ompi_tpu.runtime import spc, trace
+
+    spc.init()
+    trace.init()
+    trace.hist_record("allreduce", 4096, 1_500_000)   # 4k bin, 1.5ms
+    tool.init_thread()
+    try:
+        n = tool.pvar_get_num()
+        names = {tool.pvar_get_info(i).name: i for i in range(n)}
+        # every declared SPC counter is discoverable
+        for counter in spc._COUNTERS:
+            assert f"otpu_runtime_spc_{counter}" in names, counter
+        # the tracer's own pvar and the touched histogram cell's family
+        assert "otpu_trace_events_recorded" in names
+        for suffix in ("count", "sum_us", "p50_us", "p99_us"):
+            assert f"otpu_trace_hist_allreduce_4k_{suffix}" in names, \
+                suffix
+        # ...and every one of them is readable through a session handle
+        session = tool.pvar_session_create()
+        for pname, idx in names.items():
+            if not (pname.startswith("otpu_runtime_spc_")
+                    or pname.startswith("otpu_trace_")):
+                continue
+            h = session.handle_alloc(idx)
+            h.start()
+            val = h.read()
+            assert isinstance(val, (int, float)), pname
+            h.stop()
+            session.handle_free(h)
+        # the percentile pvars derive from the live population
+        p50 = tool.pvar_get_info(
+            names["otpu_trace_hist_allreduce_4k_p50_us"]).read()
+        assert p50 > 0, "percentile pvar read 0 after a recorded cell"
+        tool.pvar_session_free(session)
+    finally:
+        tool.finalize()

@@ -58,7 +58,7 @@ def test_spec_errors_are_loud():
     with pytest.raises(chaos.ChaosSpecError):
         chaos.parse_spec("explode:p=1")          # unknown fault
     with pytest.raises(chaos.ChaosSpecError):
-        chaos.parse_spec("drop:rank=2")          # param not allowed
+        chaos.parse_spec("drop:ms=2")            # param not allowed
     with pytest.raises(chaos.ChaosSpecError):
         chaos.parse_spec("delay:ms=abc")         # unparsable value
     with pytest.raises(chaos.ChaosSpecError):
@@ -145,6 +145,31 @@ def test_kill_point_count_and_step(monkeypatch):
     chaos.install_spec("kill:rank=3,step=1", rank=0)
     chaos.kill_point("step", n=1)
     assert not killed
+
+
+def test_rank_scoped_wire_and_pace_rules():
+    """A fault carrying ``rank=`` arms only on that rank (the
+    designed-straggler scoping otpu_analyze's acceptance run uses);
+    a ``delay`` carrying ``site=`` moves off the wire onto the named
+    chaos.pace point."""
+    # rank-scoped wire rule: fires on its rank only
+    chaos.install_spec("delay:ms=1,p=1,rank=2", rank=2)
+    assert chaos.wire_send("tcp", False)["fault"] == "delay"
+    chaos.install_spec("delay:ms=1,p=1,rank=2", rank=0)
+    assert chaos.wire_send("tcp", False) is None
+    # site-scoped delay: never on the wire, fires at its pace point
+    chaos.install_spec("delay:ms=1,p=1,rank=0,site=step", rank=0)
+    assert chaos.wire_send("tcp", False) is None
+    t0 = __import__("time").perf_counter()
+    chaos.pace("step")
+    assert __import__("time").perf_counter() - t0 >= 0.8e-3
+    chaos.pace("other_site")                     # wrong site: no sleep
+    # spec round-trips with the new params
+    rules = chaos.parse_spec("delay:ms=8,p=1,rank=2,site=step")
+    assert chaos.parse_spec(chaos.format_spec(rules)) == rules
+    # the fault log recorded the pace injection (flight-recorder tail)
+    assert any(f == "delay" and s == "pace:step"
+               for _t, f, s in chaos.event_log())
 
 
 def test_chaos_off_hooks_are_inert():

@@ -95,6 +95,12 @@ def test_monitoring_p2p_matrix_and_coll_counters(tmp_path):
                 extra=("--mca", "monitoring_enable", "1"))
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("monitoring OK") == 2
+    # the otpu-top satellite: each rank publishes its matrices into the
+    # coord KV at finalize and tpurun prints ONE job-wide matrix — both
+    # directions summed into the same table, coll totals across ranks
+    assert "job-wide p2p matrix" in r.stderr, r.stderr
+    assert "0 -> 1:" in r.stderr and "1 -> 0:" in r.stderr, r.stderr
+    assert "coll allreduce: 2 calls" in r.stderr, r.stderr
 
 
 def test_monitoring_disabled_by_default(tmp_path):
@@ -111,6 +117,27 @@ def test_monitoring_disabled_by_default(tmp_path):
     r = _tpurun(2, [sys.executable, str(script)])
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("nomon OK") == 2
+
+
+def test_info_telemetry_lists_schema_and_vars():
+    """--telemetry enumerates the declared sample schema, the sampler
+    vars, and the flight-recorder settings (registry-enumerated, also
+    under --all/--parsable)."""
+    from ompi_tpu.runtime import telemetry
+
+    r = _run_info("--telemetry")
+    assert r.returncode == 0, r.stderr
+    for key in telemetry.SCHEMA:
+        assert f"telemetry key {key}:" in r.stdout, key
+    for var in ("otpu_telemetry_interval_ms", "otpu_telemetry_jitter",
+                "otpu_flight_enable", "otpu_flight_dir",
+                "otpu_flight_events"):
+        assert var in r.stdout, var
+    # under --all and --parsable too
+    r_all = _run_info("--all", "--parsable")
+    assert r_all.returncode == 0
+    assert "telemetry key spc:" in r_all.stdout
+    assert "telemetry var otpu_flight_dir:" in r_all.stdout
 
 
 def test_topo_explicit_only():

@@ -94,14 +94,18 @@ def test_hot_path_budget():
 
 def test_observability_contracts():
     bad = run_pass("observability", FIXTURES / "obs" / "bad.py",
-                   FIXTURES / "obs" / "spc.py")
-    assert len(bad) == 3, bad
+                   FIXTURES / "obs" / "spc.py",
+                   FIXTURES / "obs" / "telemetry.py")
+    assert len(bad) == 5, bad
     msgs = " | ".join(f.message for f in bad)
     assert "no matching register_help" in msgs
     assert "not declared in runtime/spc.py" in msgs
     assert "never consumed" in msgs
+    assert "not a key of runtime/telemetry.py SCHEMA" in msgs
+    assert "no registered help-flight template" in msgs
     assert not run_pass("observability", FIXTURES / "obs" / "good.py",
-                        FIXTURES / "obs" / "spc.py")
+                        FIXTURES / "obs" / "spc.py",
+                        FIXTURES / "obs" / "telemetry.py")
 
 
 def test_mca_conformance():
