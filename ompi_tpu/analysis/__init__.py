@@ -321,6 +321,16 @@ class LintResult:
     passes: int = 0
     pass_names: list = field(default_factory=list)
     linted_paths: list = field(default_factory=list)   # slash-normalized
+    timings: list = field(default_factory=list)        # (pass, seconds)
+
+    def format_timings(self) -> str:
+        """Per-pass wall-clock breakdown (the CI gate prints this when
+        the run blows its budget, so the slow pass names itself)."""
+        total = sum(t for _n, t in self.timings)
+        rows = [f"  {n + ':':<22} {t * 1e3:8.1f} ms"
+                for n, t in sorted(self.timings,
+                                   key=lambda x: -x[1])]
+        return "\n".join(rows + [f"  {'total:':<22} {total * 1e3:8.1f} ms"])
 
     @property
     def clean(self) -> bool:
@@ -347,9 +357,14 @@ def lint(paths, select=None, suppressions: Optional[Suppressions] = None,
         if unknown:
             raise KeyError(f"unknown pass(es): {', '.join(sorted(unknown))}")
         passes = [p for p in passes if p.name in want]
+    import time
+
     findings: list[Finding] = []
+    timings: list[tuple] = []
     for p in passes:
+        t0 = time.monotonic()
         findings.extend(p.run(pkg))
+        timings.append((p.name, time.monotonic() - t0))
     findings = sorted(set(findings),
                       key=lambda f: (f.path, f.line, f.col, f.rule))
     kept, shed = [], []
@@ -360,4 +375,5 @@ def lint(paths, select=None, suppressions: Optional[Suppressions] = None,
         kept, shed, list(pkg.errors),
         files=len(pkg.modules), passes=len(passes),
         pass_names=[p.name for p in passes],
-        linted_paths=[m.path.replace(os.sep, "/") for m in pkg.modules])
+        linted_paths=[m.path.replace(os.sep, "/") for m in pkg.modules],
+        timings=timings)

@@ -6,4 +6,7 @@ from ompi_tpu.analysis.passes import (  # noqa: F401
     hot_path,
     observability,
     mca_conformance,
+    view_escape,
+    typestate,
+    coll_match,
 )

@@ -24,6 +24,29 @@ class RequestState(enum.Enum):
     CANCELLED = "cancelled"
 
 
+#: otpu-verify contract — the request lifecycle automaton, machine-read
+#: by the ``mpi-typestate`` static pass (``analysis/passes/typestate.py``
+#: loads this dict from the AST; keep every value a literal).  Persistent
+#: requests cycle inactive -> start -> active -> wait/test -> inactive and
+#: end with free; Pready marks partitions on an ACTIVE partitioned SEND
+#: request only; Parrived is observable on the receive side only.
+_TYPESTATE = {
+    "create_inactive": ["send_init", "recv_init", "psend_init",
+                        "precv_init", "pallreduce_init"],
+    "create_active": ["isend", "irecv"],
+    "send_side": ["send_init", "psend_init", "isend", "pallreduce_init"],
+    "partitioned": ["psend_init", "precv_init", "pallreduce_init"],
+    "start": ["start"],
+    "start_many": ["start_all", "startall"],
+    "complete": ["wait", "test", "get_status", "on_complete"],
+    "complete_many": ["waitall", "waitany", "waitsome", "testall",
+                      "testany", "testsome"],
+    "free": ["free"],
+    "pready": ["pready", "pready_range", "pready_list"],
+    "parrived": ["parrived", "parrived_range"],
+}
+
+
 def _progress() -> int:
     from ompi_tpu.runtime.progress import progress
 

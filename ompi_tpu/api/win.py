@@ -23,6 +23,21 @@ from ompi_tpu.api.group import Group
 from ompi_tpu.runtime import trace
 
 
+#: otpu-verify contract — the RMA epoch automaton, machine-read by the
+#: ``mpi-typestate`` static pass (loaded from the AST; keep every value
+#: a literal).  lock/lock_all open a passive-target epoch that must close
+#: with unlock/unlock_all; flush only orders operations inside one; PSCW
+#: pairs start/complete on the origin and post/wait on the target.
+_TYPESTATE = {
+    "create": ["Win.create", "Win.allocate", "Win.allocate_shared",
+               "Win.create_dynamic"],
+    "passive_open": ["lock", "lock_all"],
+    "passive_close": ["unlock", "unlock_all"],
+    "pscw": {"start": "complete", "post": "wait"},
+    "in_passive": ["flush", "flush_all"],
+}
+
+
 class Win(AttributeHost):
     LOCK_EXCLUSIVE = "exclusive"
     LOCK_SHARED = "shared"

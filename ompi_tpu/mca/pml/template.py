@@ -106,7 +106,11 @@ class TemplatePml:
         return _ImmediateRequest()
 
     def send(self, comm, buf, dest: int, tag: int) -> None:
-        self.isend(comm, buf, dest, tag)
+        # MPI_Send IS isend + wait — the skeleton must model the
+        # completion contract too, or a pml grown from it returns
+        # before the data is safe and drops the request's error
+        # (otpu-verify mpi-typestate: discarded-request finding)
+        self.isend(comm, buf, dest, tag).wait()
 
     # 3. receiving + THE MATCHING RULE: first queued frag whose
     #    (source, tag) matches, wildcards allowed, arrival order

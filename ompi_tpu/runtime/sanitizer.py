@@ -20,6 +20,15 @@ environment; every check site is on a cold/error path or behind an
 decorator/hook structure compiles out to no-ops when off — pinned by
 ``test_perf_guard.test_sanitizer_off_zero_overhead``.  Tests may flip
 ``sanitizer.enabled`` directly (consumers read it at use time).
+
+The weave interleaving explorer (``analysis/weave.py``) arms this flag
+for the duration of every scheduled run, so these assertions double as
+the failure oracles of the schedule search: a race that slips past a
+guard (the reverted-fix scenarios) fails the run's invariant check,
+while a schedule where the guard correctly catches a deliberate
+mis-use raises ``SanitizeError`` the scenario swallows.  Outside a run
+weave touches nothing here — same identity-off contract, pinned by
+``test_perf_guard.test_weave_off_zero_overhead``.
 """
 from __future__ import annotations
 

@@ -44,6 +44,9 @@ def main(argv=None) -> int:
                          "comment) and exit 0")
     ap.add_argument("--parsable", action="store_true",
                     help="Machine-readable colon-separated output")
+    ap.add_argument("--timings", action="store_true",
+                    help="Print the per-pass wall-clock breakdown "
+                         "(the CI gate's budget diagnostics)")
     args = ap.parse_args(argv)
 
     from ompi_tpu import analysis
@@ -99,6 +102,11 @@ def main(argv=None) -> int:
               f"'{e.rule} {e.path}{':' + e.symbol if e.symbol else ''}' "
               "— the finding is gone, remove the entry")
         failures += 1
+    if args.timings:
+        # stderr under --parsable: the human-format rows must not
+        # corrupt the machine-readable findings stream
+        print(result.format_timings(),
+              file=sys.stderr if args.parsable else sys.stdout)
     if not args.parsable:
         print(f"otpu-lint: {len(result.findings)} finding(s), "
               f"{len(result.suppressed)} suppressed, "

@@ -1,0 +1,55 @@
+"""collective-matching bad fixture: the classic MPI deadlock shapes."""
+import numpy as np
+
+
+def one_armed_bcast(comm, data):
+    if comm.rank == 0:
+        comm.bcast(data, root=0)
+    return data
+
+
+def mismatched_arms(comm, data):
+    if comm.rank == 0:
+        comm.allreduce(data)
+    else:
+        comm.barrier()
+
+
+def early_return_skips(comm, data):
+    rank = comm.rank
+    if rank != 0:
+        return None
+    return comm.gather(data, root=0)
+
+
+def unresolved_rank_is_conservative(rank, comm, data):
+    # `rank` is a parameter the pass cannot tie to a comm: every
+    # identity must match, and this one does not
+    if rank == 0:
+        comm.bcast(data, root=0)
+
+
+def nested_early_return(comm, data, flag):
+    if flag:
+        if comm.rank != 0:
+            return None
+    out = comm.allgather(data)
+    return out
+
+
+def count_mismatch(comm, sizes, data):
+    if comm.rank == 0:
+        comm.bcast(sizes, root=0)
+        comm.bcast(data, root=0)
+        return data
+    return comm.bcast(np.empty(1), root=0)
+
+
+def mismatched_elif_ladder(comm, data):
+    # the ladder is flattened: every arm must carry the same multiset
+    if comm.rank == 0:
+        comm.barrier()
+    elif comm.rank == 1:
+        comm.bcast(data, root=0)
+    else:
+        comm.bcast(data, root=0)

@@ -1,0 +1,78 @@
+"""collective-matching good twin: legal rank-conditional shapes."""
+import functools
+
+import numpy as np
+
+
+def matched_arms(comm, data):
+    if comm.rank == 0:
+        return comm.bcast(data, root=0)
+    return comm.bcast(np.empty_like(data), root=0)
+
+
+def matched_else(comm, data):
+    if comm.rank == 0:
+        out = comm.gather(data, root=0)
+    else:
+        out = comm.gather(data, root=0)
+    return out
+
+
+def early_return_matched(comm, sizes, data):
+    # root bcasts twice then returns; the continuation bcasts twice too
+    if comm.rank == 0:
+        comm.bcast(sizes, root=0)
+        comm.bcast(data, root=0)
+        return data
+    hdr = comm.bcast(np.empty(1), root=0)
+    return comm.bcast(np.empty(int(hdr[0])), root=0)
+
+
+def subcomm_is_membership_scoped(low, leaders, data):
+    # the hierarchical shape: `leaders` only EXISTS on low.rank==0
+    # ranks, so its collectives have no matching obligation
+    red = low.reduce(data, root=0)
+    if low.rank == 0:
+        red = leaders.allreduce(red)
+        return low.bcast(red, root=0)
+    return low.bcast(np.empty_like(data), root=0)
+
+
+def raising_arm_is_exempt(comm, data):
+    if comm.rank == 0:
+        raise ValueError("root cannot participate")
+    return comm.barrier()
+
+
+def module_style_provider(basic, comm, data):
+    # provider-object collectives match on the comm ARGUMENT
+    if comm.rank == 0:
+        return basic.bcast(comm, data, 0)
+    return basic.bcast(comm, np.empty_like(data), 0)
+
+
+def numerics_are_not_collectives(rank, values):
+    if rank == 0:
+        return functools.reduce(lambda a, b: a + b, values)
+    return np.add.reduce(values)
+
+
+def rank_alias_resolves(comm, leaders, data):
+    rank = comm.rank
+    if rank == 0:
+        leaders.barrier()
+    return comm.barrier()
+
+
+def symmetric_elif_ladder(comm, data):
+    # a rank-role dispatch ladder where EVERY rank calls the same
+    # collective exactly once is legal — arms are compared pairwise,
+    # not one-vs-the-rest-of-the-chain
+    rank = comm.rank
+    if rank == 0:
+        out = comm.bcast(data, root=0)
+    elif rank == 1:
+        out = comm.bcast(None, root=0)
+    else:
+        out = comm.bcast(None, root=0)
+    return out

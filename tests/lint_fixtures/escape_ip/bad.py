@@ -1,0 +1,61 @@
+"""view-escape bad fixture: every interprocedural escape family."""
+
+
+class Wire:
+    def __init__(self, conv, ring):
+        self.conv = conv
+        self.ring = ring
+        self.stash = None
+        self.queue = []
+
+    # 1. helper returns a borrowed view straight from the producer —
+    #    no name is ever bound, so the intraprocedural pass is blind
+    def head(self, buf):
+        return self.conv.pack_borrow(buf, 0, 64)
+
+    # 2. the caller treats the helper's result as owned and stores it
+    def remember(self, buf):
+        data = self.head(buf)
+        self.stash = data
+
+    # 3. ... or returns it onward
+    def relay(self, buf):
+        data = self.head(buf)
+        return data
+
+    # 4. a parameter that escapes: stored on self
+    def keep(self, payload):
+        self.queue.append(payload)
+
+    # 5. borrowed view passed to the escaping parameter
+    def send(self, buf):
+        data, _ = self.conv.pack_borrow(buf, 0, 64)
+        self.keep(data)
+
+    # 6. borrowed view captured by a deferred callback
+    def notify(self, req, buf):
+        data, _ = self.conv.pack_borrow(buf, 0, 64)
+        req.on_complete(lambda r: self.queue.append(data))
+
+    # 7. MULTI-HOP: borrowedness propagates through TWO helper layers —
+    #    head2's summary depends on head's, so whichever is summarized
+    #    first must be revisited when the other's summary lands (the
+    #    worklist fixpoint, not a single sweep)
+    def head2(self, buf):
+        data = self.head(buf)
+        return data
+
+    def remember2(self, buf):
+        data = self.head2(buf)
+        self.stash = data
+
+
+def fill_scratch(pool, n):
+    buf = pool.staging_acquire(n, "u1")
+    return buf
+
+
+def leak_through_helper(pool, n):
+    # 7. helper-acquired staging checkout never released
+    buf = fill_scratch(pool, n)
+    buf[0] = 1

@@ -1,0 +1,52 @@
+"""view-escape good twin: the same shapes, contracts honored."""
+
+
+class Wire:
+    def __init__(self, conv, ring):
+        self.conv = conv
+        self.ring = ring
+        self.stash = None
+        self.queue = []
+
+    # owning copy before the return: the helper's result is owned
+    def head(self, buf):
+        return bytes(self.conv.pack_borrow(buf, 0, 64)[0])
+
+    def remember(self, buf):
+        data = self.head(buf)
+        self.stash = data                  # owned: fine to store
+
+    def relay(self, buf):
+        data = self.head(buf)
+        return data                        # owned: fine to return
+
+    # parameter does NOT escape: consumed synchronously
+    def consume(self, payload):
+        return len(payload)
+
+    def send(self, buf):
+        data, _ = self.conv.pack_borrow(buf, 0, 64)
+        self.consume(data)                 # callee keeps the contract
+
+    def notify(self, req, buf):
+        data, _ = self.conv.pack_borrow(buf, 0, 64)
+        owned = bytes(data)
+        req.on_complete(lambda r: self.queue.append(owned))
+
+    # synchronous lambda consumers are not deferred escapes
+    def pick(self, buf):
+        data, _ = self.conv.pack_borrow(buf, 0, 64)
+        return max(range(4), key=lambda i: data[i])
+
+
+def fill_scratch(pool, n):
+    buf = pool.staging_acquire(n, "u1")
+    return buf
+
+
+def use_scratch(pool, n):
+    buf = fill_scratch(pool, n)
+    try:
+        buf[0] = 1
+    finally:
+        pool.staging_release(buf)

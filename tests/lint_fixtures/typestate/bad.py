@@ -1,0 +1,112 @@
+"""mpi-typestate bad fixture: one violation per automaton family."""
+import threading
+
+from somepkg import Win, instance
+
+
+def leak_started(comm, buf):
+    # persistent request started, never waited/tested/freed, no escape
+    req = comm.send_init(buf, dest=1, tag=7)
+    req.start()
+
+
+def double_free(comm, buf):
+    req = comm.recv_init(buf, source=0, tag=7)
+    req.start()
+    req.wait()
+    req.free()
+    req.free()
+
+
+def use_after_free(comm, buf):
+    req = comm.send_init(buf, dest=1, tag=7)
+    req.free()
+    req.start()
+
+
+def double_start(comm, buf):
+    req = comm.send_init(buf, dest=1, tag=7)
+    req.start()
+    req.start()
+    req.wait()
+    req.free()
+
+
+def pready_on_recv(comm, buf):
+    req = comm.precv_init(buf, 4, source=0, tag=7)
+    req.start()
+    req.pready(0)
+    req.wait()
+    req.free()
+
+
+def pready_before_start(comm, buf):
+    req = comm.psend_init(buf, 4, dest=1, tag=7)
+    req.pready(0)
+    req.start()
+    req.wait()
+    req.free()
+
+
+def parrived_on_send(comm, buf):
+    req = comm.psend_init(buf, 4, dest=1, tag=7)
+    req.start()
+    req.pready_range(0, 3)
+    if req.parrived(0):
+        pass
+    req.wait()
+    req.free()
+
+
+def dropped_isend(comm, buf):
+    # nonblocking request ignored: completion and errors vanish
+    req = comm.isend(buf, dest=1, tag=7)
+    buf[0] = 0
+
+
+def unlock_without_lock(comm, data):
+    win = Win.create(comm, base=data)
+    win.unlock(1)
+
+
+def epoch_left_open(comm, data):
+    win = Win.create(comm, base=data)
+    win.lock(1)
+    win.put(data, 1)
+
+
+def flush_outside_epoch(comm, data):
+    win = Win.create(comm, base=data)
+    win.put(data, 1)
+    win.flush(1)
+
+
+def pscw_unclosed(comm, data, group):
+    win = Win.create(comm, base=data)
+    win.start(group)
+    win.put(data, 1)
+
+
+def acquire_without_release(argv):
+    inst = instance.acquire(argv)
+    return 1
+
+
+class Pool:
+    _guarded_by = {"_free": "_lock", "_out": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = []
+        self._out = {}
+
+    def handoff_window(self, key):
+        # popped under the lock, re-registered in a LATER critical
+        # section: the object is observable as neither free nor
+        # checked out in between (the staging checkout-outside-lock
+        # family)
+        with self._lock:
+            raw = self._free.pop()
+        with self._lock:
+            self._out[key] = raw
+        return raw

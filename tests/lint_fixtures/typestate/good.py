@@ -1,0 +1,130 @@
+"""mpi-typestate good twin: the same lifecycles, protocols honored."""
+import threading
+
+from somepkg import Win, instance
+
+
+def persistent_round_trips(comm, buf):
+    req = comm.send_init(buf, dest=1, tag=7)
+    for _ in range(4):
+        req.start()
+        req.wait()
+    req.free()
+
+
+def partitioned_send(comm, buf):
+    req = comm.psend_init(buf, 4, dest=1, tag=7)
+    req.start()
+    req.pready(0)
+    req.pready_range(1, 3)
+    req.wait()
+    req.free()
+
+
+def partitioned_recv(comm, buf):
+    req = comm.precv_init(buf, 4, source=0, tag=7)
+    req.start()
+    while not req.parrived_range(0, 3):
+        pass
+    req.wait()
+    req.free()
+
+
+def isend_waited(comm, buf):
+    req = comm.isend(buf, dest=1, tag=7)
+    req.wait()
+
+
+def isend_escapes(comm, buf, registry):
+    # handing the request out transfers the completion obligation
+    req = comm.isend(buf, dest=1, tag=7)
+    registry.append(req)
+
+
+def startall_waitall(comm, buf, waitall, start_all):
+    a = comm.send_init(buf, dest=1, tag=7)
+    b = comm.recv_init(buf, source=1, tag=7)
+    start_all([a, b])
+    waitall([a, b])
+    a.free()
+    b.free()
+
+
+def keyword_wait_and_escape(comm, buf, waitall, registry):
+    # keyword arguments count: waitall(requests=[r]) observes
+    # completion, registry.register(req=r) is an escape
+    r = comm.irecv(buf, source=1, tag=7)
+    waitall(requests=[r])
+    s = comm.isend(buf, dest=1, tag=7)
+    registry.register(req=s)
+
+
+def branch_arms_are_not_sequenced(comm, buf, flag):
+    req = comm.send_init(buf, dest=1, tag=7)
+    req.start()
+    req.wait()
+    if flag:
+        req.free()
+    else:
+        req.free()
+
+
+def passive_epoch(comm, data):
+    win = Win.create(comm, base=data)
+    win.lock(1)
+    win.put(data, 1)
+    win.flush(1)
+    win.unlock(1)
+
+
+def lock_all_epoch(comm, data):
+    win, buf = Win.allocate(comm, 16)
+    win.lock_all()
+    win.put(buf, 1)
+    win.unlock_all()
+
+
+def fence_epochs(comm, data):
+    win = Win.create(comm, base=data)
+    win.fence()
+    win.put(data, 1)
+    win.fence()
+
+
+def pscw_paired(comm, data, group):
+    win = Win.create(comm, base=data)
+    win.start(group)
+    win.put(data, 1)
+    win.complete()
+    win.post(group)
+    win.wait()
+
+
+def acquire_release_paired(argv):
+    inst = instance.acquire(argv)
+    try:
+        return inst.pset_names()
+    finally:
+        instance.release()
+
+
+def acquire_escapes(argv, holder):
+    inst = instance.acquire(argv)
+    holder.inst = inst
+
+
+class Pool:
+    _guarded_by = {"_free": "_lock", "_out": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = []
+        self._out = {}
+
+    def handoff_atomic(self, key):
+        # pop and re-register inside ONE critical section: never
+        # observable as neither free nor checked out
+        with self._lock:
+            raw = self._free.pop()
+            self._out[key] = raw
+        return raw

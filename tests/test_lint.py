@@ -180,10 +180,11 @@ def test_partial_runs_do_not_flag_out_of_scope_suppressions():
 
 # -- framework plumbing ------------------------------------------------
 
-def test_registry_has_all_five_passes():
+def test_registry_has_all_eight_passes():
     names = [p.name for p in analysis.all_passes()]
     assert names == ["buffer-ownership", "lock-discipline", "hot-path",
-                     "observability", "mca-conformance"]
+                     "observability", "mca-conformance", "view-escape",
+                     "mpi-typestate", "collective-matching"]
     assert all(p.description for p in analysis.all_passes())
 
 
@@ -212,7 +213,8 @@ def test_cli_list_and_exit_codes(tmp_path):
         capture_output=True, text=True, cwd=REPO, timeout=120)
     assert r.returncode == 0, r.stderr
     for name in ("buffer-ownership", "lock-discipline", "hot-path",
-                 "observability", "mca-conformance"):
+                 "observability", "mca-conformance", "view-escape",
+                 "mpi-typestate", "collective-matching"):
         assert name in r.stdout
     # findings -> exit 1; baseline generated via --write-suppressions
     # then fed back -> exit 0
@@ -242,7 +244,8 @@ def test_otpu_info_lists_lint_passes(capsys):
     assert otpu_info.main(["--lint"]) == 0
     out = capsys.readouterr().out
     for name in ("buffer-ownership", "lock-discipline", "hot-path",
-                 "observability", "mca-conformance"):
+                 "observability", "mca-conformance", "view-escape",
+                 "mpi-typestate", "collective-matching"):
         assert f"lint pass {name}" in out
     assert otpu_info.main(["--all", "--parsable"]) == 0
     out = capsys.readouterr().out

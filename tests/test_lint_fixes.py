@@ -281,6 +281,53 @@ def test_coord_fence_replies_sent_outside_fence_cond(monkeypatch):
         "client would stall every fence job-wide")
 
 
+# -- PR 11 (otpu-verify): template pml send dropped its request --------
+
+def test_template_pml_send_waits_its_isend():
+    """The mpi-typestate discarded-request finding in
+    `mca/pml/template.py`: `send()` issued an isend and THREW AWAY the
+    request — MPI_Send is isend + wait, and a pml grown from the
+    skeleton would return before completion and silently drop any error
+    the request carried.  Pinned both dynamically (the returned
+    request's wait() must run, and its error must surface) and
+    statically (no discarded-request finding anywhere in mca/pml)."""
+    from ompi_tpu.api.errors import ErrorClass, MpiError
+    from ompi_tpu.mca.pml.template import TemplatePml
+
+    class _Probe:
+        waited = 0
+
+        def wait(self):
+            _Probe.waited += 1
+
+    class _ProbedPml(TemplatePml):
+        def isend(self, comm, buf, dest, tag, mode="standard"):
+            return _Probe()
+
+    _ProbedPml.__new__(_ProbedPml).send(None, b"x", 0, 0)
+    assert _Probe.waited == 1, "send() must wait its isend request"
+
+    class _FailProbe:
+        def wait(self):
+            raise MpiError(ErrorClass.ERR_OTHER, "wire died")
+
+    class _FailingPml(TemplatePml):
+        def isend(self, comm, buf, dest, tag, mode="standard"):
+            return _FailProbe()
+
+    with pytest.raises(MpiError):
+        _FailingPml.__new__(_FailingPml).send(None, b"x", 0, 0)
+
+    from ompi_tpu import analysis
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    res = analysis.lint([str(repo / "ompi_tpu" / "mca" / "pml")],
+                        select=["mpi-typestate"])
+    discarded = [f for f in res.findings if "discarded" in f.message]
+    assert not discarded, [f.format() for f in discarded]
+
+
 # -- OTPU_SANITIZE runtime mode ----------------------------------------
 
 @pytest.fixture

@@ -200,6 +200,35 @@ def test_sanitizer_off_zero_overhead():
         assert any(q.endswith(qual) for q in regs), qual
 
 
+def test_weave_off_zero_overhead():
+    """With no weave run active (the production state — OTPU_SANITIZE
+    off, no explorer), the interleaving instrumentation must cost the
+    lock layer NOTHING: no run object exists, instrument() returns its
+    argument with every _guarded_by lock attribute untouched (a plain
+    threading primitive — no wrapper on Lock acquire), make_lock hands
+    back a plain RLock, and pause/signal are immediate returns."""
+    import threading
+
+    from ompi_tpu.analysis import weave
+    from ompi_tpu.mca.accelerator.jax_acc import _StagingPool
+    from ompi_tpu.runtime import sanitizer
+
+    assert sanitizer.enabled is False
+    assert weave.active() is None
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    lock_before = pool._lock
+    assert weave.instrument(pool) is pool
+    assert pool._lock is lock_before
+    # the plain runtime lock type, not a WeaveLock wrapper: acquire is
+    # the raw C primitive
+    assert isinstance(pool._lock, type(threading.RLock()))
+    assert not isinstance(pool._lock, weave.WeaveLock)
+    assert isinstance(weave.make_lock("x"), type(threading.RLock()))
+    weave.pause("never")             # no-ops, no run to yield into
+    weave.signal("never")
+    assert weave.active() is None
+
+
 def test_chaos_disabled_zero_overhead():
     """An empty otpu_chaos_spec must cost the wire NOTHING: chaos is a
     module bool the hot paths read in one cold branch (the

@@ -1,0 +1,475 @@
+"""otpu-verify tests: the interprocedural passes (view-escape,
+mpi-typestate, collective-matching) fire on their bad fixtures and stay
+quiet on the good twins, the call graph resolves the shapes the passes
+lean on, and the weave interleaving explorer re-finds each reverted
+PR 6 race deterministically — replaying from its printed schedule
+string — while the fixed twins exhaust their bounded schedule space
+clean."""
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from ompi_tpu import analysis
+from ompi_tpu.analysis import weave
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def run_pass(name, *paths):
+    res = analysis.lint([str(p) for p in paths], select=[name])
+    assert not res.errors, res.errors
+    return res.findings
+
+
+# ---------------------------------------------------------------------------
+# the three new passes on their fixture twins
+# ---------------------------------------------------------------------------
+
+def test_view_escape_interprocedural_families():
+    bad = run_pass("view-escape", FIXTURES / "escape_ip" / "bad.py")
+    msgs = " | ".join(f.message for f in bad)
+    assert "returns a borrowed view straight from 'pack_borrow()'" in msgs
+    assert "is stored on 'self' without an owning copy" in msgs
+    assert "is returned without an owning copy" in msgs
+    assert "whose parameter 'payload' escapes" in msgs
+    assert "captured by deferred callback" in msgs
+    assert "acquired through fill_scratch()" in msgs
+    # the multi-hop chain (remember2 -> head2 -> head -> pack_borrow)
+    # needs the worklist fixpoint to actually propagate
+    assert "'data' (from Wire.head2())" in msgs
+    assert len(bad) == 8, bad
+    assert not run_pass("view-escape", FIXTURES / "escape_ip" / "good.py")
+
+
+def test_typestate_request_lifecycle():
+    bad = run_pass("mpi-typestate", FIXTURES / "typestate" / "bad.py")
+    msgs = " | ".join(f.message for f in bad)
+    for what in ("started but never waited", "freed twice",
+                 "used after free()", "started twice",
+                 "Pready is send-side only",
+                 "pready() on inactive request",
+                 "observable on the receive side only",
+                 "never waited/tested in this function"):
+        assert what in msgs, (what, msgs)
+    assert not run_pass("mpi-typestate", FIXTURES / "typestate" / "good.py")
+
+
+def test_typestate_win_epochs_and_refcounts():
+    bad = run_pass("mpi-typestate", FIXTURES / "typestate" / "bad.py")
+    msgs = " | ".join(f.message for f in bad)
+    for what in ("closes a passive-target epoch that was never opened",
+                 "opened here but never closed",
+                 "outside a passive-target epoch",
+                 "PSCW 'win.start()' epoch is never closed",
+                 "no paired 'instance.release'",
+                 "guarded handoff"):
+        assert what in msgs, (what, msgs)
+    assert len(bad) == 14, bad
+
+
+def test_typestate_annotation_overrides_defaults(tmp_path):
+    """The automaton is DECLARED in the api module (_TYPESTATE) and the
+    pass consumes the declaration, not a hardcoded list: a tree whose
+    request.py renames the nonblocking creator is checked against the
+    renamed automaton."""
+    (tmp_path / "request.py").write_text(
+        '_TYPESTATE = {"create_active": ["fire"]}\n')
+    (tmp_path / "use.py").write_text(
+        "def f(comm, buf):\n"
+        "    comm.fire(buf)\n")
+    bad = run_pass("mpi-typestate", tmp_path)
+    assert len(bad) == 1, bad
+    assert "'fire()' request is discarded" in bad[0].message
+    # without the annotation, 'fire' means nothing
+    (tmp_path / "request.py").write_text("_X = 1\n")
+    assert not run_pass("mpi-typestate", tmp_path)
+
+
+def test_collective_matching_deadlock_shapes():
+    bad = run_pass("collective-matching", FIXTURES / "coll_match" / "bad.py")
+    msgs = " | ".join(f.message for f in bad)
+    assert "only some arms of a rank-conditional branch" in msgs
+    assert "skipped by the rank-conditional return" in msgs
+    symbols = {f.symbol for f in bad}
+    for sym in ("one_armed_bcast", "mismatched_arms", "early_return_skips",
+                "unresolved_rank_is_conservative", "nested_early_return",
+                "count_mismatch", "mismatched_elif_ladder"):
+        assert sym in symbols, (sym, symbols)
+    assert len(bad) == 10, bad
+    assert not run_pass("collective-matching",
+                        FIXTURES / "coll_match" / "good.py")
+
+
+def test_callgraph_survives_circular_reexports(tmp_path):
+    """A circular from-import (compat-shim shape) must be unresolvable,
+    not a RecursionError that takes down the whole lint run."""
+    (tmp_path / "a.py").write_text(
+        "from b import helper\n\n"
+        "def use(x):\n"
+        "    return helper(x)\n")
+    (tmp_path / "b.py").write_text("from a import helper\n")
+    res = analysis.lint([str(tmp_path)],
+                        select=["view-escape", "mpi-typestate"])
+    assert not res.errors
+    assert not res.findings
+
+
+def test_callgraph_resolves_the_load_bearing_shapes():
+    from ompi_tpu.analysis import callgraph
+
+    pkg = analysis.load_package(
+        [str(REPO / "ompi_tpu" / "analysis"),
+         str(REPO / "ompi_tpu" / "mca" / "accelerator" / "jax_acc.py")])
+    graph = callgraph.build(pkg)
+    mod = pkg.find("analysis/scenarios.py")
+    assert mod is not None
+    info = graph.function_at(mod, "_RevertedCheckoutPool.acquire")
+    assert info is not None
+    import ast
+
+    calls = [n for n in ast.walk(info.node) if isinstance(n, ast.Call)]
+    resolved = {graph.resolve_call(info, c).qual
+                for c in calls if graph.resolve_call(info, c) is not None}
+    # self-method on the subclass AND an inherited method through the
+    # package-local base walk
+    assert "_RevertedCheckoutPool._checkout_window" in resolved
+    assert "_StagingPool._class_of" in resolved
+    # one shared graph per package object (every pass reuses it)
+    assert callgraph.build(pkg) is graph
+
+
+# ---------------------------------------------------------------------------
+# weave: the explorer itself
+# ---------------------------------------------------------------------------
+
+class _Box:
+    pass
+
+
+def _toy_scenario(bound=2):
+    def setup():
+        s = _Box()
+        s.counter = 0
+        return s
+
+    def bump(s):
+        v = s.counter
+        weave.pause("rmw")
+        s.counter = v + 1
+
+    def check(s):
+        assert s.counter == 2, f"lost update: {s.counter}"
+
+    return weave.Scenario("toy-rmw", setup, [bump, bump], check=check,
+                          preemption_bound=bound)
+
+
+def test_weave_finds_toy_race_and_replays_it():
+    sc = _toy_scenario()
+    res = weave.explore(sc)
+    assert res.failed and res.kind == "check"
+    assert res.schedule and res.schedule.startswith("toy-rmw@pb2:")
+    rep = weave.replay(sc, res.schedule)
+    assert rep.failed and rep.kind == "check"
+    assert rep.schedule == res.schedule
+
+
+def test_weave_exploration_is_deterministic():
+    sc = _toy_scenario()
+    a = weave.explore(sc)
+    b = weave.explore(sc)
+    assert (a.failed, a.schedule, a.schedules) \
+        == (b.failed, b.schedule, b.schedules)
+
+
+def test_weave_locked_twin_exhausts_clean():
+    def setup():
+        s = _Box()
+        s.counter = 0
+        s.lock = weave.make_lock("ctr")
+        return s
+
+    def bump(s):
+        with s.lock:
+            v = s.counter
+            weave.pause("rmw")
+            s.counter = v + 1
+
+    def check(s):
+        assert s.counter == 2
+
+    sc = weave.Scenario("toy-rmw-locked", setup, [bump, bump],
+                        check=check, preemption_bound=3)
+    res = weave.explore(sc)
+    assert not res.failed and res.exhausted
+    assert res.schedules > 1          # the space was actually explored
+
+
+def test_weave_detects_deadlock_with_description():
+    def setup():
+        s = _Box()
+        s.a = weave.make_lock("a")
+        s.b = weave.make_lock("b")
+        return s
+
+    def ab(s):
+        with s.a:
+            weave.pause("mid")
+            with s.b:
+                pass
+
+    def ba(s):
+        with s.b:
+            weave.pause("mid")
+            with s.a:
+                pass
+
+    sc = weave.Scenario("toy-deadlock", setup, [ab, ba],
+                        preemption_bound=1)
+    res = weave.explore(sc)
+    assert res.failed and res.kind == "deadlock"
+    assert "waiting-lock" in str(res.error)
+    rep = weave.replay(sc, res.schedule)
+    assert rep.failed and rep.kind == "deadlock"
+
+
+def test_weave_schedule_string_round_trip():
+    s = weave.format_schedule("staging-checkout", 2, [0, 0, 1, 1, 0])
+    assert s == "staging-checkout@pb2:0.0.1.1.0"
+    name, bound, choices = weave.parse_schedule(s)
+    assert (name, bound, choices) == ("staging-checkout", 2,
+                                      [0, 0, 1, 1, 0])
+    with pytest.raises(ValueError):
+        weave.parse_schedule("no-bound:0.1")
+
+
+def test_weave_replay_mismatch_is_loud():
+    sc = _toy_scenario()
+    res = weave.replay(sc, "toy-rmw@pb2:0.7.7.7")
+    assert res.failed and res.kind == "replay-mismatch"
+    with pytest.raises(ValueError):
+        weave.replay(sc, "other-scenario@pb2:0")
+
+
+def test_weave_try_acquire_declines_instead_of_blocking():
+    """acquire(blocking=False) on an instrumented lock keeps its
+    try-acquire semantics: the probe declines (returns False) when the
+    lock is held instead of silently becoming a blocking wait — so a
+    scenario over code like libnbc's `_adv_lock.acquire(blocking=False)`
+    neither deadlocks nor serializes a path the real code skips."""
+    def setup():
+        s = _Box()
+        s.lock = weave.make_lock("l")
+        s.probes = []
+        return s
+
+    def holder(s):
+        with s.lock:
+            weave.pause("held")
+            weave.pause("held2")
+
+    def prober(s):
+        got = s.lock.acquire(blocking=False)
+        s.probes.append(got)
+        if got:
+            s.lock.release()
+
+    sc = weave.Scenario("try-acquire", setup, [holder, prober],
+                        preemption_bound=2)
+    res = weave.explore(sc)
+    assert not res.failed, res.summary()   # a probe never deadlocks
+    assert res.exhausted
+
+
+def test_weave_teardown_leaves_no_threads_behind():
+    """Killed scenario threads — including ones HOLDING a WeaveLock at
+    deadlock time, whose with-block unwind re-enters the lock release —
+    must exit promptly instead of re-parking forever (the 5s-join-leak
+    regression)."""
+    import time
+
+    from ompi_tpu.analysis import scenarios
+
+    t0 = time.monotonic()
+    res = weave.explore(scenarios.get("coord-fence"))
+    elapsed = time.monotonic() - t0
+    assert res.failed and res.kind == "deadlock"
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("weave-")]
+    assert not leaked, leaked
+    assert elapsed < 4.0, f"teardown stalled: {elapsed:.2f}s"
+
+
+def test_weave_instrument_skips_condition_guards():
+    """A _guarded_by attribute backed by a Condition (the CoordServer
+    family) must be left untouched — WeaveLock has no wait()/notify(),
+    so clobbering it would crash the first wait mid-schedule.  Plain
+    mutex guards on the same object are still wrapped."""
+    class _CondGuarded:
+        _guarded_by = {"_kv": "_kv_cond", "_q": "_qlock"}
+
+        def __init__(self):
+            self._kv_cond = threading.Condition()
+            self._qlock = threading.Lock()
+
+    seen = {}
+
+    def setup():
+        obj = weave.instrument(_CondGuarded())
+        seen["cond"] = obj._kv_cond
+        seen["lock"] = obj._qlock
+        return obj
+
+    sc = weave.Scenario("cond-skip", setup, [lambda s: None],
+                        preemption_bound=0)
+    res = weave.explore(sc)
+    assert not res.failed
+    assert isinstance(seen["cond"], threading.Condition)   # untouched
+    assert isinstance(seen["lock"], weave.WeaveLock)       # wrapped
+
+
+def test_weave_timed_acquire_keeps_may_fail_contract():
+    """acquire(timeout=...) on a held instrumented lock declines (the
+    real code's timed-out fallback) instead of parking forever and
+    mis-reporting a deadlock."""
+    def setup():
+        s = _Box()
+        s.lock = weave.make_lock("l")
+        s.results = []
+        return s
+
+    def holder(s):
+        with s.lock:
+            weave.pause("held")
+
+    def timed(s):
+        got = s.lock.acquire(timeout=0.5)
+        s.results.append(got)
+        if got:
+            s.lock.release()
+
+    sc = weave.Scenario("timed-acquire", setup, [holder, timed],
+                        preemption_bound=2)
+    res = weave.explore(sc)
+    assert not res.failed, res.summary()
+    assert res.exhausted
+
+
+def test_weave_primitives_are_identity_outside_a_run():
+    assert weave.active() is None
+    weave.pause("nothing")            # immediate no-op
+    weave.signal("nothing")
+    lock = weave.make_lock("plain")
+    assert isinstance(lock, type(threading.RLock()))
+    from ompi_tpu.mca.accelerator.jax_acc import _StagingPool
+
+    pool = _StagingPool(max_bytes=1 << 20, enabled=True)
+    before = pool._lock
+    assert weave.instrument(pool) is pool
+    assert pool._lock is before       # untouched: no wrapper off-run
+
+
+# ---------------------------------------------------------------------------
+# the three PR 6 races, reverted: weave re-finds each deterministically
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,kind", [
+    ("staging-checkout", "check"),
+    ("tcp-conns", "exception"),
+    ("coord-fence", "deadlock"),
+])
+def test_reverted_pr6_race_refound_and_replayable(name, kind):
+    from ompi_tpu.analysis import scenarios
+
+    sc = scenarios.get(name)
+    res = weave.explore(sc)
+    assert res.failed, res.summary()
+    assert res.kind == kind, res.summary()
+    assert res.schedule and res.schedule.startswith(f"{name}@pb")
+    # the printed schedule string replays the failure deterministically
+    for _ in range(2):
+        rep = weave.replay(sc, res.schedule)
+        assert rep.failed and rep.kind == kind, rep.summary()
+        assert rep.schedule == res.schedule
+    # and a fresh exploration converges on the same schedule
+    again = weave.explore(sc)
+    assert again.schedule == res.schedule
+    assert again.schedules == res.schedules
+
+
+@pytest.mark.parametrize("name", [
+    "staging-checkout-fixed", "tcp-conns-fixed", "coord-fence-fixed"])
+def test_fixed_twin_has_no_failing_schedule(name):
+    from ompi_tpu.analysis import scenarios
+
+    sc = scenarios.get(name)
+    res = weave.explore(sc)
+    assert not res.failed, res.summary()
+    assert res.exhausted
+    assert res.schedules > 1
+
+
+def test_reverted_checkout_shape_refound_statically():
+    """The acceptance pin: the checkout-outside-lock revert is caught by
+    the STATIC layer too — lock-discipline on the naked insert, and the
+    mpi-typestate guarded-handoff rule on the pop -> re-register
+    window."""
+    res = analysis.lint([str(REPO / "ompi_tpu" / "analysis"
+                             / "scenarios.py")],
+                        select=["mpi-typestate", "lock-discipline"])
+    handoff = [f for f in res.findings
+               if f.rule == "mpi-typestate"
+               and "guarded handoff" in f.message]
+    assert len(handoff) == 1
+    assert handoff[0].symbol == "_RevertedCheckoutPool.acquire"
+    naked = [f for f in res.findings
+             if f.rule == "lock-discipline"
+             and f.symbol == "_RevertedCheckoutPool._checkout_window"]
+    assert naked, res.findings
+    # the real (fixed) pool is clean under both rules
+    res = analysis.lint([str(REPO / "ompi_tpu" / "mca" / "accelerator"
+                             / "jax_acc.py")],
+                        select=["mpi-typestate", "lock-discipline"])
+    assert not res.findings, [f.format() for f in res.findings]
+
+
+def test_scenarios_cli_expectations_hold():
+    """`python -m ompi_tpu.analysis.scenarios` exits 0 exactly when all
+    reverted scenarios FAIL and all fixed twins pass."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.analysis.scenarios"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("ok   ") == 6, r.stdout
+    assert "replay:" in r.stdout
+
+
+def test_scenarios_cli_bad_input_is_friendly():
+    """Typo'd scenario names and malformed schedules are argparse
+    errors, not tracebacks."""
+    for argv in (["no-such-scenario"],
+                 ["--replay", "no-such-scenario@pb2:0.0"],
+                 ["--replay", "not-a-schedule"]):
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.analysis.scenarios"]
+            + argv,
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 2, (argv, r.returncode, r.stderr)
+        assert "Traceback" not in r.stderr, (argv, r.stderr)
+
+
+def test_lint_parsable_timings_keep_stdout_clean():
+    """--timings under --parsable must not corrupt the machine stream:
+    timing rows ride on stderr."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.otpu_lint",
+         str(FIXTURES / "hot" / "good.py"), "--no-suppressions",
+         "--parsable", "--timings"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ms" not in r.stdout, r.stdout
+    assert "total:" in r.stderr, r.stderr
