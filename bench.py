@@ -1162,6 +1162,185 @@ ompi_tpu.finalize()
 """
 
 
+# ---------------------------------------------------------------------
+# otpu-prof perf-regression history plane (BENCH_HISTORY.jsonl)
+# ---------------------------------------------------------------------
+
+_HISTORY_WORKER = """
+import json, os, time
+import numpy as np
+import ompi_tpu
+from ompi_tpu.api import op
+
+w = ompi_tpu.init()
+K = int(os.environ.get("OTPU_BENCH_HISTORY_REPS", "6"))
+BATCH = int(os.environ.get("OTPU_BENCH_HISTORY_BATCH", "30"))
+points = os.environ.get(
+    "OTPU_BENCH_HISTORY_POINTS",
+    "allreduce:4096,allreduce:65536,pingpong:4096")
+out = []
+for spec in points.split(","):
+    kind, nbytes = spec.strip().split(":")
+    nbytes = int(nbytes)
+    if kind == "allreduce":
+        x = np.ones(max(1, nbytes // 4), np.float32)
+        def once():
+            for _ in range(BATCH):
+                w.allreduce(x, op.SUM)
+    else:                               # pingpong (2-rank halves)
+        x = np.ones(nbytes, np.uint8)
+        buf = np.empty_like(x)
+        peer = (w.rank + 1) % 2
+        def once():
+            for _ in range(BATCH):
+                if w.rank == 0:
+                    w.send(x, dest=1, tag=7)
+                    w.recv(buf, source=1, tag=8)
+                elif w.rank == 1:
+                    w.recv(buf, source=0, tag=7)
+                    w.send(x, dest=0, tag=8)
+    once()                              # warmup
+    best = float("inf")
+    for _ in range(K):                  # min-of-k: fast-mode statistic
+        w.barrier()
+        t0 = time.perf_counter()
+        once()
+        best = min(best, (time.perf_counter() - t0) / BATCH)
+    out.append({"key": f"{kind}_{nbytes}b_n{w.size}",
+                "lat_us": round(best * 1e6, 1), "k": K,
+                "batch": BATCH, "nbytes": nbytes})
+if w.rank == 0:
+    print("HISTORY " + json.dumps(out))
+ompi_tpu.finalize()
+"""
+
+_LADDER_WORKER = """
+import json, os, time
+import numpy as np
+import ompi_tpu
+from ompi_tpu.api import op
+from ompi_tpu.base.var import registry
+from ompi_tpu.mca.coll.tuned import _MENUS
+
+w = ompi_tpu.init()
+K = int(os.environ.get("OTPU_BENCH_LADDER_REPS", "3"))
+colls = os.environ.get("OTPU_BENCH_LADDER_COLLS",
+                       "allreduce,bcast").split(",")
+sizes = [int(s) for s in os.environ.get(
+    "OTPU_BENCH_LADDER_SIZES", "4096,65536,1048576").split(",")]
+out = []
+for coll in colls:
+    force = registry.lookup(f"otpu_coll_tuned_{coll}_algorithm")
+    for nbytes in sizes:
+        x = np.ones(max(1, nbytes // 4), np.float32)
+        for alg in sorted(_MENUS[coll]):
+            force.set(alg)              # every rank runs the same loop
+            batch = max(3, min(20, (256 << 10) // max(1, nbytes)))
+            def once():
+                for _ in range(batch):
+                    if coll == "allreduce":
+                        w.allreduce(x, op.SUM)
+                    else:
+                        w.bcast(x, root=0)
+            try:
+                once()
+                best = float("inf")
+                for _ in range(K):
+                    w.barrier()
+                    t0 = time.perf_counter()
+                    once()
+                    best = min(best, (time.perf_counter() - t0) / batch)
+                out.append({"coll": coll, "nbytes": nbytes,
+                            "algorithm": alg,
+                            "lat_us": round(best * 1e6, 1), "k": K})
+            except Exception as exc:
+                out.append({"coll": coll, "nbytes": nbytes,
+                            "algorithm": alg, "error": str(exc)[:120],
+                            "lat_us": -1.0, "k": K})
+        force.set("")
+if w.rank == 0:
+    print("LADDER " + json.dumps(out))
+ompi_tpu.finalize()
+"""
+
+
+def history_file() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.environ.get("OTPU_BENCH_HISTORY_FILE",
+                          os.path.join(here, "BENCH_HISTORY.jsonl"))
+
+
+def _run_history_worker(body: str, marker: str, n: int,
+                        extra_mca=()) -> list:
+    """One tpurun job over the PML wire path (coll/sm pushed below
+    coll/tuned so the rows measure the datapath the stage clocks cover
+    — and so a chaos wire fault actually lands in the numbers)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(body)
+        script = f.name
+    try:
+        argv = [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+                "-n", str(n),
+                "--mca", "otpu_coll_sm_coll_priority", "0"]
+        for k, v in extra_mca:
+            argv += ["--mca", k, v]
+        argv += [sys.executable, script]
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if marker in ln), None)
+        if proc.returncode or line is None:
+            print(f"history bench failed (rc={proc.returncode}):\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            return []
+        return json.loads(line.split(marker + " ", 1)[1])
+    finally:
+        os.unlink(script)
+
+
+def append_history(rows: list, kind: str, topology: str) -> list:
+    """Stamp measurement rows into v1 history rows (one run id per
+    call) and append them to the history file."""
+    run = f"r{int(time.time() * 1000)}"
+    t = time.time()
+    stamped = []
+    for r in rows:
+        row = {"v": 1, "kind": kind, "run": run, "t": t,
+               "topology": topology, "host": os.uname().nodename}
+        row.update(r)
+        stamped.append(row)
+    path = history_file()
+    with open(path, "a") as f:
+        for row in stamped:
+            f.write(json.dumps(row) + "\n")
+    return stamped
+
+
+def history_rows(n: int = 2) -> list:
+    """``--history``: min-of-k host-datapath latency points appended as
+    one run to BENCH_HISTORY.jsonl (the otpu_perf --diff input)."""
+    rows = _run_history_worker(_HISTORY_WORKER, "HISTORY", n)
+    return append_history(rows, "bench", f"host_sm_n{n}")
+
+
+def ladder_host_rows(n: int = 2) -> list:
+    """``--ladder``: the measured per-(topology, coll, size, algorithm)
+    sweep the self-tuning rules file (ROADMAP item 3) is derived from.
+    Failed (coll, size, alg) cells carry ``error`` and lat_us -1 and
+    are excluded from history (otpu_perf rejects non-positive rows)."""
+    rows = _run_history_worker(_LADDER_WORKER, "LADDER", n)
+    good = [r for r in rows if r.get("lat_us", -1) > 0]
+    bad = [r for r in rows if r.get("lat_us", -1) <= 0]
+    for r in bad:
+        print(f"ladder: {r['coll']}/{r['nbytes']}/{r['algorithm']} "
+              f"failed: {r.get('error')}", file=sys.stderr)
+    return append_history(good, "ladder", f"host_sm_n{n}") + bad
+
+
 def fastpath_points() -> list:
     """fastpath rows (BENCH_SWEEP schema): the zero-copy host-datapath
     evidence.  (a) ``fastpath_tcp_loopback``: 2-rank streaming bandwidth
@@ -2111,6 +2290,12 @@ if __name__ == "__main__":
     elif "--multidev" in sys.argv:
         for row in multidev_sweep():
             print(row)
+    elif "--history" in sys.argv:
+        for row in history_rows():
+            print(json.dumps(row))
+    elif "--ladder" in sys.argv:
+        for row in ladder_host_rows():
+            print(json.dumps(row))
     elif "--serving" in sys.argv:
         for row in refresh_serving_tables():
             print(json.dumps(row))

@@ -28,6 +28,13 @@ Three contracts the observability stack depends on:
    ``flight.dump`` must have a registered ``help-flight`` template —
    the dump announcement IS the user-facing diagnostic, and an
    unregistered reason would crash-dump with the raw fallback.
+
+6. **Profile stages come from the stage table**: every literal name
+   passed to ``profile.stage_span``/``profile.stage_mark`` must be a
+   key of the ``STAGES`` table in ``runtime/profile.py`` — the stage
+   vocabulary is closed so otpu_analyze's pack/queue/wire/parse/deliver
+   decomposition keeps a stable meaning (and the runtime rejects an
+   undeclared stage loudly; this catches it before it runs).
 """
 from __future__ import annotations
 
@@ -57,7 +64,8 @@ class ObservabilityPass(AnalysisPass):
                    "trace.now() begins are consumed by a span, "
                    "telemetry source names come from the declared "
                    "SCHEMA, flight-recorder dump reasons are "
-                   "help-flight-registered")
+                   "help-flight-registered, profile stage names come "
+                   "from the declared STAGES table")
 
     def run(self, pkg: Package) -> list[Finding]:
         registered: set[tuple] = set()
@@ -65,6 +73,8 @@ class ObservabilityPass(AnalysisPass):
         counters_declared = False
         schema: set[str] = set()
         schema_declared = False
+        stages: set[str] = set()
+        stages_declared = False
         for mod in pkg.modules:
             aliases = _register_aliases(mod)
             for node in ast.walk(mod.tree):
@@ -88,6 +98,18 @@ class ObservabilityPass(AnalysisPass):
                             s = const_str(elt)
                             if s:
                                 counters.add(s)
+            if mod.path.replace("\\", "/").endswith("profile.py"):
+                for stmt in mod.tree.body:
+                    if isinstance(stmt, ast.Assign) \
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "STAGES"
+                                    for t in stmt.targets) \
+                            and isinstance(stmt.value, ast.Dict):
+                        stages_declared = True
+                        for k in stmt.value.keys:
+                            s = const_str(k)
+                            if s:
+                                stages.add(s)
             if mod.path.replace("\\", "/").endswith("telemetry.py"):
                 for stmt in mod.tree.body:
                     if isinstance(stmt, ast.Assign) \
@@ -112,11 +134,13 @@ class ObservabilityPass(AnalysisPass):
             for fn, qual in mod.functions():
                 out.extend(self._check_fn(mod, fn, qual, registered,
                                           counters, counters_declared,
-                                          schema, schema_declared))
+                                          schema, schema_declared,
+                                          stages, stages_declared))
         return out
 
     def _check_fn(self, mod, fn, qual, registered, counters,
-                  counters_declared, schema, schema_declared) -> list:
+                  counters_declared, schema, schema_declared,
+                  stages, stages_declared) -> list:
         out = []
         begins: dict[str, ast.AST] = {}
         consumed: set[str] = set()
@@ -172,6 +196,23 @@ class ObservabilityPass(AnalysisPass):
                         "registered help-flight template — the crash "
                         "announcement would be the raw fallback",
                         qual))
+            elif short in ("stage_span", "stage_mark") and node.args \
+                    and stages_declared:
+                sname = const_str(node.args[0])
+                if sname and sname not in stages:
+                    out.append(Finding(
+                        self.name, mod.path, node.lineno,
+                        node.col_offset,
+                        f"profile stage {sname!r} is not declared in "
+                        "runtime/profile.py STAGES — stage clocks must "
+                        "aggregate into the declared stage table",
+                        qual))
+                # a stage_span consumes its t0 like span/hist_record
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            consumed.add(sub.id)
             elif short in ("span", "hist_record"):
                 for arg in list(node.args) + [kw.value for kw in
                                               node.keywords]:

@@ -154,11 +154,14 @@ class Instance:
         # live telemetry plane + crash-time flight recorder: both are
         # no-ops unless their vars/triggers arm them, and both need the
         # coord client this boot just established
-        from ompi_tpu.runtime import flight, telemetry
+        from ompi_tpu.runtime import flight, profile, telemetry
 
         if getattr(self.rte, "client", None) is not None:
             flight.arm(self.rte)
             telemetry.start(self.rte)
+        # otpu-prof needs no coord service: stage clocks are var-armed,
+        # the sampling profiler publishes through telemetry if running
+        profile.start(self.rte)
         trace.span("instance_boot", "boot", t_boot)
 
     def _boot_device_world(self) -> None:
@@ -260,6 +263,12 @@ class Instance:
             try:
                 _telemetry.stop()
                 _flight.disarm()
+            except Exception:
+                pass
+            try:
+                from ompi_tpu.runtime import profile as _profile
+
+                _profile.stop()
             except Exception:
                 pass
             # release per-comm coll resources of any communicator the

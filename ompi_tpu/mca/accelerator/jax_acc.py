@@ -32,7 +32,7 @@ import numpy as np
 from ompi_tpu.base.containers import IntervalTree
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType, registry
-from ompi_tpu.runtime import sanitizer, spc, trace
+from ompi_tpu.runtime import profile, sanitizer, spc, trace
 from ompi_tpu.runtime.hotpath import hot_path
 
 _rcache = IntervalTree()
@@ -180,7 +180,8 @@ class _StagingPool:
         nbytes = int(np.prod(shape)) * dtype.itemsize if shape \
             else dtype.itemsize
         cls = self._class_of(nbytes)
-        t0 = time.perf_counter_ns() if trace.enabled else 0
+        t0 = time.perf_counter_ns() \
+            if (trace.enabled or profile.enabled) else 0
         out = None
         with self._lock:
             dq = self._free.get(cls)
@@ -218,6 +219,8 @@ class _StagingPool:
             name = "staging_hit" if hit else "staging_miss"
             trace.span(name, "staging", t0, args={"nbytes": nbytes})
             trace.hist_record(name, nbytes, time.perf_counter_ns() - t0)
+        if profile.enabled:
+            profile.stage_span("send.staging", t0)
         return out
 
     @hot_path

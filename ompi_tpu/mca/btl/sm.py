@@ -25,6 +25,7 @@ from ompi_tpu.base.containers import Fifo
 from ompi_tpu.base.var import VarType
 from ompi_tpu.ft import chaos
 from ompi_tpu.mca.btl.base import CTL, Btl, Endpoint, Frag, owned_bytes
+from ompi_tpu.runtime import profile
 from ompi_tpu.runtime.hotpath import hot_path
 
 _HDR = struct.Struct("<QQ")  # head, tail
@@ -331,18 +332,26 @@ class SmBtl(Btl):
                     chaos.sleep_ms(rule)
                 chaos_dup = fault == "dup"
         ring = self._ring_to(ep.world_rank, ep.addr)
+        # stage clock: header build + enqueue attempt is send.queue;
+        # the ring write itself (the sm "wire") is send.wire
+        _pt = profile.now() if profile.enabled else 0
         hdr = _frame_hdr(frag)
         if chaos_dup:
             # framing-level duplicate of an idempotent CTL frame
             if not ring.push_frame(hdr, frag.data):
                 self._pending.setdefault(ep.world_rank, Fifo()).push(
                     (hdr, owned_bytes(frag.data)))
+        if profile.enabled:
+            profile.stage_span("send.queue", _pt)
+            _pt = profile.now()
         if not ring.push_frame(hdr, frag.data):
             # defer with an OWNED payload copy: the caller's request may
             # complete (eager) and the user reuse the buffer before the
             # retry fires from the progress loop
             self._pending.setdefault(ep.world_rank, Fifo()).push(
                 (hdr, owned_bytes(frag.data)))
+        if profile.enabled:
+            profile.stage_span("send.wire", _pt)
         self._ring_doorbell(ep.world_rank, ep.addr)
 
     @hot_path
@@ -364,7 +373,10 @@ class SmBtl(Btl):
                 if buf is None:
                     break
                 if self._recv_cb is not None:
+                    _pt = profile.now() if profile.enabled else 0
                     frag = _unframe(buf)
+                    if profile.enabled:
+                        profile.stage_span("recv.parse", _pt)
                     if chaos.enabled:
                         rule = chaos.wire_recv("sm", frag.kind == CTL)
                         if rule is not None:

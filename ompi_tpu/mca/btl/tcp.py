@@ -49,7 +49,7 @@ from ompi_tpu.base.var import VarType
 from ompi_tpu.ft import chaos
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, \
     Btl, Endpoint, Frag
-from ompi_tpu.runtime import sanitizer, spc, trace
+from ompi_tpu.runtime import profile, sanitizer, spc, trace
 from ompi_tpu.runtime.hotpath import hot_path
 
 _LEN = struct.Struct("!I")
@@ -393,6 +393,9 @@ class TcpBtl(Btl):
             raise ConnectionError(
                 f"chaos: injected connection reset to rank "
                 f"{ep.world_rank}")
+        # stage clock: frame build + enqueue, the wire syscall excluded
+        # (that is send.wire, recorded inside _flush_locked)
+        _pt = profile.now() if profile.enabled else 0
         # payload as a flat byte view — memoryview routes an ndarray
         # through the buffer protocol; .cast("B") flattens multi-dim /
         # non-uint8 views so len() counts bytes
@@ -448,6 +451,8 @@ class TcpBtl(Btl):
                                  else memoryview(payload))
                 conn.out_bytes += len(payload)
                 queued = 2
+            if profile.enabled:
+                profile.stage_span("send.queue", _pt)
             self._flush_locked(conn)
             if conn.outq and frag.borrowed and queued == 2:
                 # whatever the kernel did not take must stop aliasing
@@ -544,7 +549,8 @@ class TcpBtl(Btl):
                 bufs.append(mv)
                 if len(bufs) >= _IOV_BATCH:
                     break
-            t0 = time.perf_counter_ns() if trace.enabled else 0
+            t0 = time.perf_counter_ns() \
+                if (trace.enabled or profile.enabled) else 0
             try:
                 n = conn.sock.sendmsg(bufs)
             except (BlockingIOError, InterruptedError):
@@ -557,11 +563,14 @@ class TcpBtl(Btl):
                 self._mark_writable(conn, False)
                 self._drop_conn(conn)
                 return
-            if trace.enabled:
-                trace.span("btl_sendmsg", "btl", t0,
-                           args={"nbytes": n, "iov": len(bufs)})
-                trace.hist_record("btl_sendmsg", n,
-                                  time.perf_counter_ns() - t0)
+            if trace.enabled or profile.enabled:
+                t1 = time.perf_counter_ns()
+                if trace.enabled:
+                    trace.span("btl_sendmsg", "btl", t0, t1,
+                               args={"nbytes": n, "iov": len(bufs)})
+                    trace.hist_record("btl_sendmsg", n, t1 - t0)
+                if profile.enabled:
+                    profile.stage_span("send.wire", t0, t1)
             spc.record("fastpath_sendmsg")
             if n == 0:
                 break
@@ -725,7 +734,10 @@ class TcpBtl(Btl):
                             # the exact thing the armed checksum
                             # exists to preclude)
                             frame[1 + _CKSUM.size] ^= 0x01
+                _pt = profile.now() if profile.enabled else 0
                 frag = self._parse_frame(conn, frame, borrowed=True)
+                if profile.enabled:
+                    profile.stage_span("recv.parse", _pt)
                 if frag is not None and self._recv_cb is not None:
                     self._recv_cb(frag)
                     events += 1
@@ -761,7 +773,10 @@ class TcpBtl(Btl):
                 frame = bytes(memoryview(buf)[pos + _LEN.size:
                                               pos + _LEN.size + n])
                 pos += _LEN.size + n
+                _pt = profile.now() if profile.enabled else 0
                 frag = self._parse_frame(conn, frame)
+                if profile.enabled:
+                    profile.stage_span("recv.parse", _pt)
                 if frag is not None and self._recv_cb is not None:
                     self._recv_cb(frag)
                     events += 1

@@ -28,7 +28,7 @@ from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
 from ompi_tpu.mca.coll import algorithms as algs
 from ompi_tpu.mca.coll.basic import BasicCollModule
-from ompi_tpu.runtime import spc
+from ompi_tpu.runtime import profile, spc
 from ompi_tpu.runtime.hotpath import hot_path
 
 _MENUS = {
@@ -73,18 +73,23 @@ class TunedModule:
         """(algorithm, rule segsize) — segsize 0 means 'use the MCA var'.
         ``nbytes`` is the TOTAL payload per rank for every collective
         (alltoall included), matching the rule file's max_bytes column."""
-        forced = self._c.force_var(coll)
-        if forced:
-            return forced, 0
-        for (rcoll, max_size, max_bytes, alg, seg) in self._c.rules:
-            if rcoll != coll:
-                continue
-            if max_size and comm_size > max_size:
-                continue
-            if max_bytes and nbytes > max_bytes:
-                continue
-            return alg, seg
-        return default, 0
+        _pt = profile.now() if profile.enabled else 0
+        try:
+            forced = self._c.force_var(coll)
+            if forced:
+                return forced, 0
+            for (rcoll, max_size, max_bytes, alg, seg) in self._c.rules:
+                if rcoll != coll:
+                    continue
+                if max_size and comm_size > max_size:
+                    continue
+                if max_bytes and nbytes > max_bytes:
+                    continue
+                return alg, seg
+            return default, 0
+        finally:
+            if profile.enabled:
+                profile.stage_span("coll.decide", _pt)
 
     def _run(self, coll: str, alg: str, default: str, *args, **kw):
         menu = _MENUS[coll]
@@ -97,7 +102,12 @@ class TunedModule:
             # fall back to the ladder's own default: unlike an arbitrary
             # menu entry it is always safe for the op at hand
             fn = menu[default]
-        return fn(*args, **kw)
+        _pt = profile.now() if profile.enabled else 0
+        try:
+            return fn(*args, **kw)
+        finally:
+            if profile.enabled:
+                profile.stage_span("coll.alg", _pt)
 
     # -- fixed ladders (decision_fixed.c shape, TPU-host re-derivation) --
     @hot_path
@@ -114,7 +124,13 @@ class TunedModule:
                 and not self._c.rules
                 and not self._c.force_var("allreduce")):
             spc.record("fastpath_eager_lane")
-            return algs.allreduce_recursive_doubling(comm, sendbuf, op)
+            if not profile.enabled:
+                return algs.allreduce_recursive_doubling(comm, sendbuf, op)
+            _pt = profile.now()
+            try:
+                return algs.allreduce_recursive_doubling(comm, sendbuf, op)
+            finally:
+                profile.stage_span("coll.alg", _pt)
         if not op.commute:
             # ring/Rabenseifner reorder operands -> excluded (:77-80)
             default = "nonoverlapping" if comm.size <= 4 \
@@ -131,8 +147,9 @@ class TunedModule:
             default = "ring_segmented"
         alg, seg = self._pick("allreduce", comm.size, nbytes, default)
         if alg == "ring_segmented":
-            return algs.allreduce_ring_segmented(
-                comm, sendbuf, op, segsize=seg or self._c.segsize("allreduce"))
+            return self._run(
+                "allreduce", alg, default, comm, sendbuf, op,
+                segsize=seg or self._c.segsize("allreduce"))
         return self._run("allreduce", alg, default, comm, sendbuf, op)
 
     def bcast(self, comm, buf, root=0):
@@ -145,8 +162,8 @@ class TunedModule:
             default = "chain"
         alg, seg = self._pick("bcast", comm.size, nbytes, default)
         if alg == "chain":
-            return algs.bcast_chain(comm, buf, root,
-                                    segsize=seg or self._c.segsize("bcast"))
+            return self._run("bcast", alg, default, comm, buf, root,
+                             segsize=seg or self._c.segsize("bcast"))
         return self._run("bcast", alg, default, comm, buf, root)
 
     def reduce(self, comm, sendbuf, op=op_mod.SUM, root=0):
@@ -160,8 +177,8 @@ class TunedModule:
             default = "pipeline"
         alg, seg = self._pick("reduce", comm.size, nbytes, default)
         if alg == "pipeline":
-            return algs.reduce_pipeline(comm, sendbuf, op, root,
-                                        segsize=seg or self._c.segsize("reduce"))
+            return self._run("reduce", alg, default, comm, sendbuf, op,
+                             root, segsize=seg or self._c.segsize("reduce"))
         return self._run("reduce", alg, default, comm, sendbuf, op, root)
 
     def allgather(self, comm, sendbuf):

@@ -28,7 +28,7 @@ from ompi_tpu.base.var import VarType
 from ompi_tpu.datatype import Convertor
 from ompi_tpu.mca.bml import Bml
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, Frag
-from ompi_tpu.runtime import peruse, spc, trace
+from ompi_tpu.runtime import peruse, profile, spc, trace
 from ompi_tpu.runtime.hotpath import hot_path
 
 
@@ -275,7 +275,10 @@ class Ob1Pml:
             # eager: single MATCH fragment, complete immediately.  The
             # payload is a borrowed view when the layout allows it — the
             # btl's wire/ring write is the only copy (send-in-place)
+            _pt = profile.now() if profile.enabled else 0
             data, borrowed = req.convertor.pack_borrow()
+            if profile.enabled:
+                profile.stage_span("send.pack", _pt)
             frag = Frag(comm.cid, src_world, dst_world, tag, seq, MATCH,
                         data, total_len=req.nbytes, borrowed=borrowed)
             ep.btl.send(ep, frag)
@@ -291,8 +294,11 @@ class Ob1Pml:
 
             memchecker.protect_send(req, buf)
             try:
+                _pt = profile.now() if profile.enabled else 0
                 head, borrowed = req.convertor.pack_borrow(
                     ep.btl.rndv_eager_limit)
+                if profile.enabled:
+                    profile.stage_span("send.pack", _pt)
                 self._send_reqs[req.req_id] = req
                 frag = Frag(comm.cid, src_world, dst_world, tag, seq, RNDV,
                             head, total_len=req.nbytes,
@@ -343,7 +349,10 @@ class Ob1Pml:
             btl, max_send = ep.btl, rails[0].btl.max_send_size
             while not conv.finished:
                 off = conv.position
+                _pt = profile.now() if profile.enabled else 0
                 data, borrowed = conv.pack_borrow(max_send)
+                if profile.enabled:
+                    profile.stage_span("send.pack", _pt)
                 btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
                                   -1, 0, FRAG, data, total_len=req.nbytes,
                                   offset=off, meta={"req_id": peer_req},
@@ -361,7 +370,10 @@ class Ob1Pml:
                         / max(1, rails[k].btl.bandwidth))
                 ep = rails[j]
                 off = conv.position
+                _pt = profile.now() if profile.enabled else 0
                 data, borrowed = conv.pack_borrow(ep.btl.max_send_size)
+                if profile.enabled:
+                    profile.stage_span("send.pack", _pt)
                 assigned[j] += len(data)
                 ep.btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
                                      -1, 0, FRAG, data, total_len=req.nbytes,
@@ -574,6 +586,7 @@ class Ob1Pml:
         fire_now = events is None
         if events is None:
             events = []
+        _pt = profile.now() if profile.enabled else 0
         comm_src = (req.comm.remote_group if req.comm.is_inter
                     else req.comm.group).rank_of(frag.src)
         req.matched_src = frag.src
@@ -596,6 +609,8 @@ class Ob1Pml:
         req.received += n
         req.status._nbytes = min(req.total, req.received) if error else req.total
         spc.record("bytes_received", n)
+        if profile.enabled:
+            profile.stage_span("recv.deliver", _pt)
         done = False
         if frag.kind == RNDV and error is None:
             # register for FRAG continuation and ACK the sender
@@ -619,7 +634,10 @@ class Ob1Pml:
                 events.append((peruse.REQ_COMPLETE, frag.cid,
                                dict(kind="recv", source=req.status.source,
                                     tag=req.status.tag)))
+            _pt = profile.now() if profile.enabled else 0
             req.complete(error)
+            if profile.enabled:
+                profile.stage_span("recv.complete", _pt)
         if fire_now:
             for ev, cid, info in events:
                 peruse.fire(ev, cid, **info)
@@ -742,10 +760,13 @@ class Ob1Pml:
         req = self._recv_reqs.get(frag.meta["req_id"])
         if req is None:
             return
+        _pt = profile.now() if profile.enabled else 0
         req.convertor.set_position(min(frag.offset, req.capacity))
         n = req.convertor.unpack(frag.data)
         req.received += n
         spc.record("bytes_received", n)
+        if profile.enabled:
+            profile.stage_span("recv.deliver", _pt)
         if req.received >= min(req.total, req.capacity):
             self._recv_reqs.pop(frag.meta["req_id"], None)
             req.status._nbytes = req.received
@@ -755,7 +776,10 @@ class Ob1Pml:
                             nbytes=req.received)
                 peruse.fire(peruse.REQ_COMPLETE, frag.cid, kind="recv",
                             source=req.status.source, tag=req.status.tag)
+            _pt = profile.now() if profile.enabled else 0
             req.complete()
+            if profile.enabled:
+                profile.stage_span("recv.complete", _pt)
 
 
 def _release_rget(req) -> None:

@@ -89,7 +89,7 @@ def _estimate_offset_us(client) -> float:
 def _payload(rank: int, reason: str, detail: str,
              offset_us: float) -> dict:
     from ompi_tpu.ft import chaos, state as ft_state
-    from ompi_tpu.runtime import spc, trace
+    from ompi_tpu.runtime import profile, spc, trace
 
     tail = int(_events_var.value or 256)
     events = trace.chrome_events()[-tail:]
@@ -109,6 +109,10 @@ def _payload(rank: int, reason: str, detail: str,
         "chaos_events": chaos.event_log(),
         "spc": {k: v for k, v in spc.counters().items() if v},
         "failed_ranks": sorted(ft_state.failed_ranks()),
+        # otpu-prof's last stage-histogram snapshot + phase-sample
+        # counts: the post-crash bundle shows where host time was going
+        # (None when neither profile half was armed)
+        "profile": profile.export_payload(),
     }
 
 

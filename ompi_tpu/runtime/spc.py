@@ -36,6 +36,8 @@ _COUNTERS = (
     # live-telemetry plane (runtime/telemetry + runtime/flight):
     # samples published into the coord KV, crash dumps written
     "telemetry_samples", "flight_dumps",
+    # otpu-prof sampling profiler (runtime/profile): frame-sample ticks
+    "profile_samples",
 )
 
 _pvars = {}

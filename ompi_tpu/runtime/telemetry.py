@@ -67,6 +67,9 @@ SCHEMA = {
                "hits/misses",
     "serving": "continuous-batching scheduler queue/running/done depth",
     "chaos": "injected-fault totals of an armed chaos engine",
+    "profile": "otpu-prof host-overhead estimates: interval stage-clock "
+               "deltas plus sampling-profiler phase/GIL fractions "
+               "(runtime/profile.py)",
 }
 
 #: keys the sampler itself produces; component sources may only claim

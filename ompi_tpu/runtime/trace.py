@@ -471,7 +471,20 @@ def finalize_export(rte) -> None:
             offset_us = _estimate_coord_offset(client)
         except Exception:
             offset_us = 0.0
-    payload = chrome_payload(rank, clock_offset_us=offset_us)
+    # otpu-prof rides in the payload metadata: the per-rank stage
+    # breakdown reaches the launcher/analyzer over the same file + KV
+    # gather the timeline already takes
+    extra_meta = None
+    try:
+        from ompi_tpu.runtime import profile as _profile
+
+        prof = _profile.export_payload()
+        if prof is not None:
+            extra_meta = {"profile": prof}
+    except Exception:
+        extra_meta = None
+    payload = chrome_payload(rank, clock_offset_us=offset_us,
+                             extra_meta=extra_meta)
     tdir = payload["metadata"]["trace_dir"]
     encoded = json.dumps(payload)   # one encode serves file AND publish
     try:

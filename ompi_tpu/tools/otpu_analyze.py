@@ -21,6 +21,15 @@ skew report's eyeball pass cannot:
   fused-overlap work (ROADMAP item 4) must drive toward zero.  When
   step spans exist (``cat == "step"`` or a ``--step-span`` name), the
   fraction is also reported per step.
+- **Host-overhead decomposition** (otpu-prof): when the per-rank trace
+  payloads carry ``runtime/profile.py`` stage histograms (job ran with
+  ``otpu_profile_stages``), every rank gets a per-message
+  pack/queue/wire/parse/deliver breakdown, an **exposed-host fraction**
+  (host-side stage time over the rank's observed window — the number
+  the native-reactor refactor, ROADMAP item 2, must drive down), and a
+  stage-sum vs end-to-end reconciliation ratio (stage sums are work
+  segments inside the e2e latency; the remainder is progress-loop
+  wait, so the ratio must land in (0, ~1]).
 
 The report is a regression-friendly JSON document (stable key order,
 rounded numbers); ``--diff OLD.json`` compares two runs the way
@@ -41,38 +50,53 @@ from typing import Optional
 from ompi_tpu.runtime.trace import _percentile, merge_timelines
 
 
-def load_events(paths: list) -> list:
-    """Normalize any input form into one clock-aligned event list.
+def load_run(paths: list) -> tuple:
+    """Normalize any input form into ``(events, profiles)``: one
+    clock-aligned event list plus ``{rank: otpu-prof payload}`` for
+    every rank whose artifact carried profile metadata.
 
     Accepts merged-timeline files (events already aligned, ``pid`` =
     rank), per-rank payload files (aligned here via each payload's
-    ``clock_offset_us``), flight-recorder bundles (``merged_tail``),
-    and directories (prefer ``trace_merged.json``, else every
-    ``trace_rank*.json``)."""
+    ``clock_offset_us``), flight-recorder bundles (``merged_tail``;
+    per-rank profile snapshots under ``dumps``), and directories
+    (prefer ``trace_merged.json`` for events, but ALWAYS scan the
+    per-rank ``trace_rank*.json`` files too — the merged file drops
+    metadata, and the profile breakdown lives there)."""
     files: list = []
     for p in paths:
         if os.path.isdir(p):
             merged = os.path.join(p, "trace_merged.json")
+            ranks = sorted(glob.glob(os.path.join(p, "trace_rank*.json")))
             if os.path.exists(merged):
                 files.append(merged)
+                files.extend((r, "profile-only") for r in ranks)
             else:
-                files.extend(sorted(glob.glob(
-                    os.path.join(p, "trace_rank*.json"))))
+                files.extend(ranks)
         else:
             files.append(p)
     if not files:
         raise SystemExit("otpu_analyze: no timeline files found")
     events: list = []
     payloads: list = []       # per-rank payloads: align via THE merger
-    for path in files:
+    profiles: dict = {}
+    for entry in files:
+        path, meta_only = (entry if isinstance(entry, tuple)
+                           else (entry, None))
         with open(path) as f:
             doc = json.load(f)
         if "merged_tail" in doc:                  # flight bundle
             events.extend(doc["merged_tail"])
+            for r, dump in (doc.get("dumps") or {}).items():
+                if isinstance(dump, dict) and dump.get("profile"):
+                    profiles[int(r)] = dump["profile"]
         elif "traceEvents" in doc:
-            if doc.get("metadata", {}).get("rank") is not None:
-                payloads.append(doc)              # per-rank payload
-            else:
+            meta = doc.get("metadata", {})
+            if meta.get("rank") is not None:
+                if meta.get("profile"):
+                    profiles[int(meta["rank"])] = meta["profile"]
+                if not meta_only:
+                    payloads.append(doc)          # per-rank payload
+            elif not meta_only:
                 events.extend(doc["traceEvents"])  # already merged
         else:
             raise SystemExit(f"otpu_analyze: {path!r} is not a trace "
@@ -80,7 +104,12 @@ def load_events(paths: list) -> list:
     if payloads:
         events.extend(merge_timelines(payloads))
     events.sort(key=lambda e: float(e.get("ts", 0.0)))
-    return events
+    return events, profiles
+
+
+def load_events(paths: list) -> list:
+    """Back-compat wrapper over :func:`load_run` (events only)."""
+    return load_run(paths)[0]
 
 
 def _coll_rounds(events: list) -> dict:
@@ -115,7 +144,69 @@ def _union_us(intervals: list) -> float:
     return total + (cur_hi - cur_lo)
 
 
-def analyze(events: list, step_span: Optional[str] = None) -> dict:
+#: otpu-prof stage -> decomposition bucket: the five-way per-message
+#: breakdown the acceptance reports use.  ``wire`` is the only
+#: kernel-handoff bucket; every other stage is host software time.
+_BUCKETS = {
+    "pack": ("send.pack", "send.staging"),
+    "queue": ("send.queue",),
+    "wire": ("send.wire",),
+    "parse": ("recv.parse",),
+    "deliver": ("recv.deliver", "recv.complete"),
+}
+_HOST_BUCKETS = ("pack", "queue", "parse", "deliver")
+
+
+def _host_overhead(profiles: dict, windows: dict,
+                   coll_by_rank: dict) -> dict:
+    """Per-rank otpu-prof report: the five-bucket per-message
+    decomposition, exposed-host fraction, and the stage-sum vs
+    end-to-end reconciliation (see module docstring)."""
+    out: dict = {}
+    for rank in sorted(profiles):
+        prof = profiles[rank] or {}
+        stages = prof.get("stages") or {}
+        decomp: dict = {}
+        for bucket, names in _BUCKETS.items():
+            n = total = 0.0
+            for s in names:
+                row = stages.get(s)
+                if row:
+                    n = max(n, float(row.get("n", 0)))
+                    total += float(row.get("sum_us", 0.0))
+            if n:
+                decomp[bucket] = {"n": int(n),
+                                  "total_us": round(total, 1),
+                                  "mean_us": round(total / n, 2)}
+        stage_sum = sum(d["total_us"] for d in decomp.values())
+        host_sum = sum(decomp[b]["total_us"] for b in _HOST_BUCKETS
+                       if b in decomp)
+        colls = coll_by_rank.get(rank, [])
+        e2e = sum(dur for _ts, dur in colls)
+        # denominator: prefer the profile's own covered window
+        # (arm->export) — the stage totals span the WHOLE run, while
+        # the trace-event window only spans what survived the bounded
+        # ring, which would inflate the fraction on long runs
+        lo, hi = windows.get(rank, (0.0, 0.0))
+        wall = float(prof.get("elapsed_us") or 0.0) or (hi - lo)
+        row = {
+            "decomposition": decomp,
+            "stage_sum_us": round(stage_sum, 1),
+            "host_stage_us": round(host_sum, 1),
+            "exposed_host_fraction": round(host_sum / wall, 3)
+            if wall > 0 else 0.0,
+        }
+        if e2e > 0:
+            row["coll_e2e_us"] = round(e2e, 1)
+            row["stage_over_e2e"] = round(stage_sum / e2e, 3)
+        if prof.get("profiler"):
+            row["profiler"] = prof["profiler"]
+        out[str(rank)] = row
+    return out
+
+
+def analyze(events: list, step_span: Optional[str] = None,
+            profiles: Optional[dict] = None) -> dict:
     """The full report over one clock-aligned event list (see module
     docstring for the sections)."""
     ranks = sorted({int(e.get("pid", 0)) for e in events})
@@ -178,14 +269,17 @@ def analyze(events: list, step_span: Optional[str] = None) -> dict:
             step_spans.append((r, ts, dur, ev.get("args") or {}))
     for spans in coll_by_rank.values():
         spans.sort()
-    # exposed-communication fraction per rank (interval union)
+    # exposed-communication fraction per rank (interval union); the
+    # observed window doubles as the host-overhead denominator
     exposed: dict = {}
+    windows: dict = {}
     for r in ranks:
         mine = spans_by_rank.get(r)
         if not mine:
             continue
         lo = min(t0 for t0, _t1 in mine)
         hi = max(t1 for _t0, t1 in mine)
+        windows[r] = (lo, hi)
         comm = _union_us(coll_by_rank.get(r, []))
         exposed[str(r)] = round(comm / (hi - lo), 3) if hi > lo else 0.0
     # per-step breakdown when step spans exist (bisect into the rank's
@@ -223,6 +317,8 @@ def analyze(events: list, step_span: Optional[str] = None) -> dict:
         "collectives": per_coll,
         "exposed_comm": exposed,
         "steps": steps,
+        "host_overhead": _host_overhead(profiles or {}, windows,
+                                        coll_by_rank),
     }
     return report
 
@@ -247,6 +343,17 @@ def diff_reports(old: dict, new: dict) -> dict:
         b = float(new.get("exposed_comm", {}).get(r, 0.0))
         exp[r] = round(b - a, 3)
     out["exposed_comm_delta"] = exp
+    oh_old = old.get("host_overhead") or {}
+    oh_new = new.get("host_overhead") or {}
+    if oh_old or oh_new:
+        host: dict = {}
+        for r in sorted(set(oh_old) | set(oh_new)):
+            a = float((oh_old.get(r) or {})
+                      .get("exposed_host_fraction", 0.0))
+            b = float((oh_new.get(r) or {})
+                      .get("exposed_host_fraction", 0.0))
+            host[r] = round(b - a, 3)
+        out["exposed_host_delta"] = host
     return out
 
 
@@ -264,6 +371,13 @@ def render_text(report: dict, parsable: bool = False) -> str:
                 f"{c['straggler_fraction']}:{c['skew_us']['p99']}")
         for r, f in report["exposed_comm"].items():
             lines.append(f"exposed_comm:{r}:{f}")
+        for r, h in (report.get("host_overhead") or {}).items():
+            lines.append(
+                f"exposed_host:{r}:{h['exposed_host_fraction']}:"
+                f"{h['host_stage_us']}:{h.get('coll_e2e_us', 0.0)}")
+            for bucket, d in h["decomposition"].items():
+                lines.append(f"host_stage:{r}:{bucket}:{d['n']}:"
+                             f"{d['mean_us']}:{d['total_us']}")
         return "\n".join(lines)
     s = report["straggler"]
     lines = [f"otpu-analyze — {len(report['ranks'])} ranks, "
@@ -288,6 +402,33 @@ def render_text(report: dict, parsable: bool = False) -> str:
     lines.append("exposed-communication fraction per rank:")
     for r, f in report["exposed_comm"].items():
         lines.append(f"  rank {r}: {100 * f:.1f}%")
+    overhead = report.get("host_overhead") or {}
+    if overhead:
+        lines.append("")
+        lines.append("host-overhead decomposition (otpu-prof, per "
+                     "occurrence mean us / total us):")
+        buckets = ("pack", "queue", "wire", "parse", "deliver")
+        lines.append(f"{'rank':>4} " + " ".join(
+            f"{b:>15}" for b in buckets)
+            + f" {'host%':>6} {'stage/e2e':>9}")
+        for r, h in overhead.items():
+            cells = []
+            for b in buckets:
+                d = h["decomposition"].get(b)
+                cells.append(f"{d['mean_us']:.1f}/{d['total_us']:.0f}"
+                             if d else "-")
+            lines.append(
+                f"{r:>4} " + " ".join(f"{c:>15}" for c in cells)
+                + f" {100 * h['exposed_host_fraction']:>5.1f}%"
+                + f" {h.get('stage_over_e2e', '-'):>9}")
+            prof = h.get("profiler")
+            if prof:
+                lines.append(
+                    f"     profiler: {prof['samples']} samples, "
+                    f"gil_released {prof['gil_released']}, gil_wait "
+                    f"{prof['gil_wait']}, top phases "
+                    + ", ".join(f"{k}={v}" for k, v in
+                                list(prof["phases"].items())[:4]))
     return "\n".join(lines)
 
 
@@ -311,7 +452,9 @@ def main(argv=None) -> int:
                     help="Compare against a previous JSON report and "
                          "print the deltas")
     args = ap.parse_args(argv)
-    report = analyze(load_events(args.paths), step_span=args.step_span)
+    events, profiles = load_run(args.paths)
+    report = analyze(events, step_span=args.step_span,
+                     profiles=profiles)
     if args.json_out:
         encoded = json.dumps(report, indent=1, sort_keys=False)
         if args.json_out == "-":

@@ -105,6 +105,12 @@ def main(argv=None) -> int:
                          "published sample key (the declared schema "
                          "otpu_top renders), the sampler's MCA vars, "
                          "and the flight-recorder settings")
+    ap.add_argument("--profile", action="store_true",
+                    help="Show the otpu-prof plane: the declared "
+                         "datapath stage table (runtime/profile.py "
+                         "STAGES), the stage-clock / sampling-profiler "
+                         "MCA vars, and the perf-history file "
+                         "otpu_perf reads")
     ap.add_argument("--psets", action="store_true",
                     help="Show the process sets the coordination service "
                          "advertises (name, size, membership source) — "
@@ -197,6 +203,22 @@ def main(argv=None) -> int:
                 out.append(_fmt(
                     f"telemetry var {var.name}",
                     f"{var.value!r} — {var.help}", p))
+
+    if args.all or args.profile:
+        # registry-enumerated like --telemetry: the STAGES table and
+        # the profile var group, never a hand-kept list
+        from ompi_tpu.runtime import profile as _profile
+        from ompi_tpu.tools.otpu_perf import DEFAULT_HISTORY
+
+        for stage, desc in _profile.STAGES.items():
+            out.append(_fmt(f"profile stage {stage}", desc, p))
+        for var in registry.all_vars("profile"):
+            out.append(_fmt(f"profile var {var.name}",
+                            f"{var.value!r} — {var.help}", p))
+        out.append(_fmt("profile history",
+                        f"{DEFAULT_HISTORY} (bench.py --history / "
+                        "--ladder append; otpu_perf --diff/--check "
+                        "compare)", p))
 
     if args.all or args.psets:
         for pname, size, source in _pset_rows():

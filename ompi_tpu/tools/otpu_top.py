@@ -143,6 +143,25 @@ def _coll_cell(sample: dict, coll: str) -> str:
     return f"{h['p50_us']:.0f}/{h['p99_us']:.0f}us"
 
 
+def _host_pct(sample: dict) -> Optional[float]:
+    """otpu-prof live host-overhead: the interval's stage-clock time as
+    a percentage of the sampling interval (None without a profile
+    source — job not run with otpu_profile_stages)."""
+    prof = sample.get("profile")
+    iv_ms = float(sample.get("interval_ms") or 0)
+    if not prof or iv_ms <= 0:
+        return None
+    return 100.0 * float(prof.get("host_us", 0.0)) / (iv_ms * 1000.0)
+
+
+def _host_cell(sample: dict) -> str:
+    pct = _host_pct(sample)
+    if pct is None:
+        return "-"
+    gil = (sample.get("profile") or {}).get("gil_released")
+    return f"{pct:.0f}%" if gil is None else f"{pct:.0f}%/{gil:.2f}"
+
+
 def render_table(session: TopSession, samples: dict, coll: str,
                  parsable: bool = False) -> str:
     """The per-rank live table (or ``:``-separated rows)."""
@@ -152,25 +171,27 @@ def render_table(session: TopSession, samples: dict, coll: str,
         out = []
         for rank, s, stale in rows:
             if s is None:
-                out.append(f"{rank}:-:-:-:-:-:-:{int(stale)}")
+                out.append(f"{rank}:-:-:-:-:-:-:-:{int(stale)}")
                 continue
             tcp = s.get("tcp") or {}
             chaos = s.get("chaos") or {}
+            pct = _host_pct(s)
             out.append(":".join(str(x) for x in (
                 rank, s.get("seq"), round(_msg_rate(s), 1),
                 round(_byte_rate(s), 1),
                 _coll_cell(s, coll), tcp.get("outq_frags", 0),
-                sum(chaos.values()), int(stale))))
+                sum(chaos.values()),
+                "-" if pct is None else round(pct, 1), int(stale))))
         return "\n".join(out)
     hdr = (f"{'rank':>4}  {'seq':>6}  {'msg/s':>8}  {'bytes/s':>8}  "
            f"{coll + ' p50/p99':>16}  {'outq':>5}  {'stage':>6}  "
-           f"{'serveq':>6}  {'chaos':>5}  flag")
+           f"{'serveq':>6}  {'chaos':>5}  {'host%/gil':>10}  flag")
     lines = [hdr]
     for rank, s, stale in rows:
         if s is None:
             lines.append(f"{rank:>4}  {'-':>6}  {'-':>8}  {'-':>8}  "
                          f"{'-':>16}  {'-':>5}  {'-':>6}  {'-':>6}  "
-                         f"{'-':>5}  STALE")
+                         f"{'-':>5}  {'-':>10}  STALE")
             continue
         tcp = s.get("tcp") or {}
         staging = s.get("staging") or {}
@@ -185,6 +206,7 @@ def render_table(session: TopSession, samples: dict, coll: str,
             f"{_fmt_si(float(staging.get('bytes', 0))):>6}  "
             f"{serving.get('queued', '-'):>6}  "
             f"{sum(chaos.values()):>5}  "
+            f"{_host_cell(s):>10}  "
             f"{'STALE' if stale else 'ok'}")
     return "\n".join(lines)
 

@@ -25,3 +25,8 @@ def publish(telemetry):
 
 def crash(flight):
     flight.dump("mystery-reason")             # BAD: no help-flight key
+
+
+def clocked(profile):
+    t0 = profile.now()
+    profile.stage_span("mystery_stage", t0)   # BAD: not in STAGES

@@ -42,3 +42,10 @@ def publish(telemetry):
 
 def crash(flight):
     flight.dump("good-reason")                # registered help-flight key
+
+
+def clocked(profile):
+    t0 = profile.now()
+    profile.stage_span("send.pack", t0)       # declared in STAGES
+    profile.stage_mark("recv.parse")          # declared in STAGES
+    profile.stage_span(_dynamic_name(), 0)    # non-literal: out of scope

@@ -1,0 +1,7 @@
+"""Fixture stand-in for runtime/profile.py: the declared stage table
+the stage_span/stage_mark rule checks literal names against."""
+
+STAGES = {
+    "send.pack": "convertor pack",
+    "recv.parse": "frame parse",
+}

@@ -95,17 +95,20 @@ def test_hot_path_budget():
 def test_observability_contracts():
     bad = run_pass("observability", FIXTURES / "obs" / "bad.py",
                    FIXTURES / "obs" / "spc.py",
-                   FIXTURES / "obs" / "telemetry.py")
-    assert len(bad) == 5, bad
+                   FIXTURES / "obs" / "telemetry.py",
+                   FIXTURES / "obs" / "profile.py")
+    assert len(bad) == 6, bad
     msgs = " | ".join(f.message for f in bad)
     assert "no matching register_help" in msgs
     assert "not declared in runtime/spc.py" in msgs
     assert "never consumed" in msgs
     assert "not a key of runtime/telemetry.py SCHEMA" in msgs
     assert "no registered help-flight template" in msgs
+    assert "not declared in runtime/profile.py STAGES" in msgs
     assert not run_pass("observability", FIXTURES / "obs" / "good.py",
                         FIXTURES / "obs" / "spc.py",
-                        FIXTURES / "obs" / "telemetry.py")
+                        FIXTURES / "obs" / "telemetry.py",
+                        FIXTURES / "obs" / "profile.py")
 
 
 def test_mca_conformance():

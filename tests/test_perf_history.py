@@ -1,0 +1,162 @@
+"""The perf-regression history plane: BENCH_HISTORY.jsonl schema,
+otpu_perf's comparator, and THE chaos-slowdown acceptance.
+
+* ``otpu_perf --check`` against the COMMITTED seed — the tier-1 gate
+  the satellite demands: a schema or comparator regression fails CI
+  loudly;
+* comparator units: noise band, min-of-history baseline poisoning,
+  malformed-file rejection, ladder-kind rows;
+* THE acceptance — ``bench.py --history`` twice clean, then once with
+  an injected chaos ``delay:ms=...`` wire fault: ``otpu_perf --diff``
+  exits nonzero on the injected slowdown while the clean repeat passed
+  inside the noise band.  (Load-sensitive ABSOLUTE pins stay in
+  tests/bench_pins.json — this file pins only the comparator's
+  relative behavior.)
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _mk(run, t, key, lat, kind="bench", **extra):
+    row = {"v": 1, "kind": kind, "run": run, "t": t, "key": key,
+           "lat_us": lat, "k": 3}
+    row.update(extra)
+    return row
+
+
+# -------------------------------------------------- committed-seed gate
+
+def test_history_check_committed_seed():
+    """The tier-1 CI gate: the committed BENCH_HISTORY.jsonl seed must
+    validate (schema v1, parseable rows, >= 1 bench run) and the
+    comparator self-test must hold."""
+    from ompi_tpu.tools import otpu_perf
+
+    seed = REPO / "BENCH_HISTORY.jsonl"
+    assert seed.exists(), "committed BENCH_HISTORY.jsonl seed missing"
+    assert otpu_perf.main([str(seed), "--check"]) == 0
+    rows, errors = otpu_perf.load_history(str(seed))
+    assert not errors and rows
+    # every committed row carries the topology label the ladder rules
+    # derivation (ROADMAP item 3) will group by
+    assert all("topology" in r for r in rows)
+
+
+# ---------------------------------------------------- comparator units
+
+def test_comparator_noise_band_and_baseline():
+    from ompi_tpu.tools import otpu_perf
+
+    rows = [_mk("r1", 1, "x", 100.0), _mk("r2", 2, "x", 130.0)]
+    res = otpu_perf.compare(rows, band_rel=0.5, band_abs_us=10.0)
+    assert res["regressions"] == 0
+    assert res["rows"][0]["status"] == "ok"
+    # beyond the band: regression
+    rows.append(_mk("r3", 3, "x", 100.0 * 1.5 + 11.0))
+    res = otpu_perf.compare(rows, band_rel=0.5, band_abs_us=10.0)
+    assert res["regressions"] == 1
+    assert res["rows"][0]["status"] == "REGRESSED"
+    # a later clean run is compared against the rolling MIN — the slow
+    # r3 does not poison the baseline
+    rows.append(_mk("r4", 4, "x", 105.0))
+    res = otpu_perf.compare(rows, band_rel=0.5, band_abs_us=10.0)
+    assert res["regressions"] == 0
+    # keys with no prior history report as new, never regress
+    rows.append(_mk("r5", 5, "y", 50.0))
+    res = otpu_perf.compare(rows, band_rel=0.5, band_abs_us=10.0)
+    statuses = {r["key"]: r["status"] for r in res["rows"]}
+    assert statuses["y"] == "new"
+    assert res["regressions"] == 0
+
+
+def test_comparator_window_limits_baseline():
+    from ompi_tpu.tools import otpu_perf
+
+    # an ancient fast run outside the window must NOT set the baseline
+    rows = [_mk("r0", 0, "x", 10.0)]
+    rows += [_mk(f"r{i}", i, "x", 200.0) for i in range(1, 5)]
+    rows.append(_mk("r9", 9, "x", 210.0))
+    res = otpu_perf.compare(rows, band_rel=0.5, band_abs_us=10.0,
+                            window=3)
+    assert res["regressions"] == 0, res
+
+
+def test_ladder_rows_compare_by_cell():
+    from ompi_tpu.tools import otpu_perf
+
+    def lad(run, t, alg, lat):
+        return {"v": 1, "kind": "ladder", "run": run, "t": t,
+                "topology": "host_sm_n2", "coll": "allreduce",
+                "nbytes": 4096, "algorithm": alg, "lat_us": lat, "k": 2}
+
+    rows = [lad("r1", 1, "ring", 200.0), lad("r1", 1, "rd", 100.0),
+            lad("r2", 2, "ring", 205.0), lad("r2", 2, "rd", 400.0)]
+    res = otpu_perf.compare(rows, band_rel=0.5, band_abs_us=10.0,
+                            kind="ladder")
+    by_key = {r["key"]: r["status"] for r in res["rows"]}
+    assert by_key["ladder/host_sm_n2/allreduce/4096/rd"] == "REGRESSED"
+    assert by_key["ladder/host_sm_n2/allreduce/4096/ring"] == "ok"
+
+
+def test_check_rejects_malformed_history(tmp_path):
+    from ompi_tpu.tools import otpu_perf
+
+    bad = tmp_path / "hist.jsonl"
+    bad.write_text(
+        json.dumps(_mk("r1", 1, "x", 100.0)) + "\n"
+        + "this is not json\n"
+        + json.dumps({"v": 1, "kind": "bench", "run": "r2"}) + "\n"
+        + json.dumps(_mk("r3", 3, "x", -5.0)) + "\n"
+        + json.dumps(_mk("r4", 4, "x", 100.0, v=99)) + "\n"
+        + json.dumps(_mk("r5", 5, "x", 100.0, kind="mystery")) + "\n")
+    rows, errors = otpu_perf.load_history(str(bad))
+    assert len(rows) == 1 and len(errors) == 5
+    assert otpu_perf.main([str(bad), "--check"]) == 1
+    # an empty file is a check failure too, not a silent pass
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert otpu_perf.main([str(empty), "--check"]) == 1
+
+
+# ------------------------------------------------- THE acceptance run
+
+def _run_history(history, env_extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               OTPU_BENCH_HISTORY_FILE=str(history),
+               OTPU_BENCH_HISTORY_POINTS="allreduce:4096",
+               OTPU_BENCH_HISTORY_REPS="4",
+               OTPU_BENCH_HISTORY_BATCH="15")
+    env.pop("OTPU_RANK", None)
+    env.pop("OTPU_NPROCS", None)
+    env.update(env_extra)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--history"],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.strip(), "history run produced no rows"
+
+
+def test_history_diff_catches_injected_slowdown(tmp_path):
+    """bench.py --history twice (clean) -> otpu_perf --diff passes
+    inside the noise band; a third run with an injected chaos wire
+    delay -> --diff flags it and exits nonzero (3)."""
+    from ompi_tpu.tools import otpu_perf
+
+    history = tmp_path / "hist.jsonl"
+    _run_history(history, {})
+    _run_history(history, {})
+    # clean repeat: inside the noise band, exit 0
+    assert otpu_perf.main([str(history), "--diff"]) == 0
+    # injected slowdown: 5ms per wire send on a ~1ms baseline
+    _run_history(history, {"OTPU_MCA_chaos_spec": "delay:ms=5,p=1"})
+    assert otpu_perf.main([str(history), "--diff"]) == 3
+    res = otpu_perf.compare(otpu_perf.load_history(str(history))[0])
+    assert res["regressions"] == 1
+    assert res["rows"][0]["ratio"] > 1.5, res

@@ -247,6 +247,47 @@ def test_flight_dump_payload_and_once_guard(monkeypatch, tmp_path):
         srv.close()
 
 
+def test_flight_dump_bundles_profile_snapshot(monkeypatch, tmp_path):
+    """otpu-prof satellite: an armed stage-clock/profiler plane rides
+    in the crash dump — rank<r>.json shows where host time was going
+    (stage histograms + phase-sample counts); an unarmed plane dumps
+    ``profile: null`` rather than fabricating numbers."""
+    from ompi_tpu.base.var import registry
+    from ompi_tpu.runtime import flight, profile
+
+    srv, w, rt = _mk_world(monkeypatch, interval_ms=0)
+    registry.lookup("otpu_flight_dir").set(str(tmp_path / "crash3"))
+    try:
+        flight.reset_for_testing()
+        profile.reset_for_testing()
+        from ompi_tpu.runtime import init as rt_mod
+
+        flight.arm(rt_mod.get_rte())
+        # unarmed: the dump records the absence honestly
+        path = flight.dump("sanitize", detail="no profile")
+        assert json.loads(Path(path).read_text())["profile"] is None
+        # armed: stage histograms + profiler phase counts ride along
+        profile._set_enabled(True)
+        profile.stage_span("send.pack", profile.now() - 5000)
+        p = profile.HostProfiler(rank=0, interval_ms=5)
+        with profile._lock:
+            profile._profiler = p
+        p.samples = 3
+        p.phase_counts = {"idle": 2, "other": 1}
+        p.total_obs = 3
+        p.blocked_obs = 2
+        path = flight.dump("abort", detail="with profile")
+        prof = json.loads(Path(path).read_text())["profile"]
+        assert prof["stages"]["send.pack"]["n"] == 1
+        assert prof["profiler"]["phases"] == {"idle": 2, "other": 1}
+        assert prof["profiler"]["samples"] == 3
+    finally:
+        profile.reset_for_testing()
+        flight.reset_for_testing()
+        rt.reset_for_testing()
+        srv.close()
+
+
 def test_sanitizer_fail_triggers_flight_dump(monkeypatch, tmp_path):
     from ompi_tpu.base.var import registry
     from ompi_tpu.runtime import flight, sanitizer
