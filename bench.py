@@ -738,24 +738,102 @@ def serving_rows() -> list:
     return rows
 
 
+_FLEET = """
+import json, sys
+import ompi_tpu
+from ompi_tpu.serving import (FleetController, MixedPoissonDriver,
+                              ShardWorker)
+
+w = ompi_tpu.init()
+if w.rank == 0:
+    fleet = FleetController(w, tenants={"ten_a": 2, "ten_b": 1})
+    drv = MixedPoissonDriver({
+        "ten_a": dict(model="m_a", rate_rps=300.0, n_requests=48,
+                      prompt_lens=(8, 64), decode_lens=(4, 24),
+                      prefixes=3, prefix_len=32),
+        "ten_b": dict(model="m_b", rate_rps=200.0, n_requests=32,
+                      prompt_lens=(8, 64), decode_lens=(4, 24),
+                      prefixes=2, prefix_len=16),
+    }, seed=5)
+    rep = drv.run(fleet, max_wall_s=150)
+    fleet.shutdown()
+    print("FLEET " + json.dumps(rep), flush=True)
+else:
+    ShardWorker(w, router=0).serve()
+ompi_tpu.finalize()
+"""
+
+
+def fleet_rows() -> list:
+    """``bench.py --serving``'s fleet half: TWO model pools + TWO
+    weighted tenants under the mixed-workload driver (shared prompt
+    prefixes included, so the per-tenant numbers reflect prefix-aware
+    routing).  One row per tenant — the per-tenant p99 IS the fleet's
+    contract number (a blended percentile would hide one tenant
+    starving) — plus the prefix-cache hit rate on each row."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_FLEET)
+        script = f.name
+    rows = []
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "5",
+             "--pool", "m_a:1,2", "--pool", "m_b:3,4",
+             sys.executable, script],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if "FLEET " in ln), None)
+        if proc.returncode or line is None:
+            print(f"fleet bench failed (rc={proc.returncode}):\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            return [{"coll": "serving_fleet", "ok": False}]
+        rep = _json.loads(line.split("FLEET ", 1)[1])
+        for name, tr in sorted(rep["tenants"].items()):
+            rows.append({
+                "coll": f"serving_fleet_{name}",
+                "nbytes": tr["requests"],
+                "p50_ms": tr["p50_ms"], "p99_ms": tr["p99_ms"],
+                "p99_exact_ms": tr["p99_exact_ms"],
+                "tokens_per_s": tr["tokens_per_s"],
+                "req_per_s": round(tr["requests"] / rep["elapsed_s"],
+                                   1),
+                "prefix_hit_rate": rep["prefix_hit_rate"],
+            })
+    finally:
+        os.unlink(script)
+    return rows
+
+
 def _serving_md_section(rows) -> list:
     lines = ["", "## Serving (Poisson open-loop, router + 2 workers)",
              "",
              "Request latency percentiles come from the otpu-trace "
              "log2 histogram estimator (`p99_exact` is the driver's "
              "own sample check); tokens/sec counts decoded tokens. "
-             "Open-loop queueing numbers, not ping-pong latency.",
+             "Open-loop queueing numbers, not ping-pong latency. "
+             "`serving_fleet_*` rows are PER TENANT from the two-pool "
+             "/ two-tenant fleet run (weighted fair-share admission, "
+             "prefix-aware routing — `pfx%` is the cache hit rate).",
              "",
              "| mode | requests | p50 ms | p99 ms | p99 exact ms | "
-             "tokens/s | req/s |", "|---|---|---|---|---|---|---|"]
+             "tokens/s | req/s | pfx% |",
+             "|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if not r.get("ok", True):
-            lines.append(f"| {r['coll']} | FAILED | - | - | - | - | - |")
+            lines.append(f"| {r['coll']} | FAILED | - | - | - | - | "
+                         "- | - |")
             continue
+        pfx = r.get("prefix_hit_rate")
+        pfx_s = f"{100.0 * pfx:.0f}%" if pfx is not None else "-"
         lines.append(
             f"| {r['coll']} | {r['nbytes']} | {r['p50_ms']} | "
             f"{r['p99_ms']} | {r['p99_exact_ms']} | "
-            f"{r['tokens_per_s']} | {r['req_per_s']} |")
+            f"{r['tokens_per_s']} | {r['req_per_s']} | {pfx_s} |")
     return lines
 
 
@@ -764,7 +842,7 @@ def refresh_serving_tables() -> list:
     the committed sweep tables (replacing any previous serving rows) —
     the device/host rows are left untouched."""
     here = os.path.dirname(os.path.abspath(__file__))
-    rows = serving_rows()
+    rows = serving_rows() + fleet_rows()
     try:
         with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
             payload = json.load(f)

@@ -26,6 +26,13 @@ _COUNTERS = (
     # streaming epochs, and requests requeued by serve-through-failure
     "serve_requests", "serve_tokens", "serve_ticks", "serve_admitted",
     "serve_evicted", "serve_requeued", "serve_kv_epochs", "serve_scaleups",
+    # fleet counters (ompi_tpu/serving/fleet + prefix_cache): full
+    # prefill passes actually computed, prefix-cache routing hits
+    # (worker-verified, prefill skipped), router-side lookup misses,
+    # stale hints (registry said hit, worker store said no — perf miss
+    # by design), and telemetry-policy scale-downs/re-enlistments
+    "serve_prefills", "serve_prefix_hits", "serve_prefix_misses",
+    "serve_prefix_stale", "serve_scaledowns", "serve_enlists",
     # chaos counters (ompi_tpu/ft/chaos): every injected fault is
     # counted, so a chaos run self-documents what it actually injected
     "chaos_drop", "chaos_delay", "chaos_dup", "chaos_corrupt",

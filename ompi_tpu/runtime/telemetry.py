@@ -70,6 +70,9 @@ SCHEMA = {
     "profile": "otpu-prof host-overhead estimates: interval stage-clock "
                "deltas plus sampling-profiler phase/GIL fractions "
                "(runtime/profile.py)",
+    "fleet": "serving-fleet control plane: per-pool worker/queue "
+             "tables, prefix-cache hit/miss, reserve size, and recent "
+             "autoscale decisions (serving/fleet.py)",
 }
 
 #: keys the sampler itself produces; component sources may only claim

@@ -23,7 +23,16 @@ earlier PRs built —
 * **serve-through-failure**: on ``proc_failed`` the comm is revoked,
   survivors shrink (publishing ``mpi://surviving``), the router
   re-shards its worker table and requeues the dead worker's in-flight
-  requests — no admitted request is ever dropped.
+  requests — no admitted request is ever dropped;
+* **the fleet** (:mod:`ompi_tpu.serving.fleet`,
+  :mod:`ompi_tpu.serving.prefix_cache`): multiple models and tenants
+  sharing one job — named per-model pools (``mpi://serving/pool/
+  <model>`` psets, ``tpurun --pool``), fair-share weighted-round-robin
+  admission across tenants, prefix-cache-aware routing (hash prompt
+  prefixes at KV-block granularity, route to the worker already
+  holding them, verified generations so stale entries are perf misses
+  only), and autoscaling driven by the live telemetry plane (per-pool
+  p99 SLO / stale ranks / depth) instead of a queue-depth watermark.
 
 Why the eager/partitioned lanes and not naive per-request sends:
 "Optimizing Allreduce with Multiple Processes per GPU" (arxiv
@@ -80,13 +89,24 @@ from ompi_tpu.serving.scheduler import (ContinuousBatchScheduler,  # noqa: E402
                                         ServeRequest)
 from ompi_tpu.serving.kv_stream import (KvSlabReceiver,  # noqa: E402
                                         KvSlabSender)
+from ompi_tpu.serving.prefix_cache import (PrefixRegistry,  # noqa: E402
+                                           PrefixStore, block_hashes)
 from ompi_tpu.serving.router import Router  # noqa: E402
 from ompi_tpu.serving.worker import ShardWorker, worker_main  # noqa: E402
-from ompi_tpu.serving.driver import PoissonDriver  # noqa: E402
+from ompi_tpu.serving.fleet import (FleetAutoscaler,  # noqa: E402
+                                    FleetController, PoolSpec,
+                                    PSET_POOL_PREFIX,
+                                    pool_specs_from_psets)
+from ompi_tpu.serving.driver import (MixedPoissonDriver,  # noqa: E402
+                                     PoissonDriver)
 
 __all__ = [
-    "PSET_ROUTER", "PSET_WORKERS", "roles",
+    "PSET_ROUTER", "PSET_WORKERS", "PSET_POOL_PREFIX", "roles",
     "ServeRequest", "ContinuousBatchScheduler",
     "KvSlabSender", "KvSlabReceiver",
-    "Router", "ShardWorker", "worker_main", "PoissonDriver",
+    "PrefixRegistry", "PrefixStore", "block_hashes",
+    "Router", "ShardWorker", "worker_main",
+    "FleetController", "FleetAutoscaler", "PoolSpec",
+    "pool_specs_from_psets",
+    "PoissonDriver", "MixedPoissonDriver",
 ]

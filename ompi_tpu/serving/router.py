@@ -39,20 +39,40 @@ from ompi_tpu.api.errhandler import ERRORS_RETURN
 from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
                                  RevokedError)
 from ompi_tpu.runtime import spc, trace
+from ompi_tpu.serving import prefix_cache
 from ompi_tpu.serving.scheduler import (ContinuousBatchScheduler,
                                         RequestState, ServeRequest)
 from ompi_tpu.serving.worker import TAG_CMD, TAG_RES, toy_token
 
 _HIST = "serve_request"
+#: per-tenant / per-pool latency-histogram family name prefixes (the
+#: driver's per-tenant report and the fleet autoscaler's per-pool p99
+#: signal read these; hist_reset per family keeps populations apart)
+TENANT_HIST_PREFIX = "serve_tenant_"
+POOL_HIST_PREFIX = "serve_pool_"
 
 
 class Router:
-    """Admission + dispatch + recovery for one serving communicator."""
+    """Admission + dispatch + recovery for one serving communicator.
+
+    A fleet pool is exactly one Router: ``prefill_ranks`` /
+    ``decode_ranks`` size the two stage pools independently (a prefill
+    rank streams to every decode rank mapped onto it), ``pool`` names
+    the per-pool latency-histogram family, ``prefix_registry`` makes
+    routing prefix-cache-aware, and ``manage_recovery=False`` defers
+    ULFM recovery to the :class:`~ompi_tpu.serving.fleet.
+    FleetController` that owns the shared communicator (several pool
+    routers must not each shrink it)."""
 
     def __init__(self, comm, scheduler: Optional[ContinuousBatchScheduler]
                  = None, stages: bool = False, decode_chunk: int = 4,
                  kv_elems: int = 256,
                  workers: Optional[list] = None,
+                 prefill_ranks: Optional[list] = None,
+                 decode_ranks: Optional[list] = None,
+                 prefix_registry=None,
+                 pool: Optional[str] = None,
+                 manage_recovery: bool = True,
                  scale_watermark: Optional[int] = None,
                  scale_step: int = 1, scale_patience: int = 3,
                  scale_cooldown: int = 50,
@@ -69,9 +89,18 @@ class Router:
             raise MpiError(ErrorClass.ERR_ARG,
                            "serving needs at least one worker rank")
         self.sched = scheduler or ContinuousBatchScheduler()
-        self.stages = bool(stages)
+        self.stages = bool(stages) or bool(prefill_ranks)
         self.decode_chunk = int(decode_chunk)
         self.kv_elems = int(kv_elems)
+        self.pool = pool
+        self.registry = prefix_registry
+        self.manage_recovery = bool(manage_recovery)
+        # explicit stage pools (fleet: sized independently); None means
+        # the legacy half-split of the worker list
+        self._prefill = [int(w) for w in prefill_ranks] \
+            if prefill_ranks else None
+        self._decode = [int(w) for w in decode_ranks] \
+            if decode_ranks else None
         self.scale_watermark = scale_watermark
         self.scale_step = int(scale_step)
         self.scale_patience = int(scale_patience)
@@ -84,7 +113,10 @@ class Router:
         self.scale_argv = list(scale_argv) if scale_argv else None
         self._over_watermark = 0
         self._scale_cooling = 0
-        self._pair_epoch: dict = {}      # pair index -> last KV epoch
+        #: (prefill rank, decode rank) -> last KV epoch (per PAIRING,
+        #: not per pair index: independent pool sizing means one
+        #: prefill rank can hold several slab pairings)
+        self._pair_epoch: dict = {}
         self._completed: list = []
         # eviction notices: recently finished rids, re-sent with every
         # work dispatch (worker-side pops are idempotent, so repeats
@@ -92,6 +124,13 @@ class Router:
         self._recent_done: collections.deque = collections.deque(
             maxlen=64)
         self._lost_and_requeued = 0
+        #: worker-reported full-prefill and prefill-skipped counts,
+        #: accumulated ROUTER-side (SPC counters are per process; in a
+        #: multi-process job only the reports can tell the router what
+        #: the prefix cache actually saved — the acceptance's
+        #: "prefill-stage count delta" reads these)
+        self.prefill_count = 0
+        self.prefix_hit_count = 0
         if self.stages and len(self.workers) < 2:
             raise MpiError(ErrorClass.ERR_ARG,
                            "disaggregated serving needs >= 2 workers "
@@ -99,16 +138,30 @@ class Router:
 
     # -- worker table ------------------------------------------------------
     def _stage_split(self) -> tuple:
-        """(prefill ranks, decode ranks, extra ranks) — pair i of the
-        first two lists streams KV to each other; ``extra`` (the odd
-        leftover when the worker count is not even) serves colocated,
-        so no rank is silently idle.  Colocated mode decodes
-        everywhere."""
+        """(prefill ranks, decode ranks, extra ranks) — decode rank
+        ``i`` streams from prefill rank ``i % P`` (P may differ from D:
+        the pools are sized independently); ``extra`` (ranks in neither
+        explicit pool, or the odd leftover of the legacy half-split)
+        serves colocated, so no rank is silently idle.  Colocated mode
+        decodes everywhere."""
         if not self.stages:
             return [], list(self.workers), []
+        if self._prefill is not None:
+            pre = [w for w in self._prefill if w in self.workers]
+            dec = [w for w in (self._decode or []) if w in self.workers]
+            extra = [w for w in self.workers
+                     if w not in pre and w not in dec]
+            return pre, dec, extra
         half = len(self.workers) // 2
         return (self.workers[:half], self.workers[half:half * 2],
                 self.workers[half * 2:])
+
+    def _prefill_of(self, decode_rank: int, prefill_ranks,
+                    decode_ranks) -> int:
+        """The prefill rank paired with ``decode_rank`` (static map:
+        decode index i -> prefill index i mod P)."""
+        return prefill_ranks[decode_ranks.index(decode_rank)
+                             % len(prefill_ranks)]
 
     def _pick_worker(self, decode_ranks) -> int:
         """Least-loaded decode/colocated rank (running-request count)."""
@@ -118,11 +171,50 @@ class Router:
                 load[r.worker] += 1
         return min(decode_ranks, key=lambda w: (load[w], w))
 
+    def _assign(self, req, decode_ranks, extra_ranks,
+                prefill_ranks) -> None:
+        """Pick the worker for a fresh admission — prefix-cache-aware
+        when a registry is configured and the request carries prompt
+        tokens: the deepest registered block's holder wins (for a
+        stage pool, the decode rank mapped onto the holding PREFILL
+        rank), with the ``(hash, generation)`` hint attached for the
+        worker to verify; everything else, least-loaded."""
+        candidates = decode_ranks + extra_ranks
+        if self.registry is not None and req.prompt:
+            if req.hashes is None:
+                req.hashes = prefix_cache.block_hashes(req.prompt)
+            hit = self.registry.lookup(req.hashes)
+            if hit is not None:
+                target = None
+                if hit.worker in candidates:
+                    target = hit.worker
+                elif hit.worker in prefill_ranks and decode_ranks:
+                    # holder is a prefill rank: route to the least-
+                    # loaded decode rank IT streams to
+                    fed = [d for d in decode_ranks
+                           if self._prefill_of(d, prefill_ranks,
+                                               decode_ranks)
+                           == hit.worker]
+                    if fed:
+                        target = self._pick_worker(fed)
+                if target is not None:
+                    req.worker = target
+                    req.hint = (hit.hash, hit.generation, hit.blocks)
+                    return
+                # holder no longer routable (retired / re-sharded
+                # between insert and lookup): drop the stale entries
+                self.registry.invalidate_worker(hit.worker)
+            spc.record("serve_prefix_misses")
+        req.worker = self._pick_worker(candidates)
+
     # -- public API --------------------------------------------------------
     def submit(self, prompt_len: int, max_new_tokens: int,
-               rid: Optional[int] = None) -> ServeRequest:
+               rid: Optional[int] = None, tenant: str = "",
+               prompt=None) -> ServeRequest:
         return self.sched.submit(
-            ServeRequest(prompt_len, max_new_tokens, rid=rid))
+            ServeRequest(prompt_len, max_new_tokens, rid=rid,
+                         tenant=tenant, model=self.pool or "",
+                         prompt=prompt))
 
     def completed(self) -> list:
         return list(self._completed)
@@ -136,10 +228,15 @@ class Router:
     def tick(self) -> None:
         """One engine tick (see module doc).  Any ULFM error inside the
         tick routes through recovery and the tick retries cleanly on
-        the shrunken communicator at the next call."""
+        the shrunken communicator at the next call; a fleet-owned
+        router (``manage_recovery=False``) re-raises instead — the
+        fleet controller shrinks the SHARED comm exactly once and
+        rebinds every pool."""
         try:
             self._tick_inner()
         except (RevokedError, ProcFailedError):
+            if not self.manage_recovery:
+                raise
             self._recover()
 
     def serve_until_drained(self, max_ticks: int = 100000,
@@ -178,44 +275,54 @@ class Router:
         prefill_ranks, decode_ranks, extra_ranks = self._stage_split()
 
         # worker assignment for fresh admissions (decode pairs + any
-        # colocated leftover share the load)
+        # colocated leftover share the load; prefix-cache hits override
+        # least-loaded with affinity)
         for req in admitted:
-            req.worker = self._pick_worker(decode_ranks + extra_ranks)
+            self._assign(req, decode_ranks, extra_ranks, prefill_ranks)
 
         running = self.sched.running()
         if not running:
             self._maybe_autoscale()
             return
 
-        # stage round: stream this tick's new KV blocks pair-wise; a
-        # fresh request on an extra (colocated) rank prefills with its
-        # work command instead
+        # stage round: stream this tick's new KV blocks pairing-wise;
+        # a fresh request on an extra (colocated) rank prefills with
+        # its work command instead
         fresh = [r for r in running if not r.prefilled]
         paired = [r for r in fresh if r.worker in decode_ranks] \
             if self.stages else []
         if paired:
-            per_pair: dict = {}
+            per_pair: dict = {}   # (prefill rank, decode rank) -> reqs
             for r in paired:
-                per_pair.setdefault(decode_ranks.index(r.worker),
-                                    []).append(r)
-            for pair, reqs in sorted(per_pair.items()):
-                # epochs are PER PAIR: each slab pairing counts its own
-                # consecutive rounds (a global counter would desync a
-                # pair that sat out a round)
-                epoch = self._pair_epoch.get(pair, -1) + 1
-                self._pair_epoch[pair] = epoch
+                pre = self._prefill_of(r.worker, prefill_ranks,
+                                       decode_ranks)
+                per_pair.setdefault((pre, r.worker), []).append(r)
+            for (pre, dec), reqs in sorted(per_pair.items()):
+                # epochs are PER PAIRING: each slab pairing counts its
+                # own consecutive rounds (a global counter would desync
+                # a pairing that sat out a round)
+                epoch = self._pair_epoch.get((pre, dec), -1) + 1
+                self._pair_epoch[(pre, dec)] = epoch
                 self.comm.send_obj(
-                    ("prefill", epoch,
-                     [(r.rid, r.slot, r.prompt_len) for r in reqs]),
-                    prefill_ranks[pair], TAG_CMD)
+                    ("prefill", dec, epoch,
+                     [(r.rid, r.slot, r.prompt_len,
+                       self._fresh_hashes(r), r.hint) for r in reqs]),
+                    pre, TAG_CMD)
                 self.comm.send_obj(
                     ("kv", epoch,
                      [(r.rid, r.slot) for r in reqs]),
-                    decode_ranks[pair], TAG_CMD)
+                    dec, TAG_CMD)
             # prefill acks, then decode-side kv acks — order-free drain
-            for pair in sorted(per_pair):
-                self._expect(prefill_ranks[pair], "prefilled")
-                self._expect(decode_ranks[pair], "kv_ready")
+            for (pre, dec) in sorted(per_pair):
+                msg = self._expect(pre, "prefilled")
+                self._fold_preport(pre, msg[3])
+                self._expect(dec, "kv_ready")
+        # a fresh COLOCATED request prefills with its first work cmd —
+        # that cmd carries the prefix hashes + routing hint (paired
+        # requests already streamed theirs above)
+        fresh_colocated = {r.rid for r in fresh
+                           if not (self.stages
+                                   and r.worker in decode_ranks)}
         for r in fresh:
             r.prefilled = True         # paired: streamed above;
         #                                colocated: rides the work cmd
@@ -225,8 +332,11 @@ class Router:
         for r in running:
             n = min(self.decode_chunk, r.remaining)
             if n > 0:
-                per_worker.setdefault(r.worker, []).append(
-                    (r.rid, r.prompt_len, len(r.tokens), n))
+                first = r.rid in fresh_colocated
+                entry = (r.rid, r.prompt_len, len(r.tokens), n,
+                         self._fresh_hashes(r) if first else (),
+                         r.hint if first else None)
+                per_worker.setdefault(r.worker, []).append(entry)
             elif r.state is not RequestState.DONE:
                 # fully decoded but never marked (e.g. a recovery replay
                 # raced completion): retire it instead of starving
@@ -236,10 +346,9 @@ class Router:
             self.comm.send_obj(("work", batch, free_rids), w, TAG_CMD)
         by_rid = {r.rid: r for r in running}
         for w in sorted(per_worker):
-            kind, results = self._expect_res(w)
-            if kind != "res":
-                raise MpiError(ErrorClass.ERR_INTERN,
-                               f"expected decode results, got {kind!r}")
+            msg = self._expect(w, "res")
+            results = msg[1]
+            self._fold_preport(w, msg[2])
             for rid, toks in results:
                 req = by_rid.get(rid)
                 if req is None:
@@ -255,16 +364,44 @@ class Router:
                     self._finish(req)
         self._maybe_autoscale()
 
-    def _expect_res(self, worker: int):
-        msg = self.comm.recv_obj(worker, TAG_RES)
-        return msg[0], msg[-1]
+    def _fresh_hashes(self, req) -> tuple:
+        """The prompt's block-hash chain for a first dispatch (the
+        worker installs these in its prefix store), () when prefix
+        routing is off or the request carries no tokens."""
+        if self.registry is None or not req.prompt:
+            return ()
+        if req.hashes is None:
+            req.hashes = prefix_cache.block_hashes(req.prompt)
+        return req.hashes
 
-    def _expect(self, worker: int, kind: str) -> None:
+    def _fold_preport(self, worker: int, report) -> None:
+        """Fold a worker's prefix report into the routing registry:
+        freshly installed blocks become routable at the worker's
+        CURRENT generation, evicted blocks are forgotten (idempotent —
+        the report rides every reply like the KV eviction notices)."""
+        if report is None:
+            return
+        self.prefill_count += int(report.get("prefills", 0))
+        self.prefix_hit_count += int(report.get("hits", 0))
+        if self.registry is None:
+            return
+        gen = int(report.get("gen", 0))
+        installed = report.get("installed") or ()
+        if installed:
+            self.registry.insert(installed, worker, gen)
+        evicted = report.get("evicted") or ()
+        if evicted:
+            self.registry.forget(evicted, worker)
+
+    def _expect(self, worker: int, kind: str):
+        """Receive one reply from ``worker`` and check its kind;
+        returns the whole message."""
         msg = self.comm.recv_obj(worker, TAG_RES)
         if msg[0] != kind:
             raise MpiError(ErrorClass.ERR_INTERN,
                            f"expected {kind!r} from worker {worker}, "
                            f"got {msg[0]!r}")
+        return msg
 
     def _finish(self, req: ServeRequest) -> None:
         if req.state is RequestState.DONE:
@@ -275,9 +412,19 @@ class Router:
         if trace.enabled:
             # request latency (arrival -> last token) into the log2
             # histogram the percentile estimator reads; "size" is the
-            # token footprint so the bins separate small/large requests
-            trace.hist_record(_HIST, req.cost,
-                              trace.now() - req.arrival_ns)
+            # token footprint so the bins separate small/large requests.
+            # Tenant and pool get their OWN histogram families — their
+            # percentile populations never merge (the driver resets
+            # each family per run), which is what per-tenant p99
+            # reporting and the per-pool autoscaling signal read.
+            dur = trace.now() - req.arrival_ns
+            trace.hist_record(_HIST, req.cost, dur)
+            if req.tenant:
+                trace.hist_record(TENANT_HIST_PREFIX + req.tenant,
+                                  req.cost, dur)
+            if self.pool:
+                trace.hist_record(POOL_HIST_PREFIX + self.pool,
+                                  req.cost, dur)
 
     # -- failure handling --------------------------------------------------
     def _failed_workers(self) -> list:
@@ -297,16 +444,38 @@ class Router:
         except MpiError:
             pass                       # already revoked is fine
         new = self.comm.shrink()
-        new.set_errhandler(ERRORS_RETURN)
-        self.comm = new
         from ompi_tpu import serving as _pkg
 
-        self.me, self.workers = _pkg.roles(new)
-        self.stages = False            # pairs may have lost a side
+        workers = _pkg.roles(new)[1]
+        self.rebind(new, workers)
+
+    def rebind(self, new_comm, workers, prefill_ranks=None,
+               decode_ranks=None) -> None:
+        """Re-home this router onto a replacement communicator (the
+        tail of both recovery paths: standalone after its own shrink,
+        or fleet-driven after the controller shrank the SHARED comm
+        once and recomputed every pool's table).  Re-shards the worker
+        table, invalidates the prefix registry (comm ranks just
+        re-numbered — every routed worker id is suspect), and requeues
+        EVERY in-flight request: results in transit on the revoked comm
+        are gone, and decode is deterministic so a replay from
+        tokens_done is bit-identical."""
+        new_comm.set_errhandler(ERRORS_RETURN)
+        self.comm = new_comm
+        from ompi_tpu import serving as _pkg
+
+        self.me = _pkg.roles(new_comm)[0]
+        self.workers = [int(w) for w in workers if int(w) != self.me]
+        if prefill_ranks or decode_ranks:
+            self._prefill = [int(w) for w in prefill_ranks or ()]
+            self._decode = [int(w) for w in decode_ranks or ()]
+            self.stages = bool(self._prefill and self._decode)
+        else:
+            self.stages = False        # pairs may have lost a side
+            self._prefill = self._decode = None
         self._pair_epoch.clear()
-        # requeue EVERY in-flight request: results in transit on the
-        # revoked comm are gone, and decode is deterministic so a
-        # replay from tokens_done is bit-identical
+        if self.registry is not None:
+            self.registry.invalidate_all()
         running = self.sched.running()
         self._lost_and_requeued += len(running)
         self.sched.requeue(running)

@@ -14,6 +14,24 @@ Strict-FIFO admission is the no-starvation guarantee the tests pin: a
 request is admitted only when it is the OLDEST queued request, so a
 stream of short requests can never overtake a long one indefinitely.
 
+**Multi-tenant fair share** (the fleet layer): with ``tenants={name:
+weight}`` configured, each tenant owns its own strict-FIFO queue and
+admission runs **weighted round-robin across tenants** — each pass of
+the cycle lets tenant ``t`` admit up to ``weight[t]`` requests, so one
+tenant's burst can delay another by at most one cycle of the others'
+quanta, never starve it.  Both guarantees are *checkable*:
+:meth:`check_invariants` asserts per-tenant arrival order AND the
+cross-tenant bound (a continuously-backlogged tenant is never passed
+over for more than two full cycles of the other tenants' quanta — two,
+not one, because the tenant table may grow mid-run) against a bounded
+admission log.  A request whose head does not fit the width/token/slot
+budgets stops the WHOLE admission round — budget head-of-line blocking
+is shared, exactly like the single-queue case, so "passed over" can
+only mean "the WRR cycle was mid-rotation", which is what the bound
+covers.  Untagged requests ride the default tenant ``""`` and a
+scheduler constructed without ``tenants`` degenerates to the original
+single-queue FIFO bit-for-bit.
+
 Thread discipline: ``submit`` may be called from a driver thread while
 the router thread ticks, so every queue/batch structure is declared
 ``_guarded_by`` the scheduler lock (otpu-lint's lock-discipline pass
@@ -23,6 +41,7 @@ hot-path pass checks (no pickle, no string formatting, no list concat).
 """
 from __future__ import annotations
 
+import collections
 import enum
 import itertools
 import threading
@@ -42,14 +61,28 @@ class RequestState(enum.Enum):
 
 
 class ServeRequest:
-    """One inference request travelling through the serving engine."""
+    """One inference request travelling through the serving engine.
+
+    ``tenant``/``model`` place the request in the fleet (fair-share
+    queue and target pool); ``prompt`` optionally carries the actual
+    prompt tokens — with it the router can hash prefix blocks and
+    route the request to the worker already holding them (``hashes``
+    is the lazily computed digest chain, ``hint`` the
+    ``(hash, generation)`` the dispatched worker verifies, and
+    ``prefill_skipped`` records whether the hit actually saved the
+    prefill).  Without ``prompt`` everything behaves exactly as
+    before — prefix awareness is strictly additive."""
 
     __slots__ = ("rid", "prompt_len", "max_new_tokens", "arrival_ns",
                  "state", "tokens", "slot", "worker", "prefilled",
-                 "admit_ns", "done_ns")
+                 "admit_ns", "done_ns", "tenant", "model", "prompt",
+                 "hashes", "hint", "prefill_skipped")
 
     def __init__(self, prompt_len: int, max_new_tokens: int,
-                 rid: Optional[int] = None) -> None:
+                 rid: Optional[int] = None, tenant: str = "",
+                 model: str = "", prompt=None) -> None:
+        if prompt is not None and not prompt_len:
+            prompt_len = len(prompt)
         if prompt_len <= 0 or max_new_tokens <= 0:
             raise MpiError(ErrorClass.ERR_ARG,
                            f"request needs positive prompt/decode "
@@ -65,6 +98,13 @@ class ServeRequest:
         self.prefilled = False
         self.admit_ns: Optional[int] = None
         self.done_ns: Optional[int] = None
+        self.tenant = str(tenant)
+        self.model = str(model)
+        self.prompt = tuple(int(t) for t in prompt) \
+            if prompt is not None else None
+        self.hashes: Optional[tuple] = None   # router-computed digests
+        self.hint: Optional[tuple] = None     # (hash, generation)
+        self.prefill_skipped = False
 
     @property
     def cost(self) -> int:
@@ -88,12 +128,15 @@ class ContinuousBatchScheduler:
 
     _guarded_by = {
         "_sq": "_slock", "_running": "_slock", "_done": "_slock",
-        "_free_slots": "_slock",
+        "_free_slots": "_slock", "_tq": "_slock", "_tenants": "_slock",
+        "_tenant_names": "_slock", "_admit_log": "_slock",
+        "_rr": "_slock", "_rr_left": "_slock",
     }
 
     def __init__(self, max_batch: int = 8,
                  max_batch_tokens: int = 1 << 14,
-                 slots: Optional[int] = None) -> None:
+                 slots: Optional[int] = None,
+                 tenants: Optional[dict] = None) -> None:
         if max_batch <= 0 or max_batch_tokens <= 0:
             raise MpiError(ErrorClass.ERR_ARG,
                            "scheduler budgets must be positive")
@@ -105,7 +148,28 @@ class ContinuousBatchScheduler:
                            f"{self.slots} KV slots cannot back a batch "
                            f"of {self.max_batch}")
         self._slock = threading.Lock()
-        self._sq: list = []             # FIFO admission queue
+        # per-tenant strict-FIFO queues; "" is the default tenant and
+        # its queue IS the legacy _sq attribute (same list object), so
+        # single-tenant callers see the original scheduler unchanged
+        self._tenants: dict = {"": 1}
+        if tenants:
+            for name, weight in tenants.items():
+                if int(weight) <= 0:
+                    raise MpiError(ErrorClass.ERR_ARG,
+                                   f"tenant {name!r} needs a positive "
+                                   f"weight, got {weight}")
+                self._tenants[str(name)] = int(weight)
+        self._tq: dict = {name: [] for name in self._tenants}
+        self._sq: list = self._tq[""]   # FIFO admission queue (default)
+        self._tenant_names = tuple(self._tenants)
+        # bounded admission history backing the cross-tenant
+        # no-starvation invariant: (tenant, other-backlogged-tenants)
+        self._admit_log: collections.deque = collections.deque(maxlen=256)
+        # weighted-round-robin rotation state, persistent ACROSS ticks
+        # (resetting per tick would let a heavy tenant monopolize a
+        # batch narrower than its quantum forever)
+        self._rr = 0
+        self._rr_left = self._tenants[self._tenant_names[0]]
         self._running: list = []
         self._done: list = []
         self._free_slots = list(range(self.slots - 1, -1, -1))
@@ -120,11 +184,15 @@ class ContinuousBatchScheduler:
         """Queue/batch depth snapshot (the telemetry ``serving`` source
         and the autoscaler's richer sibling of :meth:`depth`)."""
         with self._slock:
-            return {"queued": len(self._sq),
-                    "running": len(self._running),
-                    "done": len(self._done),
-                    "used_tokens": self._used_tokens,
-                    "free_slots": len(self._free_slots)}
+            out = {"queued": sum(len(q) for q in self._tq.values()),
+                   "running": len(self._running),
+                   "done": len(self._done),
+                   "used_tokens": self._used_tokens,
+                   "free_slots": len(self._free_slots)}
+            if len(self._tq) > 1:
+                out["tenants"] = {t: len(q) for t, q in self._tq.items()
+                                  if q}
+            return out
 
     # -- submission (any thread) -----------------------------------------
     def submit(self, req: ServeRequest) -> ServeRequest:
@@ -136,14 +204,26 @@ class ContinuousBatchScheduler:
                 "could never be admitted")
         spc.record("serve_requests")
         with self._slock:
-            self._sq.append(req)
+            q = self._tq.get(req.tenant)
+            if q is None:
+                # a tenant first seen at submit time joins with weight 1
+                # (explicit weights come from the constructor's table)
+                self._tenants[req.tenant] = 1
+                q = self._tq[req.tenant] = []
+                self._tenant_names = tuple(self._tenants)
+            q.append(req)
         return req
 
     def depth(self) -> int:
-        """Queued (not yet admitted) request count — the autoscaling
-        watermark signal."""
+        """Queued (not yet admitted) request count across every tenant
+        — the autoscaling watermark signal."""
         with self._slock:
-            return len(self._sq)
+            return sum(len(q) for q in self._tq.values())
+
+    def tenant_depths(self) -> dict:
+        """{tenant: queued count} — the fleet fair-share view."""
+        with self._slock:
+            return {t: len(q) for t, q in self._tq.items()}
 
     def running(self) -> list:
         with self._slock:
@@ -182,26 +262,62 @@ class ContinuousBatchScheduler:
                 else:
                     keep.append(r)
             self._running = keep
-            while self._sq:
-                head = self._sq[0]
-                if len(self._running) >= self.max_batch:
-                    break
-                if self._used_tokens + head.cost > self.max_batch_tokens:
-                    break
-                if not self._free_slots:
-                    break
-                self._sq.pop(0)
-                head.slot = self._free_slots.pop()
-                head.state = RequestState.RUNNING
-                head.admit_ns = trace.now()
-                self._used_tokens += head.cost
-                self._running.append(head)
-                admitted.append(head)
+            self._admit_locked(admitted)
         if admitted:
             spc.record("serve_admitted", len(admitted))
         if evicted:
             spc.record("serve_evicted", len(evicted))
         return admitted, evicted
+
+    def _admit_locked(self, admitted: list) -> None:
+        """Weighted-round-robin admission (caller holds the scheduler
+        lock).  Each cycle pass lets tenant ``t`` admit up to
+        ``weight[t]`` oldest requests; a head that does not fit a
+        budget ends the WHOLE round (shared head-of-line semantics —
+        budget pressure never reorders anybody).  One tenant
+        degenerates to the original strict-FIFO loop."""
+        names = self._tenant_names
+        multi = len(names) > 1
+        if self._rr >= len(names):
+            self._rr = 0
+        while True:
+            if not any(self._tq[n] for n in names):
+                return
+            t = names[self._rr]
+            q = self._tq[t]
+            if not q or self._rr_left <= 0:
+                # empty queue forfeits the rest of the quantum (DRR);
+                # either way the NEXT tenant's quantum starts fresh
+                self._rr = (self._rr + 1) % len(names)
+                self._rr_left = self._tenants[names[self._rr]]
+                continue
+            head = q[0]
+            if len(self._running) >= self.max_batch:
+                return
+            if self._used_tokens + head.cost > self.max_batch_tokens:
+                return
+            if not self._free_slots:
+                return
+            # a budget return above leaves the rotation state in place:
+            # the next tick resumes THIS tenant's turn — fairness holds
+            # across tick boundaries, not only inside one tick (a
+            # narrow batch refilling one slot per tick must still walk
+            # the whole cycle)
+            q.pop(0)
+            head.slot = self._free_slots.pop()
+            head.state = RequestState.RUNNING
+            head.admit_ns = trace.now()
+            self._used_tokens += head.cost
+            self._running.append(head)
+            admitted.append(head)
+            self._rr_left -= 1
+            if multi:
+                # the no-starvation evidence: who was admitted, and
+                # which OTHER tenants were backlogged at that moment
+                # (check_invariants replays this)
+                others = tuple(n for n in names
+                               if n != t and self._tq[n])
+                self._admit_log.append((t, others))
 
     def mark_done(self, req: ServeRequest) -> None:
         """Sequence finished decoding: it leaves the batch at the NEXT
@@ -236,7 +352,13 @@ class ContinuousBatchScheduler:
                 r.state = RequestState.QUEUED
                 r.worker = None
                 r.prefilled = False
-                self._sq.insert(0, r)
+                r.hint = None
+                q = self._tq.get(r.tenant)
+                if q is None:
+                    self._tenants[r.tenant] = 1
+                    q = self._tq[r.tenant] = []
+                    self._tenant_names = tuple(self._tenants)
+                q.insert(0, r)
         spc.record("serve_requeued", len(back))
 
     # -- invariants (tests) ------------------------------------------------
@@ -256,3 +378,29 @@ class ContinuousBatchScheduler:
                 "slot both free and assigned"
             assert len(slots) + len(self._free_slots) == self.slots, \
                 "slots leaked"
+            # per-tenant strict FIFO: every queue stays arrival-ordered
+            for t, q in self._tq.items():
+                arr = [r.arrival_ns for r in q]
+                assert arr == sorted(arr), \
+                    f"tenant {t!r} queue broke arrival order"
+            # cross-tenant no-starvation (the fleet fair-share
+            # guarantee): replay the admission log — a tenant that was
+            # backlogged at every admission in a run of OTHER tenants'
+            # admissions is passed over at most two WRR cycles of the
+            # others' quanta (two, not one: the tenant table may have
+            # grown mid-run, rotating the cycle under it).  Budget
+            # blocking cannot inflate the run — a non-fitting head
+            # stops the whole round, so nothing after it is logged.
+            total_w = sum(self._tenants.values())
+            for t, w in self._tenants.items():
+                bound = 2 * max(1, total_w - w)
+                run = 0
+                for adm, backlogged in self._admit_log:
+                    if adm == t or t not in backlogged:
+                        run = 0
+                        continue
+                    run += 1
+                    assert run <= bound, (
+                        f"tenant {t!r} passed over {run} consecutive "
+                        f"admissions while backlogged (bound {bound}) "
+                        "— fair-share admission starved it")

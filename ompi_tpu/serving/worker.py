@@ -71,12 +71,13 @@ class ShardWorker:
     """One worker rank's engine loop (see module doc)."""
 
     def __init__(self, comm, router: Optional[int] = None,
-                 role: str = "colocated", peer: Optional[int] = None,
+                 role: str = "colocated", peer=None,
                  slots: int = 8, kv_elems: int = 256,
                  kv_partitions: Optional[int] = None) -> None:
         from ompi_tpu import serving as _pkg
         from ompi_tpu.serving.kv_stream import (KvSlabReceiver,
                                                 KvSlabSender)
+        from ompi_tpu.serving.prefix_cache import PrefixStore
 
         comm.set_errhandler(ERRORS_RETURN)   # ULFM: errors raise, not abort
         self.comm = comm
@@ -85,10 +86,28 @@ class ShardWorker:
         self.slots, self.kv_elems = int(slots), int(kv_elems)
         self._kv: dict = {}          # rid -> local KV block (decode state)
         self._stopped = False
-        self._sender = self._receiver = None
+        # prefix store: which block hashes this worker's cache still
+        # holds, generation-stamped (the router's routing hints are
+        # verified against it — see serving/prefix_cache.py)
+        self._prefix = PrefixStore()
+        self._prefix_hits = 0
+        self._preport_installed: list = []
+        self._preport_evicted: list = []
+        self._preport_prefills = 0
+        #: one KV slab sender per DECODE PEER: a prefill pool sized
+        #: independently of its decode pool streams to several decode
+        #: ranks, each over its own partitioned persistent pairing
+        self._senders: dict = {}
+        self._receiver = None
         if role == "prefill":
-            self._sender = KvSlabSender(comm, int(peer), self.slots,
-                                        self.kv_elems, TAG_KV)
+            peers = [int(peer)] if isinstance(peer, int) else \
+                [int(p) for p in (peer or ())]
+            if not peers:
+                raise MpiError(ErrorClass.ERR_ARG,
+                               "prefill worker needs >= 1 decode peer")
+            for p in peers:
+                self._senders[p] = KvSlabSender(comm, p, self.slots,
+                                                self.kv_elems, TAG_KV)
         elif role == "decode":
             self._receiver = KvSlabReceiver(comm, int(peer), self.slots,
                                             self.kv_elems, TAG_KV,
@@ -97,10 +116,71 @@ class ShardWorker:
     # -- compute ----------------------------------------------------------
     def _prefill(self, rid: int, prompt_len: int) -> np.ndarray:
         # simulated prefill cost scales with the prompt (a tanh pass
-        # over prompt_len model rows), result is the checkable KV block
+        # over prompt_len model rows), result is the checkable KV block.
+        # serve_prefills counts exactly these FULL passes — the prefix
+        # cache's value shows up as this counter staying below the
+        # request count (the acceptance soak asserts the delta)
+        spc.record("serve_prefills")
         _ = np.tanh(np.arange(int(prompt_len) * 8,
                               dtype=np.float32)).sum()
         return toy_kv(rid, self.kv_elems)
+
+    def _prefill_or_skip(self, rid: int, prompt_len: int, phashes,
+                         hint) -> np.ndarray:
+        """Prefill with the prefix cache consulted: a verified hint —
+        the hinted block is in THIS store at THIS generation — skips
+        the full pass (the cached KV serves the prefix; the toy model
+        regenerates the block directly).  Any mismatch, full prefill.
+        Either way the prompt's blocks are (re-)installed and the
+        caller's pending prefix report picks up what the LRU evicted."""
+        hit = bool(hint) and self._prefix.has(hint[0], int(hint[1]))
+        if not hit:
+            self._preport_prefills += 1
+        if hit:
+            spc.record("serve_prefix_hits")
+            self._prefix_hits += 1
+            # only the UNCACHED suffix pays prefill compute: the hinted
+            # blocks' KV is already resident (hint[2] counts them)
+            from ompi_tpu.serving.prefix_cache import block_size
+
+            cached = int(hint[2]) * block_size() if len(hint) > 2 else 0
+            suffix = max(0, int(prompt_len) - cached)
+            if suffix:
+                _ = np.tanh(np.arange(suffix * 8,
+                                      dtype=np.float32)).sum()
+            kv = toy_kv(rid, self.kv_elems)
+        else:
+            if hint:
+                # stale hint (evicted entry or a previous store
+                # lifetime): a perf miss, NEVER wrong KV
+                spc.record("serve_prefix_stale")
+            kv = self._prefill(rid, prompt_len)
+        if phashes:
+            self._preport_installed.extend(phashes)
+            self._preport_evicted.extend(self._prefix.add_all(phashes))
+        return kv
+
+    def _take_preport(self):
+        """Drain the pending prefix report (rides the next reply to
+        the router, which folds it into its registry — the same
+        idempotent piggyback channel as the KV eviction notices).
+        ``prefills``/``hits`` carry the worker's full-pass and
+        skipped-pass counts to the router: SPC counters are
+        per-process, so the router side is where a fleet-wide
+        prefill-delta can actually be read."""
+        if not (self._preport_installed or self._preport_evicted
+                or self._prefix_hits or self._preport_prefills):
+            return None
+        rep = {"gen": self._prefix.generation,
+               "installed": self._preport_installed,
+               "evicted": self._preport_evicted,
+               "hits": self._prefix_hits,
+               "prefills": self._preport_prefills}
+        self._preport_installed = []
+        self._preport_evicted = []
+        self._prefix_hits = 0
+        self._preport_prefills = 0
+        return rep
 
     def _decode(self, rid: int, tokens_done: int, n: int) -> list:
         kv = self._kv.get(rid)
@@ -118,7 +198,7 @@ class ShardWorker:
         if kind == "work":
             self._on_work(msg[1], msg[2])
         elif kind == "prefill":
-            self._on_prefill(msg[1], msg[2])
+            self._on_prefill(msg[1], msg[2], msg[3])
         elif kind == "kv":
             self._on_kv(msg[1], msg[2])
         elif kind == "scale":
@@ -131,7 +211,8 @@ class ShardWorker:
 
     def _on_work(self, batch, free_rids) -> None:
         """Colocated/decode micro-batch: (rid, prompt_len, tokens_done,
-        n) per entry; results are one coalesced reply."""
+        n, phashes, hint) per entry; results are one coalesced reply
+        carrying the pending prefix report."""
         from ompi_tpu.ft import chaos
 
         if chaos.enabled:
@@ -140,31 +221,42 @@ class ShardWorker:
             # results unsent (tests/test_serving.py's victim schedule)
             chaos.kill_point("serve_work")
         results = []
-        for rid, prompt_len, tokens_done, n in batch:
+        for rid, prompt_len, tokens_done, n, phashes, hint in batch:
             if rid not in self._kv:
                 if self.role == "decode":
                     raise MpiError(
                         ErrorClass.ERR_INTERN,
                         f"decode work for rid {rid} before its KV block")
-                self._kv[rid] = self._prefill(rid, prompt_len)
+                self._kv[rid] = self._prefill_or_skip(rid, prompt_len,
+                                                      phashes, hint)
             toks = self._decode(rid, tokens_done, n)
             spc.record("serve_tokens", len(toks))
             results.append((rid, toks))
         for rid in free_rids:          # router-confirmed evictions
             self._kv.pop(rid, None)
-        self.comm.send_obj(("res", results), self.router, TAG_RES)
+        self.comm.send_obj(("res", results, self._take_preport()),
+                           self.router, TAG_RES)
 
-    def _on_prefill(self, epoch, batch) -> None:
-        """Prefill-stage micro-batch: compute each block, Pready it the
+    def _on_prefill(self, peer, epoch, batch) -> None:
+        """Prefill-stage micro-batch for ONE decode peer's slab:
+        compute each block (prefix cache consulted), Pready it the
         moment it is final, aggregate-flush the slab tail."""
-        self._sender.begin_epoch(epoch)
+        sender = self._senders.get(int(peer))
+        if sender is None:
+            raise MpiError(ErrorClass.ERR_ARG,
+                           f"prefill asked to stream to decode rank "
+                           f"{peer} but no slab pairing exists "
+                           f"(peers: {sorted(self._senders)})")
+        sender.begin_epoch(epoch)
         rids = []
-        for rid, slot, prompt_len in batch:
-            self._sender.write_slot(slot, self._prefill(rid, prompt_len))
-            self._sender.slot_ready(slot)
+        for rid, slot, prompt_len, phashes, hint in batch:
+            sender.write_slot(slot, self._prefill_or_skip(
+                rid, prompt_len, phashes, hint))
+            sender.slot_ready(slot)
             rids.append(rid)
-        self._sender.finish_epoch(wait=True)
-        self.comm.send_obj(("prefilled", epoch, rids), self.router,
+        sender.finish_epoch(wait=True)
+        self.comm.send_obj(("prefilled", epoch, rids,
+                            self._take_preport()), self.router,
                            TAG_RES)
 
     def _on_kv(self, epoch, batch) -> None:
@@ -238,14 +330,22 @@ class ShardWorker:
     def _recover(self) -> None:
         """Serve-through-failure, worker side: shrink with the other
         survivors, rebind, fall back to the colocated role (a stage
-        pair may have lost its other half), keep serving."""
-        for stream in (self._sender, self._receiver):
+        pair may have lost its other half), keep serving.  The prefix
+        store clears WITH a generation bump: every routing hint minted
+        against the pre-shrink store must miss, never alias."""
+        for stream in list(self._senders.values()) + [self._receiver]:
             if stream is not None:
                 try:
                     stream.free()
                 except Exception:
                     pass               # stream rode the dead comm
-        self._sender = self._receiver = None
+        self._senders = {}
+        self._receiver = None
+        self._prefix.clear()
+        self._preport_installed = []
+        self._preport_evicted = []
+        self._prefix_hits = 0
+        self._preport_prefills = 0
         new = self.comm.shrink()
         new.set_errhandler(ERRORS_RETURN)
         self.comm = new

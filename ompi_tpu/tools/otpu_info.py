@@ -111,6 +111,12 @@ def main(argv=None) -> int:
                          "STAGES), the stage-clock / sampling-profiler "
                          "MCA vars, and the perf-history file "
                          "otpu_perf reads")
+    ap.add_argument("--serving", action="store_true",
+                    help="Show the serving-fleet plane: the "
+                         "registry-enumerated serving MCA vars (prefix "
+                         "cache, autoscale policy) and the serving "
+                         "role/pool process sets the coordination "
+                         "service advertises")
     ap.add_argument("--psets", action="store_true",
                     help="Show the process sets the coordination service "
                          "advertises (name, size, membership source) — "
@@ -219,6 +225,20 @@ def main(argv=None) -> int:
                         f"{DEFAULT_HISTORY} (bench.py --history / "
                         "--ladder append; otpu_perf --diff/--check "
                         "compare)", p))
+
+    if args.all or args.serving:
+        # registry-enumerated like --telemetry/--profile: the serving
+        # var group (registered at ompi_tpu.serving import) plus the
+        # advertised serving role/pool psets — never a hand-kept list
+        import ompi_tpu.serving  # noqa: F401  (registers serving vars)
+
+        for var in registry.all_vars("serving"):
+            out.append(_fmt(f"serving var {var.name}",
+                            f"{var.value!r} — {var.help}", p))
+        for pname, size, source in _pset_rows():
+            if pname.startswith("mpi://serving/"):
+                out.append(_fmt(f"serving pset {pname}",
+                                f"size {size} (source {source})", p))
 
     if args.all or args.psets:
         for pname, size, source in _pset_rows():

@@ -162,6 +162,24 @@ def _host_cell(sample: dict) -> str:
     return f"{pct:.0f}%" if gil is None else f"{pct:.0f}%/{gil:.2f}"
 
 
+def _fleet_cell(sample: dict) -> str:
+    """Serving-fleet summary of a rank publishing the ``fleet`` key
+    (the fleet controller rank): total queued across pools + the
+    prefix-cache hit rate — 'q3/87%' (or '-' off the fleet rank)."""
+    fl = sample.get("fleet")
+    if not fl:
+        return "-"
+    pools = fl.get("pools") or {}
+    queued = sum(int(p.get("queued", 0)) for p in pools.values())
+    hits = sum(int((p.get("prefix") or {}).get("hits", 0))
+               for p in pools.values())
+    misses = sum(int((p.get("prefix") or {}).get("misses", 0))
+                 for p in pools.values())
+    if hits + misses:
+        return f"q{queued}/{100.0 * hits / (hits + misses):.0f}%"
+    return f"q{queued}/-"
+
+
 def render_table(session: TopSession, samples: dict, coll: str,
                  parsable: bool = False) -> str:
     """The per-rank live table (or ``:``-separated rows)."""
@@ -171,7 +189,7 @@ def render_table(session: TopSession, samples: dict, coll: str,
         out = []
         for rank, s, stale in rows:
             if s is None:
-                out.append(f"{rank}:-:-:-:-:-:-:-:{int(stale)}")
+                out.append(f"{rank}:-:-:-:-:-:-:-:-:{int(stale)}")
                 continue
             tcp = s.get("tcp") or {}
             chaos = s.get("chaos") or {}
@@ -181,17 +199,19 @@ def render_table(session: TopSession, samples: dict, coll: str,
                 round(_byte_rate(s), 1),
                 _coll_cell(s, coll), tcp.get("outq_frags", 0),
                 sum(chaos.values()),
-                "-" if pct is None else round(pct, 1), int(stale))))
+                "-" if pct is None else round(pct, 1),
+                _fleet_cell(s), int(stale))))
         return "\n".join(out)
     hdr = (f"{'rank':>4}  {'seq':>6}  {'msg/s':>8}  {'bytes/s':>8}  "
            f"{coll + ' p50/p99':>16}  {'outq':>5}  {'stage':>6}  "
-           f"{'serveq':>6}  {'chaos':>5}  {'host%/gil':>10}  flag")
+           f"{'serveq':>6}  {'chaos':>5}  {'host%/gil':>10}  "
+           f"{'fleet':>8}  flag")
     lines = [hdr]
     for rank, s, stale in rows:
         if s is None:
             lines.append(f"{rank:>4}  {'-':>6}  {'-':>8}  {'-':>8}  "
                          f"{'-':>16}  {'-':>5}  {'-':>6}  {'-':>6}  "
-                         f"{'-':>5}  {'-':>10}  STALE")
+                         f"{'-':>5}  {'-':>10}  {'-':>8}  STALE")
             continue
         tcp = s.get("tcp") or {}
         staging = s.get("staging") or {}
@@ -207,6 +227,7 @@ def render_table(session: TopSession, samples: dict, coll: str,
             f"{serving.get('queued', '-'):>6}  "
             f"{sum(chaos.values()):>5}  "
             f"{_host_cell(s):>10}  "
+            f"{_fleet_cell(s):>8}  "
             f"{'STALE' if stale else 'ok'}")
     return "\n".join(lines)
 

@@ -463,6 +463,17 @@ def main(argv=None) -> int:
                     help="Serving role flag: publish the given ranks as "
                          "the 'mpi://serving/workers' pset (the serving "
                          "router's model-shard worker table)")
+    ap.add_argument("--pool", action="append", default=[],
+                    metavar="MODEL:RANKS", dest="pool",
+                    help="Fleet pool flag (repeatable): publish the "
+                         "given ranks as the "
+                         "'mpi://serving/pool/<MODEL>' pset — one "
+                         "per-model worker pool of the serving fleet "
+                         "(ompi_tpu.serving.fleet resolves pool "
+                         "placement from these, the way roles() "
+                         "resolves the router).  Same RANKS syntax as "
+                         "--pset: comma list with ranges, "
+                         "'llama:1,3-4'")
     ap.add_argument("--device-world", action="store_true",
                     dest="device_world",
                     help="Boot a multi-process device world: every rank "
@@ -542,6 +553,12 @@ def main(argv=None) -> int:
         if flag:
             _, pranks = _parse_pset(f"serving:{flag}", args.nprocs)
             server.publish_pset(pset_name, pranks, source="user")
+    # fleet pool psets (ompi_tpu.serving.fleet.pool_specs_from_psets):
+    # one mpi://serving/pool/<model> set per --pool flag
+    for spec_s in args.pool:
+        model, pranks = _parse_pset(spec_s, args.nprocs)
+        server.publish_pset(f"mpi://serving/pool/{model}", pranks,
+                            source="user")
 
     if args.device_world:
         # jax.distributed coordinator lives INSIDE rank 0's process;

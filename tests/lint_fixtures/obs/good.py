@@ -38,6 +38,7 @@ _rh("help-flight", "good-reason", "Dump at {path}.")
 
 def publish(telemetry):
     telemetry.register_source("tcp", dict)    # declared in SCHEMA
+    telemetry.register_source("fleet", dict)  # the fleet control plane
 
 
 def crash(flight):

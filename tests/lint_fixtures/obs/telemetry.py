@@ -4,4 +4,5 @@ schema the register_source rule checks literal names against."""
 SCHEMA = {
     "tcp": "transport out-queue depth",
     "serving": "scheduler queue depth",
+    "fleet": "serving-fleet pool/prefix/autoscale tables",
 }

@@ -1,0 +1,206 @@
+"""serving/prefix_cache — pure units, no MPI boot.
+
+The prefix cache is the correctness-sensitive half of prefix-aware
+routing, so it gets exhaustive unit coverage in isolation: hash
+stability ACROSS PROCESSES (the router, every worker, and a respawned
+replacement must all name a prefix identically), block-granularity
+boundary cases, the generation-mismatch fallback (a stale hint must be
+a perf miss, never wrong KV), and registry invalidation along the
+eviction-notice and shrink/re-shard paths.
+"""
+import subprocess
+import sys
+
+from ompi_tpu.serving.prefix_cache import (PrefixRegistry, PrefixStore,
+                                           block_hashes)
+
+B = 4   # explicit block size: the tests must not depend on the MCA var
+
+
+# ------------------------------------------------------------- hashing
+
+def test_block_hashes_boundaries():
+    toks = list(range(10))
+    # only FULL blocks hash: 10 tokens at block 4 -> 2 digests
+    assert len(block_hashes(toks, B)) == 2
+    assert block_hashes(toks[:3], B) == ()          # under one block
+    assert len(block_hashes(toks[:4], B)) == 1      # exactly one block
+    assert len(block_hashes(toks[:7], B)) == 1      # partial tail drops
+    assert len(block_hashes(toks[:8], B)) == 2
+    assert block_hashes((), B) == ()
+
+
+def test_block_hashes_chain_is_prefix_sensitive():
+    a = block_hashes([1, 2, 3, 4, 5, 6, 7, 8], B)
+    b = block_hashes([1, 2, 3, 4, 9, 9, 9, 9], B)
+    c = block_hashes([0, 2, 3, 4, 5, 6, 7, 8], B)
+    assert a[0] == b[0], "shared first block must share its digest"
+    assert a[1] != b[1], "diverging second block must diverge"
+    assert a[0] != c[0], "first-token change must change block 0"
+    # the chain makes digest i cover the WHOLE prefix, not block i
+    # alone: same second block after different first blocks differs
+    d = block_hashes([9, 9, 9, 9, 5, 6, 7, 8], B)
+    assert a[1] != d[1]
+
+
+def test_block_hashes_stable_across_processes():
+    """The digests must be process-stable (blake2b over packed tokens,
+    never Python's salted hash()) — a respawned worker and the router
+    must agree on every prefix name."""
+    toks = [17, 4093, 0, 88, 17, 17, 2, 9]
+    here = block_hashes(toks, B)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from ompi_tpu.serving.prefix_cache import block_hashes\n"
+         f"print(','.join(block_hashes({toks!r}, {B})))"],
+        capture_output=True, text=True, timeout=120,
+        env={"PYTHONPATH": ":".join(sys.path), "JAX_PLATFORMS": "cpu",
+             "PYTHONHASHSEED": "random"})
+    assert out.returncode == 0, out.stderr
+    assert tuple(out.stdout.strip().split(",")) == here
+
+
+# ------------------------------------------------------------ registry
+
+def test_registry_longest_prefix_lookup():
+    reg = PrefixRegistry(capacity=32)
+    h8 = block_hashes(list(range(8)), B)      # 2 blocks
+    h12 = block_hashes(list(range(12)), B)    # 3 blocks, extends h8
+    reg.insert(h8, worker=3, generation=1)
+    hit = reg.lookup(h12)
+    assert hit is not None
+    assert (hit.worker, hit.generation, hit.blocks) == (3, 1, 2)
+    assert hit.hash == h8[1], "deepest registered block wins"
+    reg.insert(h12, worker=4, generation=2)
+    hit = reg.lookup(h12)
+    assert (hit.worker, hit.blocks) == (4, 3)
+    # an unrelated prompt misses (and the miss is counted)
+    assert reg.lookup(block_hashes([99] * 8, B)) is None
+    st = reg.stats()
+    assert st["hits"] == 2 and st["misses"] == 1
+    assert 0 < st["hit_rate"] < 1
+    assert reg.lookup(()) is None             # promptless: no count
+    assert reg.stats()["misses"] == 1
+
+
+def test_registry_lru_capacity():
+    reg = PrefixRegistry(capacity=3)
+    chains = [block_hashes([i] * 4, B) for i in range(5)]
+    for i, ch in enumerate(chains):
+        reg.insert(ch, worker=i, generation=0)
+    assert len(reg) == 3
+    assert reg.lookup(chains[0]) is None      # oldest evicted
+    assert reg.lookup(chains[4]) is not None
+
+
+def test_registry_forget_respects_owner():
+    """A late eviction notice from worker A must not kill worker B's
+    fresh entry under the same hash (the idempotent piggyback
+    channel can replay old notices)."""
+    reg = PrefixRegistry(capacity=8)
+    ch = block_hashes([5] * 4, B)
+    reg.insert(ch, worker=1, generation=0)
+    reg.forget(ch, worker=2)                  # wrong owner: ignored
+    assert reg.lookup(ch).worker == 1
+    reg.insert(ch, worker=2, generation=3)    # B took the block over
+    reg.forget(ch, worker=1)                  # stale notice from A
+    assert reg.lookup(ch).worker == 2
+    reg.forget(ch, worker=2)
+    assert reg.lookup(ch) is None
+    reg.forget(ch, worker=2)                  # idempotent
+
+
+def test_registry_invalidation_paths():
+    """The shrink/re-shard and retire paths: per-worker and wholesale
+    invalidation drop exactly the right entries."""
+    reg = PrefixRegistry(capacity=32)
+    ch1 = block_hashes([1] * 8, B)
+    ch2 = block_hashes([2] * 8, B)
+    reg.insert(ch1, worker=1, generation=0)
+    reg.insert(ch2, worker=2, generation=0)
+    assert reg.invalidate_worker(1) == 2      # both of ch1's blocks
+    assert reg.lookup(ch1) is None
+    assert reg.lookup(ch2) is not None
+    reg.invalidate_all()
+    assert reg.lookup(ch2) is None and len(reg) == 0
+    assert reg.stats()["invalidated"] == 4
+
+
+# -------------------------------------------------------------- store
+
+def test_store_generation_mismatch_falls_back():
+    """THE correctness property: a hint minted against an older store
+    lifetime (worker recovered / re-sharded) must MISS — stale routing
+    state degrades to a full prefill, never to wrong KV."""
+    store = PrefixStore(capacity=8)
+    ch = block_hashes([7] * 8, B)
+    store.add_all(ch)
+    gen = store.generation
+    assert store.has(ch[1], gen)
+    store.clear()                             # recovery path
+    assert store.generation == gen + 1
+    assert not store.has(ch[1], gen), "old-generation hint matched"
+    store.add_all(ch)                         # re-prefilled post-shrink
+    assert not store.has(ch[1], gen), \
+        "pre-shrink generation must never match again"
+    assert store.has(ch[1], store.generation)
+
+
+def test_store_lru_eviction_reports_evicted():
+    """Evicted hashes must surface to the caller — they become the
+    eviction notices that keep the router's registry honest."""
+    store = PrefixStore(capacity=2)
+    h = [block_hashes([i] * 4, B)[0] for i in range(4)]
+    assert store.add_all(h[:2]) == []
+    assert store.add_all([h[2]]) == [h[0]]
+    assert not store.has(h[0], store.generation)
+    # touching an entry refreshes it: h[1] survives, h[2] goes
+    assert store.has(h[1], store.generation)
+    assert store.add_all([h[3]]) == [h[2]]
+    assert store.has(h[1], store.generation)
+
+
+def test_worker_prefill_skip_and_stale_hint_fallback():
+    """ShardWorker._prefill_or_skip against a bare store (no comm):
+    verified hint skips the full pass, stale hint does the full pass,
+    both install the prompt's blocks and queue the report."""
+    from ompi_tpu.runtime import spc
+    from ompi_tpu.serving.prefix_cache import PrefixStore
+    from ompi_tpu.serving.worker import ShardWorker, toy_kv
+    import numpy as np
+
+    wk = ShardWorker.__new__(ShardWorker)
+    wk.kv_elems = 16
+    wk._prefix = PrefixStore(capacity=8)
+    wk._prefix_hits = 0
+    wk._preport_installed, wk._preport_evicted = [], []
+    wk._preport_prefills = 0
+    ch = block_hashes(list(range(8)), B)
+    spc.init()
+    prefills0 = spc.read("serve_prefills")
+    # cold: full prefill, blocks installed
+    kv = wk._prefill_or_skip(11, 8, ch, None)
+    np.testing.assert_array_equal(kv, toy_kv(11, 16))
+    assert spc.read("serve_prefills") == prefills0 + 1
+    rep = wk._take_preport()
+    assert rep["prefills"] == 1 and rep["hits"] == 0
+    assert list(ch) == list(rep["installed"])
+    # warm with a VERIFIED hint: skip (kv still bit-exact)
+    kv = wk._prefill_or_skip(12, 8, ch, (ch[1], wk._prefix.generation,
+                                         2))
+    np.testing.assert_array_equal(kv, toy_kv(12, 16))
+    assert spc.read("serve_prefills") == prefills0 + 1, "hit prefilled"
+    assert wk._take_preport()["hits"] == 1
+    # stale hint (generation bumped): full prefill fallback
+    wk._prefix.clear()
+    kv = wk._prefill_or_skip(13, 8, ch, (ch[1], 0, 2))
+    np.testing.assert_array_equal(kv, toy_kv(13, 16))
+    assert spc.read("serve_prefills") == prefills0 + 2
+    rep = wk._take_preport()
+    assert rep["hits"] == 0 and rep["prefills"] == 1
+
+
+def test_degenerate_capacities_clamp():
+    # degenerate capacities clamp to >= 1 rather than thrash-evict
+    assert PrefixRegistry(capacity=0).capacity == 1
+    assert PrefixStore(capacity=-3).capacity == 1
