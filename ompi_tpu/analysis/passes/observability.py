@@ -35,6 +35,13 @@ Three contracts the observability stack depends on:
    vocabulary is closed so otpu_analyze's pack/queue/wire/parse/deliver
    decomposition keeps a stable meaning (and the runtime rejects an
    undeclared stage loudly; this catches it before it runs).
+
+7. **Flow-key categories come from the declared registry**: every
+   literal category passed to ``trace.flow_start``/``trace.flow_finish``
+   must be a key of the ``FLOW_CATEGORIES`` table in
+   ``runtime/trace.py`` — each category documents its id format, and
+   ``otpu_analyze`` parses flow ids by category, so an undeclared
+   category would emit arrows the critical-path graph silently drops.
 """
 from __future__ import annotations
 
@@ -65,7 +72,8 @@ class ObservabilityPass(AnalysisPass):
                    "telemetry source names come from the declared "
                    "SCHEMA, flight-recorder dump reasons are "
                    "help-flight-registered, profile stage names come "
-                   "from the declared STAGES table")
+                   "from the declared STAGES table, flow-key categories "
+                   "come from the declared FLOW_CATEGORIES registry")
 
     def run(self, pkg: Package) -> list[Finding]:
         registered: set[tuple] = set()
@@ -75,6 +83,8 @@ class ObservabilityPass(AnalysisPass):
         schema_declared = False
         stages: set[str] = set()
         stages_declared = False
+        flows: set[str] = set()
+        flows_declared = False
         for mod in pkg.modules:
             aliases = _register_aliases(mod)
             for node in ast.walk(mod.tree):
@@ -110,6 +120,18 @@ class ObservabilityPass(AnalysisPass):
                             s = const_str(k)
                             if s:
                                 stages.add(s)
+            if mod.path.replace("\\", "/").endswith("trace.py"):
+                for stmt in mod.tree.body:
+                    if isinstance(stmt, ast.Assign) \
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "FLOW_CATEGORIES"
+                                    for t in stmt.targets) \
+                            and isinstance(stmt.value, ast.Dict):
+                        flows_declared = True
+                        for k in stmt.value.keys:
+                            s = const_str(k)
+                            if s:
+                                flows.add(s)
             if mod.path.replace("\\", "/").endswith("telemetry.py"):
                 for stmt in mod.tree.body:
                     if isinstance(stmt, ast.Assign) \
@@ -135,12 +157,14 @@ class ObservabilityPass(AnalysisPass):
                 out.extend(self._check_fn(mod, fn, qual, registered,
                                           counters, counters_declared,
                                           schema, schema_declared,
-                                          stages, stages_declared))
+                                          stages, stages_declared,
+                                          flows, flows_declared))
         return out
 
     def _check_fn(self, mod, fn, qual, registered, counters,
                   counters_declared, schema, schema_declared,
-                  stages, stages_declared) -> list:
+                  stages, stages_declared, flows,
+                  flows_declared) -> list:
         out = []
         begins: dict[str, ast.AST] = {}
         consumed: set[str] = set()
@@ -213,6 +237,18 @@ class ObservabilityPass(AnalysisPass):
                     for sub in ast.walk(arg):
                         if isinstance(sub, ast.Name):
                             consumed.add(sub.id)
+            elif short in ("flow_start", "flow_finish") and node.args \
+                    and flows_declared:
+                fname_lit = const_str(node.args[0])
+                if fname_lit and fname_lit not in flows:
+                    out.append(Finding(
+                        self.name, mod.path, node.lineno,
+                        node.col_offset,
+                        f"flow category {fname_lit!r} is not declared "
+                        "in runtime/trace.py FLOW_CATEGORIES — flow "
+                        "ids are parsed per declared category, an "
+                        "undeclared one emits arrows the critical-path "
+                        "graph silently drops", qual))
             elif short in ("span", "hist_record"):
                 for arg in list(node.args) + [kw.value for kw in
                                               node.keywords]:

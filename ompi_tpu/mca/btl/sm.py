@@ -25,7 +25,7 @@ from ompi_tpu.base.containers import Fifo
 from ompi_tpu.base.var import VarType
 from ompi_tpu.ft import chaos
 from ompi_tpu.mca.btl.base import CTL, Btl, Endpoint, Frag, owned_bytes
-from ompi_tpu.runtime import profile
+from ompi_tpu.runtime import profile, trace
 from ompi_tpu.runtime.hotpath import hot_path
 
 _HDR = struct.Struct("<QQ")  # head, tail
@@ -343,15 +343,29 @@ class SmBtl(Btl):
                     (hdr, owned_bytes(frag.data)))
         if profile.enabled:
             profile.stage_span("send.queue", _pt)
-            _pt = profile.now()
+        # the ring write is sm's "wire": traced like tcp's btl_sendmsg
+        # so the critical path's wire bucket sees same-host traffic too
+        # (the frame header carries the flow key ride-along — the full
+        # pickled (src, seq) match header, see _frame_hdr)
+        _t0 = trace.now() if (trace.enabled or profile.enabled) else 0
         if not ring.push_frame(hdr, frag.data):
             # defer with an OWNED payload copy: the caller's request may
             # complete (eager) and the user reuse the buffer before the
             # retry fires from the progress loop
             self._pending.setdefault(ep.world_rank, Fifo()).push(
                 (hdr, owned_bytes(frag.data)))
-        if profile.enabled:
-            profile.stage_span("send.wire", _pt)
+        if trace.enabled or profile.enabled:
+            t1 = trace.now()
+            if trace.enabled:
+                nb = getattr(frag.data, "nbytes", None)
+                if nb is None:
+                    nb = len(frag.data)
+                trace.span("btl_ringpush", "btl", _t0, t1,
+                           args={"nbytes": int(nb),
+                                 "peer": ep.world_rank})
+                trace.hist_record("btl_ringpush", int(nb), t1 - _t0)
+            if profile.enabled:
+                profile.stage_span("send.wire", _t0, t1)
         self._ring_doorbell(ep.world_rank, ep.addr)
 
     @hot_path

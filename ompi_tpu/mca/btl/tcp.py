@@ -15,6 +15,12 @@ fragment, so fast and pickle headers coexist on one connection)::
     htype 0  := [u32 hlen][pickle header]          (exotic meta, handshake)
     htype 1  := [_FAST struct: cid,src,dst,tag,seq,kind,total,off,req_id]
 
+Both header forms carry the otpu-crit flow key ride-along for free:
+``(src, seq)`` together with ``cid``/``dst`` IS the ``cid.src.dst.seq``
+message key the pml stamps on its send span and the recv side closes at
+delivery (``runtime/trace.py`` FLOW_CATEGORIES) — no extra framing
+bytes, the match header always carried it.
+
 The fast header covers the common contiguous-frag cases — eager MATCH
 (empty meta) and RNDV-continuation FRAG (``{"req_id": int}``) — which
 carry all the payload bytes; anything else (ACK/CTL/RGET metas, FT
@@ -566,8 +572,13 @@ class TcpBtl(Btl):
             if trace.enabled or profile.enabled:
                 t1 = time.perf_counter_ns()
                 if trace.enabled:
+                    # peer rides along so otpu_analyze's critical-path
+                    # wire bucket can attribute syscall time to the
+                    # rank the bytes went to (-1: pre-handshake conn)
                     trace.span("btl_sendmsg", "btl", t0, t1,
-                               args={"nbytes": n, "iov": len(bufs)})
+                               args={"nbytes": n, "iov": len(bufs),
+                                     "peer": conn.rank
+                                     if conn.rank is not None else -1})
                     trace.hist_record("btl_sendmsg", n, t1 - t0)
                 if profile.enabled:
                     profile.stage_span("send.wire", t0, t1)

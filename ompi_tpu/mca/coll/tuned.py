@@ -51,6 +51,113 @@ def _nbytes(buf) -> int:
     return n if n is not None else np.asarray(buf).nbytes
 
 
+def default_algorithm(coll: str, comm_size: int, nbytes: int,
+                      commute: bool = True,
+                      per_block: int = None) -> str:
+    """The fixed decision ladder's pick for one (coll, comm_size,
+    nbytes) cell — the ``decision_fixed.c`` tables as a pure function.
+
+    ONE home for the ladder: the per-communicator :class:`TunedModule`
+    dispatch methods call it on every invocation, and ``otpu_analyze
+    --suggest-ladder`` calls it to name the incumbent algorithm for the
+    critical-path-hot cells its draft rules file pins (a rules file
+    that disagreed with the ladder it documents would be a lie).
+
+    ``per_block`` is the alltoall per-destination block size (derived
+    from ``nbytes / comm_size`` when not supplied — the dispatch method
+    passes the exact value).
+    """
+    if coll == "allreduce":
+        if not commute:
+            # ring/Rabenseifner reorder operands -> excluded (:77-80)
+            return "nonoverlapping" if comm_size <= 4 \
+                else "recursive_doubling"
+        if nbytes <= 4096:
+            # boundary inclusive: rd measured ~1.9x rabenseifner at
+            # exactly 4KB on the 4-rank host path (matches the lane)
+            return "recursive_doubling"
+        if nbytes < (512 << 10):
+            return "rabenseifner"
+        if nbytes < (4 << 20):
+            return "ring"
+        return "ring_segmented"
+    if coll == "bcast":
+        if nbytes < 2048 or comm_size <= 4:
+            return "binomial"
+        return "scatter_allgather" if nbytes < (1 << 20) else "chain"
+    if coll == "reduce":
+        if not commute:
+            # binomial reorders; pipeline and linear are rank-ordered
+            return "linear" if nbytes < (64 << 10) else "pipeline"
+        return "binomial" if nbytes < (64 << 10) else "pipeline"
+    if coll == "allgather":
+        if comm_size <= 2:
+            return "linear"
+        if nbytes < 1024:
+            return "bruck"
+        if nbytes < (512 << 10):
+            return "recursive_doubling"  # falls to bruck for non-pof2
+        return "neighbor"                # falls to ring for odd sizes
+    if coll == "alltoall":
+        if per_block is None:
+            per_block = nbytes // max(1, comm_size)
+        if comm_size <= 2:
+            return "linear"
+        return "bruck" if per_block < 256 else "pairwise"
+    if coll == "barrier":
+        return "recursive_doubling" \
+            if not (comm_size & (comm_size - 1)) else "bruck"
+    if coll == "reduce_scatter":
+        if not commute:
+            return "basic"           # reduce+scatter keeps rank order
+        return "recursive_halving" if nbytes < (64 << 10) else "ring"
+    if coll in ("gather", "scatter"):
+        return "binomial" if nbytes < (64 << 10) else "linear"
+    raise KeyError(f"no fixed ladder for collective {coll!r}")
+
+
+def ladder_rules(coll: str, comm_size: int, cap_bytes: int,
+                 commute: bool = True) -> list[tuple[int, str]]:
+    """The fixed ladder as ascending ``(max_bytes, algorithm)`` rule
+    rows whose first-match-wins evaluation reproduces
+    :func:`default_algorithm` EXACTLY for every ``nbytes <= cap_bytes``
+    (sizes above the cap fall through the rule list back to the fixed
+    ladder itself, which picks the same incumbent — so a rules file
+    built from these rows is behavior-identical by construction).
+
+    ``otpu_analyze --suggest-ladder`` uses this: emitting only a hot
+    cell's own row would silently extend that cell's pick to every
+    smaller message (the grammar has no lower bound); emitting the
+    whole breakpoint table keeps the draft honest.
+
+    Thresholds are powers of two in total bytes (``<=`` or ``<``
+    style) or per-destination-block bytes (alltoall: pow2 times
+    ``comm_size``), so probing each boundary's two sides at ``2^k``
+    and ``2^k * comm_size`` finds every breakpoint."""
+    probes: set = set()
+    n = 1
+    while n <= (1 << 40):
+        probes.update((n, n + 1, n * max(1, comm_size),
+                       n * max(1, comm_size) + 1))
+        n <<= 1
+    rows: list[tuple[int, str]] = []
+    cur = default_algorithm(coll, comm_size, 0, commute)
+    last_max = -1
+    for probe in sorted(probes):
+        if last_max >= cap_bytes:
+            break
+        alg = default_algorithm(coll, comm_size, probe, commute)
+        if alg != cur:
+            rows.append((probe - 1, cur))
+            last_max = probe - 1
+            cur = alg
+    if last_max < cap_bytes:
+        # close the table at the cap (0 = unbounded, which is exactly
+        # right for a size-independent pick like barrier's)
+        rows.append((int(cap_bytes), cur))
+    return rows
+
+
 class TunedModule:
     """Per-communicator module: ladder dispatch over the algorithm menu.
 
@@ -69,15 +176,25 @@ class TunedModule:
 
     # -- decision machinery ---------------------------------------------
     def _pick(self, coll: str, comm_size: int, nbytes: int,
-              default: str) -> tuple[str, int]:
+              default: str, commute: bool = True) -> tuple[str, int]:
         """(algorithm, rule segsize) — segsize 0 means 'use the MCA var'.
         ``nbytes`` is the TOTAL payload per rank for every collective
-        (alltoall included), matching the rule file's max_bytes column."""
+        (alltoall included), matching the rule file's max_bytes column.
+
+        Dynamic rules apply to COMMUTATIVE reductions only: the rule
+        grammar cannot express commutativity, and a measured schedule
+        for commutative traffic (ring/Rabenseifner/binomial reorder
+        operands) would silently produce wrong answers on a
+        non-commutative op — those always take the fixed ladder's
+        order-safe picks.  A force-var is the user's explicit override
+        and still applies."""
         _pt = profile.now() if profile.enabled else 0
         try:
             forced = self._c.force_var(coll)
             if forced:
                 return forced, 0
+            if not commute:
+                return default, 0
             for (rcoll, max_size, max_bytes, alg, seg) in self._c.rules:
                 if rcoll != coll:
                     continue
@@ -131,21 +248,10 @@ class TunedModule:
                 return algs.allreduce_recursive_doubling(comm, sendbuf, op)
             finally:
                 profile.stage_span("coll.alg", _pt)
-        if not op.commute:
-            # ring/Rabenseifner reorder operands -> excluded (:77-80)
-            default = "nonoverlapping" if comm.size <= 4 \
-                else "recursive_doubling"
-        elif nbytes <= 4096:
-            # boundary inclusive: rd measured ~1.9x rabenseifner at
-            # exactly 4KB on the 4-rank host path (matches the lane)
-            default = "recursive_doubling"
-        elif nbytes < (512 << 10):
-            default = "rabenseifner"
-        elif nbytes < (4 << 20):
-            default = "ring"
-        else:
-            default = "ring_segmented"
-        alg, seg = self._pick("allreduce", comm.size, nbytes, default)
+        default = default_algorithm("allreduce", comm.size, nbytes,
+                                    op.commute)
+        alg, seg = self._pick("allreduce", comm.size, nbytes, default,
+                              commute=op.commute)
         if alg == "ring_segmented":
             return self._run(
                 "allreduce", alg, default, comm, sendbuf, op,
@@ -154,12 +260,7 @@ class TunedModule:
 
     def bcast(self, comm, buf, root=0):
         nbytes = _nbytes(buf)
-        if nbytes < 2048 or comm.size <= 4:
-            default = "binomial"
-        elif nbytes < (1 << 20):
-            default = "scatter_allgather"
-        else:
-            default = "chain"
+        default = default_algorithm("bcast", comm.size, nbytes)
         alg, seg = self._pick("bcast", comm.size, nbytes, default)
         if alg == "chain":
             return self._run("bcast", alg, default, comm, buf, root,
@@ -168,14 +269,10 @@ class TunedModule:
 
     def reduce(self, comm, sendbuf, op=op_mod.SUM, root=0):
         nbytes = _nbytes(sendbuf)
-        if not op.commute:
-            # binomial reorders; pipeline and linear are rank-ordered
-            default = "linear" if nbytes < (64 << 10) else "pipeline"
-        elif nbytes < (64 << 10):
-            default = "binomial"
-        else:
-            default = "pipeline"
-        alg, seg = self._pick("reduce", comm.size, nbytes, default)
+        default = default_algorithm("reduce", comm.size, nbytes,
+                                    op.commute)
+        alg, seg = self._pick("reduce", comm.size, nbytes, default,
+                              commute=op.commute)
         if alg == "pipeline":
             return self._run("reduce", alg, default, comm, sendbuf, op,
                              root, segsize=seg or self._c.segsize("reduce"))
@@ -183,14 +280,7 @@ class TunedModule:
 
     def allgather(self, comm, sendbuf):
         nbytes = _nbytes(sendbuf)
-        if comm.size <= 2:
-            default = "linear"
-        elif nbytes < 1024:
-            default = "bruck"
-        elif nbytes < (512 << 10):
-            default = "recursive_doubling"  # falls to bruck for non-pof2
-        else:
-            default = "neighbor"            # falls to ring for odd sizes
+        default = default_algorithm("allgather", comm.size, nbytes)
         alg, _ = self._pick("allgather", comm.size, nbytes, default)
         return self._run("allgather", alg, default, comm, sendbuf)
 
@@ -198,42 +288,34 @@ class TunedModule:
         stack = np.asarray(sendbuf)
         nbytes = stack.nbytes   # total, like every other collective
         per_block = nbytes // max(1, stack.shape[0] if stack.ndim else 1)
-        if comm.size <= 2:
-            default = "linear"
-        elif per_block < 256:
-            default = "bruck"
-        else:
-            default = "pairwise"
+        default = default_algorithm("alltoall", comm.size, nbytes,
+                                    per_block=per_block)
         alg, _ = self._pick("alltoall", comm.size, nbytes, default)
         return self._run("alltoall", alg, default, comm, sendbuf)
 
     def barrier(self, comm):
-        default = "recursive_doubling" \
-            if not (comm.size & (comm.size - 1)) else "bruck"
+        default = default_algorithm("barrier", comm.size, 0)
         alg, _ = self._pick("barrier", comm.size, 0, default)
         return self._run("barrier", alg, default, comm)
 
     def reduce_scatter(self, comm, sendbuf, recvcounts=None, op=op_mod.SUM):
         nbytes = _nbytes(sendbuf)
-        if not op.commute:
-            default = "basic"            # reduce+scatter keeps rank order
-        elif nbytes < (64 << 10):
-            default = "recursive_halving"
-        else:
-            default = "ring"
-        alg, _ = self._pick("reduce_scatter", comm.size, nbytes, default)
+        default = default_algorithm("reduce_scatter", comm.size, nbytes,
+                                    op.commute)
+        alg, _ = self._pick("reduce_scatter", comm.size, nbytes,
+                            default, commute=op.commute)
         return self._run("reduce_scatter", alg, default,
                          comm, sendbuf, recvcounts, op)
 
     def gather(self, comm, sendbuf, root=0):
         nbytes = _nbytes(sendbuf)
-        default = "binomial" if nbytes < (64 << 10) else "linear"
+        default = default_algorithm("gather", comm.size, nbytes)
         alg, _ = self._pick("gather", comm.size, nbytes, default)
         return self._run("gather", alg, default, comm, sendbuf, root)
 
     def scatter(self, comm, sendbuf, root=0):
         nbytes = _nbytes(sendbuf)
-        default = "binomial" if nbytes < (64 << 10) else "linear"
+        default = default_algorithm("scatter", comm.size, nbytes)
         alg, _ = self._pick("scatter", comm.size, nbytes, default)
         return self._run("scatter", alg, default, comm, sendbuf, root)
 

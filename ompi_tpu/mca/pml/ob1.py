@@ -64,6 +64,7 @@ class RecvRequest(Request):
         self.received = 0
         self.total = None               # known after match
         self.matched_src = None
+        self._flow = None               # (cid, src, dst, seq) at deliver
 
     def matches(self, frag: Frag, comm_src: int) -> bool:
         if self.source != ANY_SOURCE and self.source != comm_src:
@@ -209,14 +210,7 @@ class Ob1Pml:
         and cannot observe the match)."""
         spc.record("isend")
         req = SendRequest(self, comm, buf, dest, tag)
-        if trace.enabled:
-            # span closes at request completion, whichever protocol leg
-            # (eager inline, RNDV ACK, RGET done/pull) completes it
-            _t0 = trace.now()
-            req.on_complete(lambda r, _t0=_t0: trace.span(
-                "send", "pml", _t0,
-                args={"nbytes": r.nbytes, "dest": r.dest, "tag": r.tag,
-                      "cid": r.comm.cid}))
+        _t0 = trace.now() if trace.enabled else 0
         dst_world = (comm.remote_group if comm.is_inter
                      else comm.group).world_rank(dest)
         src_world = comm.world_rank(comm.rank)
@@ -231,6 +225,28 @@ class Ob1Pml:
                         dest=dest, tag=tag)
         seq = next(self._seq.setdefault(
             (comm.cid, src_world, dst_world), itertools.count()))
+        if trace.enabled:
+            # span closes at request completion, whichever protocol leg
+            # (eager inline, RNDV ACK, RGET done/pull) completes it.
+            # With the flow layer armed the span carries the message's
+            # flow key — the (cid, src, dst, per-peer seq) stamped on
+            # its btl match header — and emits the flow-arrow start
+            # anchored at the span's own end.  The key stays a tuple on
+            # this @hot_path (flow_start renders the Chrome id string).
+            fkey = ((comm.cid, src_world, dst_world, seq)
+                    if trace.flow_enabled else None)
+
+            def _send_span(r, _t0=_t0, fkey=fkey):
+                t1 = trace.now()
+                eargs = {"nbytes": r.nbytes, "dest": r.dest,
+                         "tag": r.tag, "cid": r.comm.cid}
+                if fkey is not None:
+                    eargs["fid"] = fkey
+                trace.span("send", "pml", _t0, t1, args=eargs)
+                if fkey is not None:
+                    trace.flow_start("pml_msg", fkey, t1)
+
+            req.on_complete(_send_span)
         spc.record("bytes_sent", req.nbytes)
         rget_limit = self.component.rget_limit()
         if (rget_limit and not sync
@@ -402,10 +418,22 @@ class Ob1Pml:
         req = RecvRequest(self, comm, buf, source, tag)
         if trace.enabled:
             _t0 = trace.now()
-            req.on_complete(lambda r, _t0=_t0: trace.span(
-                "recv", "pml", _t0,
-                args={"nbytes": r.received, "source": r.status.source,
-                      "tag": r.tag, "cid": r.comm.cid}))
+
+            def _recv_span(r, _t0=_t0):
+                t1 = trace.now()
+                eargs = {"nbytes": r.received, "source": r.status.source,
+                         "tag": r.tag, "cid": r.comm.cid}
+                fl = r._flow
+                if fl is not None and trace.flow_enabled:
+                    # the sender's stamp rode the match header; closing
+                    # the same key here is what lets the merged timeline
+                    # draw the send-complete -> recv-delivery arrow
+                    eargs["fid"] = fl
+                trace.span("recv", "pml", _t0, t1, args=eargs)
+                if fl is not None and trace.flow_enabled:
+                    trace.flow_finish("pml_msg", fl, t1)
+
+            req.on_complete(_recv_span)
         dst_world = comm.world_rank(comm.rank)
         key = (comm.cid, dst_world)
         if peruse.active():
@@ -590,6 +618,10 @@ class Ob1Pml:
         comm_src = (req.comm.remote_group if req.comm.is_inter
                     else req.comm.group).rank_of(frag.src)
         req.matched_src = frag.src
+        if trace.flow_enabled:
+            # the flow key off the match header (MATCH/RNDV/RGET all
+            # carry the pml sequence); the recv span closes it
+            req._flow = (frag.cid, frag.src, frag.dst, frag.seq)
         req.total = frag.total_len or len(frag.data)
         req.status.source = comm_src
         req.status.tag = frag.tag

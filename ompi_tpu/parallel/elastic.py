@@ -43,6 +43,7 @@ from typing import Optional
 import numpy as np
 
 from ompi_tpu.api.errhandler import ERRORS_RETURN
+from ompi_tpu.runtime import trace
 from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
                                  RevokedError)
 from ompi_tpu.parallel import checkpoint
@@ -184,12 +185,23 @@ class ElasticTrainer:
                 # site=step')
                 chaos.kill_point("step", n=self.step)
                 chaos.pace("step")
+            # step window span: the unit otpu_analyze --critical-path
+            # attributes (cat "step"; args carry the step index so
+            # windows match across ranks even after a ring wrap)
+            _t0 = trace.now() if trace.enabled else 0
+            _step0 = self.step
             try:
                 if self.step % self.ckpt_every == 0:
                     self._checkpoint()
                 self._train_step()
             except (ProcFailedError, RevokedError) as exc:
+                if trace.enabled:
+                    trace.span("step", "step", _t0,
+                               args={"step": _step0, "failed": True})
                 self._recover(exc)
+                continue
+            if trace.enabled:
+                trace.span("step", "step", _t0, args={"step": _step0})
         return self.w
 
     # -- recovery --------------------------------------------------------

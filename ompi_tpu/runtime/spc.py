@@ -45,6 +45,10 @@ _COUNTERS = (
     "telemetry_samples", "flight_dumps",
     # otpu-prof sampling profiler (runtime/profile): frame-sample ticks
     "profile_samples",
+    # otpu-crit causal flow layer (runtime/trace flow_start/flow_finish):
+    # emitted message-flow halves — finish/start ratio is the cheap
+    # live proxy for the merged-timeline link rate
+    "flow_starts", "flow_finishes",
 )
 
 _pvars = {}

@@ -21,6 +21,23 @@ At finalize each rank exports a Chrome trace-event JSON file
 CoordServer KV space so the launcher (``tools/tpurun.py``) can gather
 every rank's timeline, align clocks with the mpisync offset estimator,
 and emit one merged timeline plus a skew report.
+
+**Causal flow keys (otpu-crit).**  Per-rank spans say what each rank
+did; they cannot say which rank's message a recv waited on.  The flow
+layer stamps every pml message span with a compact key —
+``cid.src.dst.seq``, the (comm, sender, receiver, per-peer sequence)
+tuple that ALREADY rides every btl match header — and every traced
+collective span with ``(cid, cseq)``, a per-communicator collective
+sequence every member rank counts identically (MPI requires identical
+collective order per comm, so rank A's Nth collective on a cid IS rank
+B's Nth).  Send completion and recv delivery additionally emit Chrome
+flow events (``ph:"s"``/``"f"`` sharing an ``id``), so a merged
+timeline renders real cross-rank message arrows and
+``tools/otpu_analyze.py`` can assemble the cross-rank activity graph
+(program-order edges, message edges, collective barrier edges) behind
+``--critical-path``.  Guarded by its own module bool ``flow_enabled``
+(`otpu_trace_flow`): flow-off runs pay nothing beyond the existing
+``enabled`` checks.
 """
 from __future__ import annotations
 
@@ -38,9 +55,56 @@ from ompi_tpu.base.var import PvarClass, VarType, registry
 #: while tracing is disabled.
 enabled = False
 
+#: the flow layer's own guard: true only while ``enabled`` AND the
+#: ``otpu_trace_flow`` cvar is set.  Flow stamping sites (pml span
+#: keys, flow_start/flow_finish, the coll-wrapper cseq) read this and
+#: branch — a flow-disabled run records exactly what it did before
+#: otpu-crit existed.
+flow_enabled = False
+
+#: Declared span categories (the registry ``otpu_info --trace``
+#: enumerates; every ``trace.span``/``instant`` call site uses one).
+CATEGORIES = {
+    "boot": "instance boot path (coord connect, modex fence)",
+    "btl": "transport-layer wire operations (sendmsg, ring push)",
+    "chaos": "injected-fault instants (ft/chaos)",
+    "coll": "collective invocations (c_coll interposition)",
+    "device": "device-world dispatch (coll/xla)",
+    "ft": "failure detection/propagation/agreement + elastic recovery",
+    "io": "MPI-IO (ompio) operations",
+    "osc": "one-sided epochs (fence/lock/PSCW/flush)",
+    "part": "partitioned communication (Pready/Parrived)",
+    "pml": "point-to-point send/recv completion spans",
+    "serving": "continuous-batching serving ticks",
+    "staging": "accelerator staging-pool checkouts",
+    "step": "application/training step windows (critical-path unit)",
+    "flow": "Chrome flow events binding send completion to recv "
+            "delivery (ph s/f; otpu-crit message arrows)",
+}
+
+#: Declared flow-key categories: the closed vocabulary ``flow_start``/
+#: ``flow_finish`` accept (otpu-lint's observability pass checks
+#: literal call sites against this table, the STAGES discipline).  The
+#: key format is part of the contract — otpu_analyze parses it.
+FLOW_CATEGORIES = {
+    "pml_msg": "one point-to-point message: send completion -> recv "
+               "delivery, id 'cid.src.dst.seq' (world ranks; the "
+               "per-(cid,src,dst) pml sequence that rides every btl "
+               "match header)",
+    "coll_round": "one collective round: every member rank's span "
+                  "carries the same (cid, cseq) key in its args; the "
+                  "analyzer builds last-arrival->all-release barrier "
+                  "edges from it",
+}
+
 _ring: Optional[list] = None
 _ring_n = 0
 _slot = itertools.count()
+
+#: per-communicator collective sequence counters (cid -> count); every
+#: rank assigns cseq at record time in program order, so the counters
+#: agree across ranks without any wire traffic
+_coll_seq: dict = {}
 
 #: wall/monotonic anchor pair: spans carry perf_counter_ns timestamps
 #: (monotonic, ns resolution); export maps them onto the wall clock via
@@ -60,6 +124,14 @@ _KV_KEY = "otpu_trace"
 _DEFAULT_DIR = "otpu-trace"
 
 
+def _sync_flow() -> None:
+    # defensive lookup: the flow var's own registration may fire this
+    # hook (env/file value applied) before the module global binds
+    global flow_enabled
+    var = globals().get("_flow_var")
+    flow_enabled = enabled and (var is None or bool(var.value))
+
+
 def _set_enabled(value: bool) -> None:
     global enabled, _ring, _ring_n
     if value:
@@ -70,10 +142,11 @@ def _set_enabled(value: bool) -> None:
             _ring_n = want
             _ring = [None] * want
     enabled = bool(value)
+    _sync_flow()
 
 
-# buffer/dir register first: registering the enable var applies its
-# env/file value immediately, and the on_set hook sizes the ring
+# buffer/dir/flow register first: registering the enable var applies
+# its env/file value immediately, and the on_set hook sizes the ring
 _dir_var = registry.register(
     "trace", None, "dir", vtype=VarType.STRING, default="",
     help="Directory for per-rank Chrome trace JSON written at finalize "
@@ -81,7 +154,18 @@ _dir_var = registry.register(
 _buf_var = registry.register(
     "trace", None, "buffer_events", vtype=VarType.INT, default=65536,
     help="Ring buffer capacity in events; the ring overwrites oldest "
-         "entries, so a trace always holds the run's tail")
+         "entries, so a trace always holds the run's tail — the "
+         "overwritten count is surfaced in the export metadata and the "
+         "otpu_analyze report header")
+_flow_var = registry.register(
+    "trace", None, "flow", vtype=VarType.BOOL, default=True,
+    help="Stamp pml message spans with their cid.src.dst.seq flow key "
+         "(emitted as Chrome flow-event arrows) and collective spans "
+         "with a per-comm (cid, cseq) round key — the causal edges "
+         "otpu_analyze --critical-path consumes.  Only meaningful "
+         "while tracing is enabled; off pins the pre-otpu-crit "
+         "record path",
+    on_set=lambda _v: _sync_flow())
 _enable_var = registry.register(
     "trace", None, "enable", vtype=VarType.BOOL, default=False,
     help="Record span/instant events (pml, coll host+device, osc epochs, "
@@ -137,6 +221,62 @@ def instant(name: str, cat: str, args: Optional[dict] = None) -> None:
     i = next(_slot)
     _ring[i % _ring_n] = ("i", name, cat, time.perf_counter_ns(), 0,
                           threading.get_ident(), args, i)
+
+
+# -- causal flow events (otpu-crit) --------------------------------------
+
+def _flow_id(fid) -> str:
+    """Normalize a flow key to the Chrome id string: tuple keys (what
+    @hot_path call sites pass — string building is banned there) render
+    dot-joined, matching the documented ``cid.src.dst.seq`` format."""
+    return fid if isinstance(fid, str) else ".".join(map(str, fid))
+
+
+def flow_start(fcat: str, fid, t_ns: Optional[int] = None) -> None:
+    """Record the producing half of one flow edge (Chrome ``ph:"s"``).
+
+    ``fcat`` must be a :data:`FLOW_CATEGORIES` key (otpu-lint-enforced
+    at literal call sites); ``fid`` is the category's documented key —
+    a string or a tuple rendered dot-joined.  ``t_ns`` anchors the
+    arrow inside the emitting span — callers pass the span's own end
+    timestamp so viewers bind the flow to that slice."""
+    if not flow_enabled:
+        return
+    from ompi_tpu.runtime import spc
+
+    spc.record("flow_starts")
+    i = next(_slot)
+    _ring[i % _ring_n] = ("s", fcat, "flow",
+                         t_ns if t_ns is not None
+                         else time.perf_counter_ns(), 0,
+                         threading.get_ident(), {"id": _flow_id(fid)}, i)
+
+
+def flow_finish(fcat: str, fid, t_ns: Optional[int] = None) -> None:
+    """Record the consuming half of one flow edge (Chrome ``ph:"f"``,
+    bound to the enclosing slice via ``bp:"e"``)."""
+    if not flow_enabled:
+        return
+    from ompi_tpu.runtime import spc
+
+    spc.record("flow_finishes")
+    i = next(_slot)
+    _ring[i % _ring_n] = ("f", fcat, "flow",
+                         t_ns if t_ns is not None
+                         else time.perf_counter_ns(), 0,
+                         threading.get_ident(), {"id": _flow_id(fid)}, i)
+
+
+def next_coll_seq(cid: int) -> int:
+    """Allocate this rank's next collective sequence number on ``cid``
+    (the coll_round flow key's second half).  Program order per comm is
+    identical on every member rank by MPI semantics, so the counters
+    agree with zero wire traffic; assignment happens at record time, so
+    ring overwrite can never desynchronise surviving spans."""
+    c = _coll_seq.get(cid)
+    if c is None:
+        c = _coll_seq.setdefault(cid, itertools.count())
+    return next(c)
 
 
 # -- log2-size-binned latency histograms --------------------------------
@@ -374,13 +514,19 @@ def wrap_coll_table(comm) -> None:
             nbytes = 0
             if name in _SIZED_COLLS and args:
                 nbytes = getattr(args[0], "nbytes", 0) or 0
+            # coll_round flow key: cseq allocated BEFORE the collective
+            # runs, in program order — every member rank's span for this
+            # round carries the same (cid, cseq)
+            cseq = next_coll_seq(comm_arg.cid) if flow_enabled else None
             t0 = time.perf_counter_ns()
             try:
                 return fn(comm_arg, *args, **kw)
             finally:
                 t1 = time.perf_counter_ns()
-                span(name, "coll", t0, t1,
-                     args={"nbytes": int(nbytes), "cid": comm_arg.cid})
+                eargs = {"nbytes": int(nbytes), "cid": comm_arg.cid}
+                if cseq is not None:
+                    eargs["cseq"] = cseq
+                span(name, "coll", t0, t1, args=eargs)
                 hist_record(name, int(nbytes), t1 - t0)
 
         # carry the inner slot's marker attributes (__sync_wrapped__,
@@ -416,6 +562,14 @@ def chrome_events() -> list:
               "ts": _wall_us(t0), "tid": tid}
         if ph == "X":
             ev["dur"] = dur / 1000.0
+        if ph in ("s", "f"):
+            # flow events: the id is a top-level field in the Chrome
+            # schema; "f" binds to its enclosing slice (bp:"e") so the
+            # arrow lands on the recv span, not the next event
+            eargs = dict(eargs or {})
+            ev["id"] = eargs.pop("id", "")
+            if ph == "f":
+                ev["bp"] = "e"
         if eargs:
             ev["args"] = eargs
         out.append(ev)
@@ -570,28 +724,37 @@ def skew_report(payloads: list) -> str:
     for key in keys:
         name, cid = key
         seqs = {r: per_rank[r].get(key, []) for r in ranks}
-        rounds = min((len(s) for s in seqs.values()), default=0)
+        # rounds match across the ranks that HAVE spans for this key: a
+        # rank with none (died early, ring-wrapped, or sat out the comm
+        # — crash bundles produce all three) must not zero every other
+        # rank's rounds and erase the survivors' skew
+        members = [r for r in ranks if seqs[r]]
+        rounds = min((len(seqs[r]) for r in members), default=0) \
+            if len(members) >= 2 else 0
         # tail-align: the ring keeps the newest events on every rank
-        tails = {r: seqs[r][len(seqs[r]) - rounds:] for r in ranks}
+        tails = {r: seqs[r][len(seqs[r]) - rounds:] for r in members}
         spreads, slow_count = [], {}
         for k in range(rounds):
-            starts = {r: tails[r][k][0] for r in ranks}
-            durs = {r: tails[r][k][1] for r in ranks}
+            starts = {r: tails[r][k][0] for r in members}
+            durs = {r: tails[r][k][1] for r in members}
             spreads.append(max(starts.values()) - min(starts.values()))
             slowest = max(durs, key=durs.get)
             slow_count[slowest] = slow_count.get(slowest, 0) + 1
         for r in ranks:
-            for _ts, dur, nbytes in tails[r] if rounds else seqs[r]:
+            for _ts, dur, nbytes in tails.get(r, []) if rounds \
+                    else seqs[r]:
                 label = _bin_label(int(nbytes).bit_length())
                 bin_lat.setdefault((name, label), []).append(dur)
         cid_s = "-" if cid is None else str(cid)
         if rounds:
             slowest_rank = max(slow_count, key=slow_count.get)
+            absent = len(ranks) - len(members)
             lines.append(
                 f"{name:<18}  {cid_s:>3}  {rounds:>6}"
                 f"  {sum(spreads)/len(spreads):>14.1f}"
                 f"  {max(spreads):>13.1f}  {slowest_rank:>12}"
-                f"  ({slow_count[slowest_rank]}/{rounds} rounds)")
+                f"  ({slow_count[slowest_rank]}/{rounds} rounds"
+                + (f"; {absent} rank(s) absent)" if absent else ")"))
         else:
             # unmatched across ranks (some rank never ran it): note only
             total = sum(len(s) for s in seqs.values())
@@ -610,11 +773,13 @@ def skew_report(payloads: list) -> str:
 
 def reset_for_testing() -> None:
     """Drop all tracer state and re-arm from the cvar (tests only)."""
-    global _ring, _ring_n, _slot, enabled
+    global _ring, _ring_n, _slot, enabled, flow_enabled
     with _hist_lock:
         _hist.clear()
     _ring = None
     _ring_n = 0
     _slot = itertools.count()
+    _coll_seq.clear()
     enabled = False
+    flow_enabled = False
     _set_enabled(bool(_enable_var.value))

@@ -5,6 +5,25 @@ Consumes the clock-aligned timelines the tracing stack already produces
 payloads, or a directory holding either) and answers the questions a
 skew report's eyeball pass cannot:
 
+- **Critical-path attribution (otpu-crit)**: with the flow layer armed
+  (``otpu_trace_flow``, default-on under tracing) every pml message
+  span carries its ``cid.src.dst.seq`` key and every collective span a
+  per-comm ``(cid, cseq)`` round key.  ``--critical-path`` assembles
+  the cross-rank activity graph over the merged timeline — program-
+  order edges within each rank, message edges send-complete → recv-
+  delivery, collective barrier edges last-arrival → all-release — and
+  walks each step's longest dependency chain backward from the step's
+  completion.  The report attributes every step's wall time to
+  {compute, comm buckets (split into PR 12 STAGES groups when otpu-prof
+  payloads ride along), blocked-on-rank-R}, names the step's bounding
+  rank, gives a top-blockers table, and reports the **critical**
+  exposed-comm fraction — only comm ON the path counts, so a collective
+  that merely absorbs another rank's skew stops inflating the number.
+  ``--suggest-ladder`` converts the per-(coll, size-bin) critical
+  contributions into a versioned draft rules file in exactly the format
+  ``coll/tuned.py`` consumes (ROADMAP item 3's autotuner seeds its
+  sweep from it).
+
 - **Last-arrival attribution**: for every matched collective round,
   which rank entered last?  The rank that is last most often IS the
   straggler — on a synchronizing collective everyone else's wait time
@@ -51,9 +70,14 @@ from ompi_tpu.runtime.trace import _percentile, merge_timelines
 
 
 def load_run(paths: list) -> tuple:
-    """Normalize any input form into ``(events, profiles)``: one
-    clock-aligned event list plus ``{rank: otpu-prof payload}`` for
-    every rank whose artifact carried profile metadata.
+    """Normalize any input form into ``(events, profiles, meta)``: one
+    clock-aligned event list, ``{rank: otpu-prof payload}`` for every
+    rank whose artifact carried profile metadata, and a run-metadata
+    dict — ``events_overwritten`` per rank (the ring-wrap honesty
+    counter a critical path must disclose: a silently truncated
+    timeline attributes blame it never saw) plus ``payload_ranks``
+    (ranks whose payloads were present even with ZERO spans — crash
+    bundles produce those, and a vanished rank is itself a finding).
 
     Accepts merged-timeline files (events already aligned, ``pid`` =
     rank), per-rank payload files (aligned here via each payload's
@@ -61,7 +85,7 @@ def load_run(paths: list) -> tuple:
     per-rank profile snapshots under ``dumps``), and directories
     (prefer ``trace_merged.json`` for events, but ALWAYS scan the
     per-rank ``trace_rank*.json`` files too — the merged file drops
-    metadata, and the profile breakdown lives there)."""
+    the profile breakdown)."""
     files: list = []
     for p in paths:
         if os.path.isdir(p):
@@ -79,6 +103,7 @@ def load_run(paths: list) -> tuple:
     events: list = []
     payloads: list = []       # per-rank payloads: align via THE merger
     profiles: dict = {}
+    meta: dict = {"events_overwritten": {}, "payload_ranks": []}
     for entry in files:
         path, meta_only = (entry if isinstance(entry, tuple)
                            else (entry, None))
@@ -90,21 +115,33 @@ def load_run(paths: list) -> tuple:
                 if isinstance(dump, dict) and dump.get("profile"):
                     profiles[int(r)] = dump["profile"]
         elif "traceEvents" in doc:
-            meta = doc.get("metadata", {})
-            if meta.get("rank") is not None:
-                if meta.get("profile"):
-                    profiles[int(meta["rank"])] = meta["profile"]
+            m = doc.get("metadata", {})
+            if m.get("rank") is not None:
+                rank = int(m["rank"])
+                if m.get("profile"):
+                    profiles[rank] = m["profile"]
+                if rank not in meta["payload_ranks"]:
+                    meta["payload_ranks"].append(rank)
+                if m.get("events_overwritten"):
+                    meta["events_overwritten"][rank] = \
+                        int(m["events_overwritten"])
                 if not meta_only:
                     payloads.append(doc)          # per-rank payload
             elif not meta_only:
                 events.extend(doc["traceEvents"])  # already merged
+                # tpurun's merged file carries the per-rank overflow
+                # counters forward so a merged-only analyze stays honest
+                for r, n in (m.get("events_overwritten") or {}).items():
+                    if n:
+                        meta["events_overwritten"][int(r)] = int(n)
         else:
             raise SystemExit(f"otpu_analyze: {path!r} is not a trace "
                              "timeline, payload, or flight bundle")
     if payloads:
         events.extend(merge_timelines(payloads))
     events.sort(key=lambda e: float(e.get("ts", 0.0)))
-    return events, profiles
+    meta["payload_ranks"].sort()
+    return events, profiles, meta
 
 
 def load_events(paths: list) -> list:
@@ -205,11 +242,404 @@ def _host_overhead(profiles: dict, windows: dict,
     return out
 
 
+# -- critical path (otpu-crit) -------------------------------------------
+
+#: STAGES groups the per-bucket on-path comm time is decomposed into
+#: when otpu-prof payloads ride along (proportional to the rank's own
+#: measured stage sums — the path tells WHERE the time sits, the stage
+#: clocks tell WHAT the host was doing there)
+_STAGE_GROUPS = {
+    "send": ("send.pack", "send.staging", "send.queue", "send.wire"),
+    "recv": ("recv.parse", "recv.deliver", "recv.complete"),
+    "coll": ("coll.decide", "coll.alg"),
+}
+
+
+def _latest_before(spans: list, t: float) -> Optional[tuple]:
+    """Latest span (by start) in a start-sorted list with start
+    STRICTLY before ``t`` — strictness is what keeps the backward walk
+    from revisiting the span it just jumped out of."""
+    i = bisect.bisect_left(spans, (t,))
+    return spans[i - 1] if i else None
+
+
+def _overlap_us(spans: list, lo: float, hi: float) -> float:
+    """Union-microseconds of start-sorted (start, end, ...) spans
+    clipped to [lo, hi]."""
+    total = 0.0
+    cur = lo
+    i = bisect.bisect_left(spans, (lo,))
+    if i:
+        prev = spans[i - 1]
+        if prev[1] > lo:
+            i -= 1
+    for s in spans[i:]:
+        if s[0] >= hi:
+            break
+        a, b = max(s[0], cur), min(s[1], hi)
+        if b > a:
+            total += b - a
+            cur = b
+    return total
+
+
+def _crit_prepare(events: list, step_span: Optional[str]) -> dict:
+    """Index the merged timeline for the walk: per-rank sorted span
+    lists, collective rounds keyed by (name, cid, cseq), message edges
+    keyed by flow id, and per-(step index, rank) windows."""
+    colls: dict = {}     # rank -> [(ts, end, name, cid, cseq, nbytes)]
+    sends: dict = {}     # fid -> (rank, send-complete ts)
+    recvs: dict = {}     # rank -> [(ts, end, fid)]
+    pml: dict = {}       # rank -> {"send": [(ts, end)], "recv": ...}
+    steps: dict = {}     # step idx -> {rank: (ts, end)}
+    rounds: dict = {}    # (name, cid, cseq) -> {rank: (ts, end)}
+    step_counts: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        r = int(ev.get("pid", 0))
+        ts = float(ev["ts"])
+        end = ts + float(ev.get("dur", 0.0))
+        cat = ev.get("cat")
+        eargs = ev.get("args") or {}
+        if cat == "coll":
+            cseq = eargs.get("cseq")
+            nbytes = int(eargs.get("nbytes", 0) or 0)
+            colls.setdefault(r, []).append(
+                (ts, end, ev.get("name"), eargs.get("cid"), cseq, nbytes))
+            if cseq is not None:
+                rounds.setdefault(
+                    (ev.get("name"), eargs.get("cid"), cseq), {})[r] = \
+                    (ts, end)
+        elif cat == "pml":
+            kind = "send" if ev.get("name") == "send" else "recv"
+            pml.setdefault(r, {"send": [], "recv": []})[kind].append(
+                (ts, end))
+            fid = eargs.get("fid")
+            if fid:
+                # span args carry the key as a tuple (JSON: a list);
+                # normalize so send/recv sides hash identically
+                if isinstance(fid, (list, tuple)):
+                    fid = tuple(fid)
+                if kind == "send":
+                    sends[fid] = (r, end)
+                else:
+                    recvs.setdefault(r, []).append((ts, end, fid))
+        if cat == "step" or ev.get("name") == (step_span or "step"):
+            idx = eargs.get("step")
+            if idx is None:     # no index arg: per-rank occurrence order
+                idx = step_counts.get(r, 0)
+            step_counts[r] = step_counts.get(r, 0) + 1
+            steps.setdefault(idx, {})[r] = (ts, end)
+    for table in (colls, recvs):
+        for spans in table.values():
+            spans.sort()
+    for by_kind in pml.values():
+        by_kind["send"].sort()
+        by_kind["recv"].sort()
+    # recv jump candidates: only recvs NOT nested inside a coll span on
+    # the same rank (a collective's internal recvs are subsumed by the
+    # round's barrier edge)
+    standalone: dict = {}
+    for r, spans in recvs.items():
+        mine = colls.get(r, [])
+        keep = []
+        for ts, end, fid in spans:
+            i = bisect.bisect_left(mine, (ts,))
+            inside = bool(i and mine[i - 1][1] >= end) or \
+                bool(i < len(mine) and mine[i][0] <= ts
+                     and mine[i][1] >= end)
+            if not inside:
+                keep.append((ts, end, fid))
+        standalone[r] = keep
+    return {"colls": colls, "sends": sends, "recvs": standalone,
+            "pml": pml, "steps": steps, "rounds": rounds}
+
+
+def _walk_step(idx, windows: dict, ix: dict) -> Optional[dict]:
+    """Extract one step's critical path by walking backward from the
+    step's completion: inside a collective round, the time after the
+    last member's arrival is shared algorithm work ON the path, and the
+    path then jumps to the last-arriving rank (barrier edge); inside a
+    matched recv, it jumps to the sender at send-complete (message
+    edge); everything else is the current rank's own program order."""
+    home = max(windows, key=lambda r: windows[r][1])
+    r, t = home, windows[home][1]
+    lo_all = min(w[0] for w in windows.values())
+    segments: list = []   # (rank, lo, hi, kind, key)
+    for _guard in range(100000):
+        lo_r = windows.get(r, (lo_all, 0.0))[0]
+        if t <= lo_r + 1e-9:
+            break
+        cand_c = _latest_before(ix["colls"].get(r, []), t)
+        if cand_c is not None and cand_c[0] < lo_r:
+            cand_c = None
+        cand_m = _latest_before(ix["recvs"].get(r, []), t)
+        if cand_m is not None and cand_m[0] < lo_r:
+            cand_m = None
+        if cand_c is None and cand_m is None:
+            segments.append((r, lo_r, t, "gap", None))
+            break
+        if cand_m is not None and (cand_c is None
+                                   or cand_m[0] > cand_c[0]):
+            ts_v, end_v, fid = cand_m
+            if end_v < t:
+                segments.append((r, end_v, t, "gap", None))
+                t = end_v
+            snd = ix["sends"].get(fid)
+            if snd is not None and snd[0] != r and snd[1] > ts_v:
+                # message edge: recv waited on the sender
+                seg_lo = min(t, max(ts_v, snd[1]))
+                segments.append((r, seg_lo, t, "msg", None))
+                r, t = snd[0], min(snd[1], t)
+                continue
+            segments.append((r, ts_v, t, "msg", None))
+            t = ts_v
+            continue
+        ts_c, end_c, name, cid, cseq, nbytes = cand_c
+        if end_c < t:
+            segments.append((r, end_c, t, "gap", None))
+            t = end_c
+        member = ix["rounds"].get((name, cid, cseq)) \
+            if cseq is not None else None
+        if member and len(member) > 1:
+            last_rank = max(member, key=lambda rr: member[rr][0])
+            last_start = member[last_rank][0]
+            if last_rank != r and last_start > ts_c:
+                # barrier edge: work after last arrival is on the path
+                # here; the wait before it belongs to the last arriver
+                seg_lo = min(t, max(ts_c, last_start))
+                segments.append((r, seg_lo, t, "coll", (name, nbytes)))
+                r, t = last_rank, min(last_start, t)
+                continue
+        segments.append((r, ts_c, t, "coll", (name, nbytes)))
+        t = ts_c
+    if not segments:
+        return None
+    return {"home": home, "segments": segments,
+            "wall_us": windows[home][1] - lo_all}
+
+
+def _crit_step_report(idx, walk: dict, ix: dict) -> tuple:
+    """Fold one walk into ``(per-step report row, per-(coll, size-bin)
+    critical contributions, on-path us per rank)`` — the row carries
+    buckets, the bounding rank, and the step's critical exposed-comm
+    fraction; the other two aggregate across steps."""
+    from ompi_tpu.runtime.trace import _bin_label
+
+    home = walk["home"]
+    on_path: dict = {}
+    buckets = {"compute": 0.0, "send": 0.0, "recv": 0.0, "coll": 0.0}
+    blocked: dict = {}
+    coll_crit: dict = {}
+    for rk, lo, hi, kind, key in walk["segments"]:
+        us = hi - lo
+        if us <= 0:
+            continue
+        on_path[rk] = on_path.get(rk, 0.0) + us
+        if rk != home:
+            blocked[rk] = blocked.get(rk, 0.0) + us
+        if kind == "coll":
+            buckets["coll"] += us
+            name, nbytes = key
+            ck = f"{name}/{_bin_label(int(nbytes).bit_length())}"
+            cell = coll_crit.setdefault(ck, [0.0, 0])
+            cell[0] += us
+            cell[1] = max(cell[1], int(nbytes))
+        elif kind == "msg":
+            buckets["recv"] += us
+        else:
+            spans = ix["pml"].get(rk, {})
+            snd = _overlap_us(spans.get("send", []), lo, hi)
+            rcv = _overlap_us(spans.get("recv", []), lo, hi)
+            buckets["send"] += snd
+            buckets["recv"] += rcv
+            buckets["compute"] += max(0.0, us - snd - rcv)
+    path_us = sum(on_path.values())
+    comm_us = buckets["coll"] + buckets["send"] + buckets["recv"]
+    row = {
+        "step": idx,
+        "wall_us": round(walk["wall_us"], 1),
+        "bound_by": max(on_path, key=on_path.get),
+        "on_path_us": {str(r): round(v, 1)
+                       for r, v in sorted(on_path.items())},
+        "buckets": {k: round(v, 1) for k, v in buckets.items()},
+        "blocked_on": {str(r): round(v, 1)
+                       for r, v in sorted(blocked.items())},
+        "critical_exposed_comm": round(comm_us / path_us, 3)
+        if path_us > 0 else 0.0,
+    }
+    return row, coll_crit, on_path
+
+
+def critical_path_report(events: list, profiles: Optional[dict] = None,
+                         step_span: Optional[str] = None) -> dict:
+    """The --critical-path section: per-step attribution rows, the
+    most-often-bounding rank, top-blockers table, overall critical
+    exposed-comm fraction, per-(coll, size-bin) critical contributions,
+    and — when otpu-prof profiles ride along — a STAGES-group blame
+    decomposition per rank."""
+    ix = _crit_prepare(events, step_span)
+    if not ix["steps"]:
+        return {"steps": [], "note": "no step spans found (record "
+                "trace.span(..., cat='step') or pass --step-span)"}
+    steps_out: list = []
+    bound_counts: dict = {}
+    coll_crit_all: dict = {}
+    on_path_all: dict = {}
+    comm_on_path = path_total = 0.0
+    for idx in sorted(ix["steps"], key=lambda v: (str(type(v)), v)):
+        windows = ix["steps"][idx]
+        walk = _walk_step(idx, windows, ix)
+        if walk is None:
+            continue
+        row, coll_crit, on_path = _crit_step_report(idx, walk, ix)
+        steps_out.append(row)
+        bound_counts[row["bound_by"]] = \
+            bound_counts.get(row["bound_by"], 0) + 1
+        for k, (us, nb) in coll_crit.items():
+            cell = coll_crit_all.setdefault(k, [0.0, 0])
+            cell[0] += us
+            cell[1] = max(cell[1], nb)
+        for r, us in on_path.items():
+            on_path_all[r] = on_path_all.get(r, 0.0) + us
+        b = row["buckets"]
+        comm_on_path += b["coll"] + b["send"] + b["recv"]
+        path_total += sum(b.values())
+    if not steps_out:
+        return {"steps": [], "note": "no walkable steps"}
+    bound_rank = max(bound_counts, key=bound_counts.get)
+    report = {
+        "steps": steps_out,
+        "bound_by": {
+            "rank": bound_rank,
+            "fraction": round(bound_counts[bound_rank]
+                              / len(steps_out), 3),
+            "counts": {str(r): n
+                       for r, n in sorted(bound_counts.items())},
+        },
+        "critical_exposed_comm": round(comm_on_path / path_total, 3)
+        if path_total > 0 else 0.0,
+        "top_blockers": [
+            {"rank": r, "steps_bound": bound_counts.get(r, 0),
+             "on_path_us": round(us, 1)}
+            for r, us in sorted(on_path_all.items(),
+                                key=lambda kv: -kv[1])],
+        "coll_critical_us": {k: round(v[0], 1) for k, v in
+                             sorted(coll_crit_all.items(),
+                                    key=lambda kv: -kv[1][0])},
+        "_coll_critical_nbytes": {k: v[1]
+                                  for k, v in coll_crit_all.items()},
+    }
+    if profiles:
+        report["stage_blame"] = _stage_blame(on_path_all, ix, profiles)
+    return report
+
+
+def _stage_blame(on_path_all: dict, ix: dict, profiles: dict) -> dict:
+    """Per-rank STAGES-group decomposition of the rank's on-path time:
+    the comm share splits across the rank's measured stage sums within
+    each group (otpu-prof rode in the payload metadata); a rank with no
+    profile keeps the coarse group totals."""
+    out: dict = {}
+    for r, total in sorted(on_path_all.items()):
+        stages = ((profiles.get(r) or {}).get("stages")
+                  or {}) if profiles else {}
+        row: dict = {"on_path_us": round(total, 1)}
+        for group, names in _STAGE_GROUPS.items():
+            sums = {s: float((stages.get(s) or {}).get("sum_us", 0.0))
+                    for s in names}
+            gsum = sum(sums.values())
+            if gsum > 0:
+                row[group] = {s: round(v / gsum, 3)
+                              for s, v in sums.items() if v > 0}
+        out[str(r)] = row
+    return out
+
+
+_LADDER_VERSION = 1
+
+
+def suggest_ladder(report: dict, comm_size: int) -> str:
+    """Render the per-(coll, size-bin) critical contributions as a
+    draft dynamic-rules file in the EXACT format ``coll/tuned.py``
+    loads (``_load_rules``; one rule per line, first match wins).
+
+    The draft is **behavior-identical by construction**: for every
+    collective with critical-path time it emits the fixed ladder's
+    whole breakpoint table up through the hot cells
+    (``tuned.ladder_rules`` — a lone hot-cell row would silently
+    extend that cell's pick to every smaller message, since the
+    grammar has no lower bound), with the measured critical share
+    annotated on the rows the hot cells land in.  Loading it changes
+    NO pick — it marks exactly which cells ``bench.py --ladder`` is
+    worth sweeping, and the autotuner's improved picks then diff
+    against a checked-in baseline.  Commutativity caveat: the rule
+    grammar cannot express it, so tuned applies dynamic rules to
+    commutative reductions only (non-commutative ops keep the fixed
+    ladder's order-safe picks) and the draft pins the commutative
+    incumbents.  Note the one deliberate perf side effect of ANY
+    loaded rules file: tuned's small-allreduce eager lane disables
+    itself so overrides are never masked."""
+    from ompi_tpu.mca.coll.tuned import _MENUS, ladder_rules
+
+    crit = report.get("critical_path") or report
+    cells = crit.get("coll_critical_us") or {}
+    nbytes_by_key = crit.get("_coll_critical_nbytes") or {}
+    total = sum(cells.values()) or 1.0
+    lines = [
+        f"# otpu-crit suggested tuning ladder v{_LADDER_VERSION}",
+        f"# source: otpu_analyze --suggest-ladder over "
+        f"{len(crit.get('steps') or [])} steps, comm_size {comm_size}",
+        "# schema: coll  max_comm_size  max_bytes  algorithm  [segsize]",
+        "# behavior-identical draft: every row pins the fixed ladder's",
+        "# own incumbent (commutative form; non-commutative ops ignore",
+        "# dynamic rules); rows marked critical_us sat on the measured",
+        "# critical path — sweep those with bench.py --ladder before",
+        "# promoting a different algorithm",
+    ]
+    # hot-cell upper bounds per collective: (cap_bytes, {max_bin_bound:
+    # (us, share)}) — the cap decides how far the breakpoint table runs
+    per_coll: dict = {}
+    for key, us in cells.items():
+        name = key.rsplit("/", 1)[0]
+        if name not in _MENUS:
+            continue        # device *_array entry points have no ladder
+        nbytes = int(nbytes_by_key.get(key, 0))
+        hi = (1 << int(nbytes).bit_length()) - 1 if nbytes else 0
+        cap, hot = per_coll.setdefault(name, [0, {}])
+        per_coll[name][0] = max(cap, hi)
+        hot[hi] = hot.get(hi, 0.0) + us
+    for name in sorted(per_coll):
+        cap, hot = per_coll[name]
+        for max_bytes, alg in ladder_rules(name, comm_size, cap):
+            # annotate the row each hot cell falls under (the first
+            # rule whose bound covers the cell's bin)
+            marks = [f"critical_us={us:.1f} share={us / total:.2f} "
+                     f"(<= {hi}b)"
+                     for hi, us in sorted(hot.items())
+                     if hi <= max_bytes]
+            for hi in [h for h in hot if h <= max_bytes]:
+                del hot[hi]
+            for m in marks:
+                lines.append(f"# {m}")
+            lines.append(f"{name}  {comm_size}  {max_bytes}  {alg}")
+    if not per_coll:
+        lines.append("# (no collective time on the critical path)")
+    return "\n".join(lines) + "\n"
+
+
 def analyze(events: list, step_span: Optional[str] = None,
-            profiles: Optional[dict] = None) -> dict:
+            profiles: Optional[dict] = None,
+            meta: Optional[dict] = None,
+            critical_path: bool = False) -> dict:
     """The full report over one clock-aligned event list (see module
-    docstring for the sections)."""
-    ranks = sorted({int(e.get("pid", 0)) for e in events})
+    docstring for the sections).  ``meta`` is :func:`load_run`'s third
+    element (overflow counters + payload ranks); ``critical_path``
+    adds the otpu-crit section (it walks every step, so it is opt-in
+    on the CLI)."""
+    ranks = sorted({int(e.get("pid", 0)) for e in events}
+                   | set((meta or {}).get("payload_ranks") or []))
     per_coll: dict = {}
     last_arrival: dict = {r: 0 for r in ranks}
     all_spreads: list = []
@@ -297,8 +727,17 @@ def analyze(events: list, step_span: Optional[str] = None,
     all_spreads.sort()
     straggler = (max(last_arrival, key=last_arrival.get)
                  if rounds_total else None)
+    overwritten = (meta or {}).get("events_overwritten") or {}
     report = {
         "ranks": ranks,
+        # ring-wrap honesty: a wrapped ring silently lost this many
+        # events per rank — critical paths over a truncated timeline
+        # can lie, so the counter leads the report
+        "events_overwritten": {
+            "total": sum(overwritten.values()),
+            "per_rank": {str(r): int(n)
+                         for r, n in sorted(overwritten.items())},
+        },
         "rounds_total": rounds_total,
         "straggler": {
             "rank": straggler,
@@ -320,6 +759,9 @@ def analyze(events: list, step_span: Optional[str] = None,
         "host_overhead": _host_overhead(profiles or {}, windows,
                                         coll_by_rank),
     }
+    if critical_path:
+        report["critical_path"] = critical_path_report(
+            events, profiles=profiles, step_span=step_span)
     return report
 
 
@@ -354,14 +796,46 @@ def diff_reports(old: dict, new: dict) -> dict:
                       .get("exposed_host_fraction", 0.0))
             host[r] = round(b - a, 3)
         out["exposed_host_delta"] = host
+    cp_old = old.get("critical_path") or {}
+    cp_new = new.get("critical_path") or {}
+    if cp_old or cp_new:
+        a = (cp_old.get("bound_by") or {}).get("rank")
+        b = (cp_new.get("bound_by") or {}).get("rank")
+        out["critical_bound_by_changed"] = a != b
+        out["critical_bound_by"] = [a, b]
+        out["critical_exposed_comm_delta"] = round(
+            float(cp_new.get("critical_exposed_comm", 0.0))
+            - float(cp_old.get("critical_exposed_comm", 0.0)), 3)
+        colls: dict = {}
+        for k in sorted(set(cp_old.get("coll_critical_us") or {})
+                        | set(cp_new.get("coll_critical_us") or {})):
+            colls[k] = round(
+                float((cp_new.get("coll_critical_us") or {})
+                      .get(k, 0.0))
+                - float((cp_old.get("coll_critical_us") or {})
+                        .get(k, 0.0)), 1)
+        out["coll_critical_us_delta"] = colls
     return out
 
 
 def render_text(report: dict, parsable: bool = False) -> str:
+    ow = report.get("events_overwritten") or {}
     if parsable:
         lines = []
+        if ow.get("total"):
+            lines.append(f"events_overwritten:{ow['total']}:" + ":".join(
+                f"{r}={n}" for r, n in ow["per_rank"].items()))
         s = report["straggler"]
         lines.append(f"straggler:{s['rank']}:{s['fraction']}")
+        cp = report.get("critical_path") or {}
+        if cp.get("steps"):
+            bb = cp["bound_by"]
+            lines.append(f"critical_bound_by:{bb['rank']}:"
+                         f"{bb['fraction']}:{len(cp['steps'])}")
+            lines.append("critical_exposed_comm:"
+                         f"{cp['critical_exposed_comm']}")
+            for k, us in cp["coll_critical_us"].items():
+                lines.append(f"coll_critical_us:{k}:{us}")
         sk = report["skew_us"]
         lines.append(f"skew_us:{sk['mean']}:{sk['p50']}:{sk['p99']}:"
                      f"{sk['max']}")
@@ -382,6 +856,12 @@ def render_text(report: dict, parsable: bool = False) -> str:
     s = report["straggler"]
     lines = [f"otpu-analyze — {len(report['ranks'])} ranks, "
              f"{report['rounds_total']} matched collective rounds"]
+    if ow.get("total"):
+        lines.append(
+            f"WARNING: {ow['total']} events overwritten by ring wrap "
+            f"({', '.join(f'rank {r}: {n}' for r, n in ow['per_rank'].items())}) "
+            "— raise otpu_trace_buffer_events; attribution below may "
+            "miss the truncated prefix")
     if s["rank"] is not None:
         lines.append(
             f"straggler: rank {s['rank']} arrived last in "
@@ -429,6 +909,40 @@ def render_text(report: dict, parsable: bool = False) -> str:
                     f"{prof['gil_wait']}, top phases "
                     + ", ".join(f"{k}={v}" for k, v in
                                 list(prof["phases"].items())[:4]))
+    cp = report.get("critical_path")
+    if cp is not None:
+        lines.append("")
+        if not cp.get("steps"):
+            lines.append(f"critical path: {cp.get('note', 'no steps')}")
+            return "\n".join(lines)
+        bb = cp["bound_by"]
+        lines.append(
+            f"critical path over {len(cp['steps'])} steps: bound by "
+            f"rank {bb['rank']} in {100 * bb['fraction']:.0f}% of steps "
+            f"({bb['counts']}); critical exposed-comm "
+            f"{100 * cp['critical_exposed_comm']:.1f}%")
+        lines.append("top blockers (time owning the critical path):")
+        for row in cp["top_blockers"]:
+            lines.append(f"  rank {row['rank']}: "
+                         f"{row['on_path_us']:.0f}us on path, bounds "
+                         f"{row['steps_bound']} steps")
+        if cp["coll_critical_us"]:
+            lines.append("collective time ON the critical path "
+                         "(per coll/size-bin; --suggest-ladder pins "
+                         "these cells):")
+            for k, us in list(cp["coll_critical_us"].items())[:8]:
+                lines.append(f"  {k}: {us:.0f}us")
+        blame = cp.get("stage_blame")
+        if blame:
+            lines.append("stage blame (otpu-prof group shares of each "
+                         "rank's on-path comm):")
+            for r, row in blame.items():
+                groups = ", ".join(
+                    f"{g}[" + " ".join(f"{s.split('.')[1]}={f:.0%}"
+                                       for s, f in row[g].items()) + "]"
+                    for g in ("send", "recv", "coll") if g in row)
+                lines.append(f"  rank {r}: {row['on_path_us']:.0f}us "
+                             f"on path {groups}")
     return "\n".join(lines)
 
 
@@ -448,13 +962,34 @@ def main(argv=None) -> int:
     ap.add_argument("--step-span", default=None,
                     help="Span name marking one training step (per-step "
                          "exposed-comm breakdown)")
+    ap.add_argument("--critical-path", action="store_true",
+                    dest="critical_path",
+                    help="Walk each step's cross-rank critical path "
+                         "(flow keys + collective round keys) and "
+                         "attribute its wall time to {compute, comm "
+                         "buckets, blocked-on-rank-R}")
+    ap.add_argument("--suggest-ladder", default=None, metavar="OUT",
+                    dest="suggest_ladder",
+                    help="Write the per-(coll, size-bin) critical "
+                         "contributions as a draft coll/tuned dynamic-"
+                         "rules file ('-' = stdout); implies "
+                         "--critical-path")
     ap.add_argument("--diff", default=None, metavar="OLD",
                     help="Compare against a previous JSON report and "
                          "print the deltas")
     args = ap.parse_args(argv)
-    events, profiles = load_run(args.paths)
+    events, profiles, meta = load_run(args.paths)
     report = analyze(events, step_span=args.step_span,
-                     profiles=profiles)
+                     profiles=profiles, meta=meta,
+                     critical_path=bool(args.critical_path
+                                        or args.suggest_ladder))
+    if args.suggest_ladder:
+        text = suggest_ladder(report, comm_size=len(report["ranks"]))
+        if args.suggest_ladder == "-":
+            print(text, end="")
+        else:
+            with open(args.suggest_ladder, "w") as f:
+                f.write(text)
     if args.json_out:
         encoded = json.dumps(report, indent=1, sort_keys=False)
         if args.json_out == "-":
@@ -467,7 +1002,10 @@ def main(argv=None) -> int:
             old = json.load(f)
         print(json.dumps(diff_reports(old, report), indent=1))
     if not (args.json_out == "-" or args.diff):
-        print(render_text(report, parsable=args.parsable))
+        try:
+            print(render_text(report, parsable=args.parsable))
+        except BrokenPipeError:
+            pass   # output piped into head & friends
     return 0
 
 

@@ -100,6 +100,12 @@ def main(argv=None) -> int:
                          "(the invariant families the static analyzer "
                          "enforces; run them with ompi_tpu.tools"
                          ".otpu_lint)")
+    ap.add_argument("--trace", action="store_true",
+                    help="Show the otpu-trace plane: the declared span "
+                         "categories and flow-key categories "
+                         "(runtime/trace.py CATEGORIES / "
+                         "FLOW_CATEGORIES) and the ring/export/flow "
+                         "MCA vars")
     ap.add_argument("--telemetry", action="store_true",
                     help="Show the live-telemetry plane: every "
                          "published sample key (the declared schema "
@@ -195,6 +201,20 @@ def main(argv=None) -> int:
         for lint_pass in analysis.all_passes():
             out.append(_fmt(f"lint pass {lint_pass.name}",
                             lint_pass.description, p))
+
+    if args.all or args.trace:
+        # registry-enumerated like --telemetry/--profile: the declared
+        # category tables and the trace var group, never a hand-kept
+        # list — a category added later shows up automatically
+        from ompi_tpu.runtime import trace as _trace
+
+        for cat, desc in _trace.CATEGORIES.items():
+            out.append(_fmt(f"trace category {cat}", desc, p))
+        for fcat, desc in _trace.FLOW_CATEGORIES.items():
+            out.append(_fmt(f"trace flow key {fcat}", desc, p))
+        for var in registry.all_vars("trace"):
+            out.append(_fmt(f"trace var {var.name}",
+                            f"{var.value!r} — {var.help}", p))
 
     if args.all or args.telemetry:
         # registry-enumerated like --lint/--psets: the schema constant

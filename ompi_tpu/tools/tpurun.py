@@ -201,10 +201,21 @@ def _merge_traces(server) -> None:
     try:
         os.makedirs(tdir, exist_ok=True)
         merged_path = os.path.join(tdir, "trace_merged.json")
+        # carry each rank's ring-wrap counter into the merged file's
+        # metadata: otpu_analyze leads its report with it (a silently
+        # truncated timeline makes critical paths lie)
+        overwritten = {
+            str(p["metadata"]["rank"]):
+                int(p["metadata"].get("events_overwritten", 0) or 0)
+            for p in payloads if p.get("metadata", {}).get("rank")
+            is not None}
         with open(merged_path, "w") as f:
             json.dump({"traceEvents": trace.merge_timelines(payloads),
                        "metadata": {"ranks": sorted(raw),
-                                    "clock": "coord-server"}}, f)
+                                    "clock": "coord-server",
+                                    "events_overwritten": {
+                                        r: n for r, n in
+                                        overwritten.items() if n}}}, f)
         report_path = os.path.join(tdir, "trace_skew.txt")
         report = trace.skew_report(payloads)
         with open(report_path, "w") as f:

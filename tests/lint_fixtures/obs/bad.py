@@ -30,3 +30,7 @@ def crash(flight):
 def clocked(profile):
     t0 = profile.now()
     profile.stage_span("mystery_stage", t0)   # BAD: not in STAGES
+
+
+def linked():
+    trace.flow_start("mystery_flow", "1.2.3.4")  # BAD: no such category

@@ -50,3 +50,9 @@ def clocked(profile):
     profile.stage_span("send.pack", t0)       # declared in STAGES
     profile.stage_mark("recv.parse")          # declared in STAGES
     profile.stage_span(_dynamic_name(), 0)    # non-literal: out of scope
+
+
+def linked():
+    trace.flow_start("pml_msg", "1.2.3.4")    # declared category
+    trace.flow_finish("coll_round", "7.0")    # declared category
+    trace.flow_start(_dynamic_name(), "x")    # non-literal: out of scope

@@ -1,0 +1,8 @@
+"""Fixture stand-in for runtime/trace.py: the declared flow-key
+category table the flow_start/flow_finish rule checks literals
+against."""
+
+FLOW_CATEGORIES = {
+    "pml_msg": "point-to-point message flow",
+    "coll_round": "collective round key",
+}

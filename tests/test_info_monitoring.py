@@ -140,6 +140,29 @@ def test_info_telemetry_lists_schema_and_vars():
     assert "telemetry var otpu_flight_dir:" in r_all.stdout
 
 
+def test_info_trace_lists_categories_and_vars():
+    """--trace enumerates the declared span categories, the flow-key
+    categories, and the ring/export/flow vars (registry-enumerated,
+    also under --all/--parsable)."""
+    from ompi_tpu.runtime import trace
+
+    r = _run_info("--trace")
+    assert r.returncode == 0, r.stderr
+    for cat in trace.CATEGORIES:
+        assert f"trace category {cat}:" in r.stdout, cat
+    for fcat in trace.FLOW_CATEGORIES:
+        assert f"trace flow key {fcat}:" in r.stdout, fcat
+    for var in ("otpu_trace_enable", "otpu_trace_dir",
+                "otpu_trace_buffer_events", "otpu_trace_flow"):
+        assert var in r.stdout, var
+    # under --all and --parsable too
+    r_all = _run_info("--all", "--parsable")
+    assert r_all.returncode == 0
+    assert "trace category pml:" in r_all.stdout
+    assert "trace flow key pml_msg:" in r_all.stdout
+    assert "trace var otpu_trace_flow:" in r_all.stdout
+
+
 def test_topo_explicit_only():
     """--all must NOT boot the accelerator runtime for topology; --topo
     opts in (regression guard for the lazy-init guarantee)."""

@@ -96,8 +96,9 @@ def test_observability_contracts():
     bad = run_pass("observability", FIXTURES / "obs" / "bad.py",
                    FIXTURES / "obs" / "spc.py",
                    FIXTURES / "obs" / "telemetry.py",
-                   FIXTURES / "obs" / "profile.py")
-    assert len(bad) == 6, bad
+                   FIXTURES / "obs" / "profile.py",
+                   FIXTURES / "obs" / "trace.py")
+    assert len(bad) == 7, bad
     msgs = " | ".join(f.message for f in bad)
     assert "no matching register_help" in msgs
     assert "not declared in runtime/spc.py" in msgs
@@ -105,10 +106,12 @@ def test_observability_contracts():
     assert "not a key of runtime/telemetry.py SCHEMA" in msgs
     assert "no registered help-flight template" in msgs
     assert "not declared in runtime/profile.py STAGES" in msgs
+    assert "not declared in runtime/trace.py FLOW_CATEGORIES" in msgs
     assert not run_pass("observability", FIXTURES / "obs" / "good.py",
                         FIXTURES / "obs" / "spc.py",
                         FIXTURES / "obs" / "telemetry.py",
-                        FIXTURES / "obs" / "profile.py")
+                        FIXTURES / "obs" / "profile.py",
+                        FIXTURES / "obs" / "trace.py")
 
 
 def test_mca_conformance():

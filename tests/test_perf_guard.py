@@ -473,6 +473,66 @@ def test_tracing_disabled_overhead_is_one_flag_check(tmp_path):
         f"{t_direct * 1e6:.2f}us)")
 
 
+def test_flow_disabled_zero_overhead():
+    """otpu-crit satellite pin: with ``otpu_trace_flow`` off (or
+    tracing off entirely) the flow layer is an identity — flow_start/
+    flow_finish record nothing, pml spans carry no flow key, requests
+    never grow a _flow stamp, the coll wrapper allocates no cseq, and
+    the SPC flow counters stay flat.  The record path must be byte-
+    identical to the pre-otpu-crit tracer."""
+    import numpy as np
+
+    import ompi_tpu
+    from ompi_tpu.base.var import registry as _registry
+    from ompi_tpu.runtime import init as rt
+    from ompi_tpu.runtime import spc, trace
+
+    # default-off half: tracing disabled forces flow off whatever the
+    # flow var says, and the flow calls are guarded no-ops
+    _registry.set("otpu_trace_enable", False)
+    trace.reset_for_testing()
+    assert trace.flow_enabled is False
+    before = spc.read("flow_starts"), spc.read("flow_finishes")
+    trace.flow_start("pml_msg", (0, 0, 1, 0))
+    trace.flow_finish("pml_msg", (0, 0, 1, 0))
+    assert trace.recorded_count() == 0
+    # tracing ON, flow OFF: spans record exactly the pre-flow shape
+    rt.reset_for_testing()
+    _registry.set("otpu_trace_enable", True)
+    _registry.set("otpu_trace_flow", False)
+    trace.reset_for_testing()
+    try:
+        assert trace.enabled is True and trace.flow_enabled is False
+        w = ompi_tpu.init()
+        x = np.ones(64, np.float32)
+        buf = np.empty_like(x)
+        a, b = w.as_rank(0), w.as_rank(1)
+        sreq = a.isend(x, dest=1, tag=9)
+        b.recv(buf, source=0, tag=9)
+        sreq.wait()
+        evs = trace.chrome_events()
+        pml = [e for e in evs if e.get("cat") == "pml"]
+        assert pml, "pml spans missing"
+        for e in pml:
+            assert "fid" not in (e.get("args") or {}), e
+        assert not [e for e in evs if e["ph"] in ("s", "f")]
+        # no request ever carried a flow stamp
+        assert trace._coll_seq == {}
+        assert (spc.read("flow_starts"),
+                spc.read("flow_finishes")) == before
+        # conductor world: collectives take a leading rank axis
+        w.allreduce(np.ones((w.size, 4), np.float32))
+        colls = [e for e in trace.chrome_events()
+                 if e.get("cat") == "coll"]
+        assert colls and all("cseq" not in (e.get("args") or {})
+                             for e in colls)
+    finally:
+        _registry.set("otpu_trace_enable", False)
+        _registry.set("otpu_trace_flow", True)
+        trace.reset_for_testing()
+        rt.reset_for_testing()
+
+
 def test_telemetry_disabled_zero_overhead():
     """otpu-top satellite pin: with otpu_telemetry_interval_ms at its
     default (0), the telemetry plane is an identity — no sampler

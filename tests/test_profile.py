@@ -263,7 +263,7 @@ def test_load_run_collects_profiles(tmp_path):
     # a merged file alongside: events prefer it, profiles still load
     (tmp_path / "trace_merged.json").write_text(
         json.dumps({"traceEvents": events}))
-    ev, profiles = otpu_analyze.load_run([str(tmp_path)])
+    ev, profiles, _meta = otpu_analyze.load_run([str(tmp_path)])
     assert len(ev) == len(events)
     assert set(profiles) == {0, 1, 2}
     rep = otpu_analyze.analyze(ev, profiles=profiles)
@@ -296,7 +296,7 @@ def test_stage_breakdown_reconciles_on_loopback_allreduce(tmp_path):
     assert r.returncode == 0, out
     from ompi_tpu.tools import otpu_analyze
 
-    events, profiles = otpu_analyze.load_run([str(tdir)])
+    events, profiles, _meta = otpu_analyze.load_run([str(tdir)])
     assert set(profiles) == {0, 1, 2}, (sorted(profiles), out)
     rep = otpu_analyze.analyze(events, profiles=profiles)
     assert rep["rounds_total"] >= 25, rep["rounds_total"]

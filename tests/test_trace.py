@@ -213,6 +213,47 @@ def _payload(rank, offset_us, spans):
     }
 
 
+def test_merge_handles_negative_offsets_and_zero_span_ranks():
+    """Crash-bundle shapes: a rank whose clock ran BEHIND the coord's
+    (negative offset) must align onto the same timebase, and a rank
+    whose payload has zero spans must neither crash the merge/skew path
+    nor erase the other ranks' matched rounds."""
+    # rank 0 runs 500us behind the coord clock (offset is ours MINUS
+    # the coord's, so it is negative); rank 1 runs 250us ahead
+    p0 = _payload(0, -500.0, [("allreduce", -400.0, 50.0, 1024)])
+    p1 = _payload(1, 250.0, [("allreduce", 350.0, 80.0, 1024)])
+    p2 = _payload(2, 100.0, [])               # zero spans (died early)
+    merged = trace.merge_timelines([p0, p1, p2])
+    assert [e["ts"] for e in merged] == [100.0, 100.0]
+    assert sorted(e["pid"] for e in merged) == [0, 1]
+
+    report = trace.skew_report([p0, p1, p2])
+    # the zero-span rank must NOT zero the survivors' rounds (the
+    # pre-fix behavior: min over ALL ranks made every round unmatched)
+    line = next(ln for ln in report.splitlines()
+                if ln.startswith("allreduce"))
+    cols = line.split()
+    assert cols[2] == "1", line               # one matched round
+    assert cols[5] == "1", line               # rank 1's 80us is slowest
+    assert "absent" in line                   # the dead rank is noted
+    assert "3 ranks" in report
+
+
+def test_flow_events_survive_merge_and_export(tracer):
+    trace.flow_start("pml_msg", (3, 0, 1, 9))
+    trace.flow_finish("pml_msg", (3, 0, 1, 9))
+    payload = trace.chrome_payload(1, clock_offset_us=-40.0)
+    payload = json.loads(json.dumps(payload))
+    merged = trace.merge_timelines([payload])
+    flows = [e for e in merged if e["ph"] in ("s", "f")]
+    assert len(flows) == 2
+    assert all(e["id"] == "3.0.1.9" for e in flows)
+    assert all(e["pid"] == 1 for e in flows)
+    # alignment shifted the flow timestamps like any span's
+    raw = [e for e in payload["traceEvents"] if e["ph"] in ("s", "f")]
+    assert flows[0]["ts"] == raw[0]["ts"] + 40.0
+
+
 def test_merge_aligns_clocks_and_skew_names_slowest():
     # rank 1's clock runs 1000us ahead of the coord clock; after merge
     # both ranks' allreduces line up at ts=100
