@@ -837,6 +837,24 @@ def _serving_md_section(rows) -> list:
     return lines
 
 
+def _splice_md_section(md: str, heading_prefix: str,
+                       new_lines: list) -> str:
+    """Replace ONE '## ' section of the sweep markdown (matched by its
+    heading prefix; appended at the end when absent), PRESERVING every
+    later section — a plain partition-and-truncate silently deleted
+    whatever another refresher had appended after the replaced heading
+    (the --serving run ate the committed Recovery/Quant sections)."""
+    head, sep, tail = md.partition("\n" + heading_prefix)
+    rest = ""
+    if sep:
+        nxt = tail.find("\n## ")
+        if nxt != -1:
+            rest = tail[nxt:]
+    return (head.rstrip("\n") + "\n" + "\n".join(new_lines) + "\n"
+            + ("\n" + rest.strip("\n") + "\n" if rest.strip("\n")
+               else ""))
+
+
 def refresh_serving_tables() -> list:
     """``bench.py --serving``: run the serving rows and fold them into
     the committed sweep tables (replacing any previous serving rows) —
@@ -860,10 +878,9 @@ def refresh_serving_tables() -> list:
             md = f.read()
     except OSError:
         md = "# Collective sweep\n"
-    head, _sep, _old = md.partition(
-        "\n## Serving (Poisson open-loop")
-    _atomic_write(md_path, head.rstrip("\n") + "\n"
-                  + "\n".join(_serving_md_section(rows)) + "\n")
+    _atomic_write(md_path, _splice_md_section(
+        md, "## Serving (Poisson open-loop",
+        _serving_md_section(rows)))
     return rows
 
 
@@ -981,10 +998,267 @@ def refresh_recovery_tables() -> list:
             md = f.read()
     except OSError:
         md = "# Collective sweep\n"
-    head, _sep, _old = md.partition(
-        "\n## Recovery (elastic train-through-failure)")
-    _atomic_write(md_path, head.rstrip("\n") + "\n"
-                  + "\n".join(_recovery_md_section(rows)) + "\n")
+    _atomic_write(md_path, _splice_md_section(
+        md, "## Recovery (elastic train-through-failure)",
+        _recovery_md_section(rows)))
+    return rows
+
+
+_QUANT_WIRE = """
+import json, time
+import numpy as np
+import ompi_tpu
+from ompi_tpu.mca.coll import quant
+from ompi_tpu.runtime import spc
+
+w = ompi_tpu.init()
+n = (4 << 20) // 4
+base = np.stack([np.random.default_rng([7, r]).standard_normal(n)
+                 for r in range(w.size)]).astype(np.float32)
+mine = base[w.rank]
+exact = base.astype(np.float64).sum(0)
+w.barrier()
+got = np.asarray(w.allreduce(mine))          # warm
+reps = 3
+t0 = time.perf_counter()
+for _ in range(reps):
+    got = np.asarray(w.allreduce(mine))
+dt = (time.perf_counter() - t0) / reps
+rel = float(np.max(np.abs(got - exact)) / max(1e-12,
+                                              np.max(np.abs(exact))))
+st = quant.wire_stats()
+if w.rank == 0:
+    print("QUANTWIRE " + json.dumps({
+        "lat_us": round(dt * 1e6, 1),
+        "eff_gbs": round(n * 4 / dt / 1e9, 4),
+        "wire_orig": st["orig"], "wire_enc": st["enc"],
+        "wire_saved": spc.read("quant_wire_bytes_saved"),
+        "max_rel_err": rel}), flush=True)
+ompi_tpu.finalize()
+"""
+
+
+def _quant_wire_rows() -> list:
+    """Wire-path evidence: the 4MB host allreduce over loopback tcp
+    (the PR 4 fastpath wire) with quantize-on-pack ON vs OFF — latency,
+    effective GB/s, measured bytes-on-wire (orig vs encoded out of the
+    codec stage's own accounting), and max relative error vs the f64
+    exact sum.  rd forced so both runs move the same message pattern."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(_QUANT_WIRE)
+        script = f.name
+    rows = []
+    try:
+        for name, wire in (("quant_wire_off_4MB", "0"),
+                           ("quant_wire_int8_4MB", "1")):
+            proc = subprocess.run(
+                [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+                 "-n", "2", "--fake-nodes", "2",
+                 "--mca", "otpu_coll_sm_coll_priority", "0",
+                 "--mca", "otpu_coll_quant_wire", wire,
+                 "--mca", "otpu_coll_tuned_allreduce_algorithm",
+                 "recursive_doubling",
+                 "--mca", "pml_ob1_stripe", "0",
+                 "--mca", "pml_ob1_rget_limit", "0",
+                 sys.executable, script],
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if "QUANTWIRE " in ln), None)
+            if proc.returncode or line is None:
+                print(f"quant wire bench ({name}) failed "
+                      f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}",
+                      file=sys.stderr)
+                rows.append({"coll": name, "ok": False})
+                continue
+            rep = json.loads(line.split("QUANTWIRE ", 1)[1])
+            row = {"coll": name, "nbytes": 4 << 20}
+            row.update(rep)
+            if rep.get("wire_enc"):
+                row["wire_ratio"] = round(rep["wire_orig"]
+                                          / rep["wire_enc"], 2)
+            rows.append(row)
+    finally:
+        os.unlink(script)
+    return rows
+
+
+def _quant_kv_row(codec: str = "int8") -> dict:
+    """KV-slab evidence: encode+decode cost per 4096-elem block, the
+    capacity multiplier (raw slot bytes / encoded slot bytes — the
+    users-per-chip factor), and the codec's measured error."""
+    import numpy as np
+
+    from ompi_tpu.mca.coll import quant
+
+    elems, reps = 4096, 64
+    rng = np.random.default_rng(11)
+    blocks = rng.standard_normal((reps, elems)).astype(np.float32)
+    enc0 = quant.encode_f32(blocks[0], codec)
+    t0 = time.perf_counter()
+    worst = 0.0
+    for i in range(reps):
+        enc = quant.encode_f32(blocks[i], codec)
+        dec = quant.decode_f32(enc, codec, elems)
+        worst = max(worst, float(np.max(np.abs(dec - blocks[i]))
+                                 / np.max(np.abs(blocks[i]))))
+    dt = (time.perf_counter() - t0) / reps
+    return {"coll": f"quant_kv_{codec}", "nbytes": elems * 4,
+            "lat_us": round(dt * 1e6, 1),
+            "enc_bytes": int(enc0.nbytes),
+            "capacity_x": round(elems * 4 / enc0.nbytes, 2),
+            "max_rel_err": worst}
+
+
+_QUANT_DEVICE = """
+import json, time
+import numpy as np
+import ompi_tpu
+
+w = ompi_tpu.init()
+n = (4 << 20) // 4
+host = np.stack([np.random.default_rng([13, r]).standard_normal(n)
+                 for r in range(w.size)]).astype(np.float32)
+exact = host.astype(np.float64).sum(0)
+import jax
+xla = next(m for m in w.coll_modules
+           if type(m).__name__ == "XlaCollModule")
+rows = []
+for name, budget in (("quant_device_off_4MB", None),
+                     ("quant_device_int8_4MB", "0.02")):
+    c = w.dup()
+    if budget is not None:
+        c.info.set("otpu_quant_budget", budget)
+    x = next(m for m in c.coll_modules
+             if type(m).__name__ == "XlaCollModule").make_world_array(host)
+    out = np.asarray(c.allreduce_array(x))       # compile + warm
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jax.block_until_ready(c.allreduce_array(x))
+    dt = (time.perf_counter() - t0) / reps
+    rel = float(np.max(np.abs(np.asarray(out) - exact))
+                / max(1e-12, np.max(np.abs(exact))))
+    rows.append({"coll": name, "nbytes": n * 4,
+                 "lat_us": round(dt * 1e6, 1),
+                 "eff_gbs": round(n * 4 / dt / 1e9, 3),
+                 "max_rel_err": rel})
+print("QUANTDEV " + json.dumps(rows), flush=True)
+ompi_tpu.finalize()
+"""
+
+
+def _quant_device_rows() -> list:
+    """Device-tier rows — run ONLY after the device probe succeeds
+    (the carried-forward-honesty rule: a fake-device run must never
+    mint device rows; the CPU-side compile coverage lives in
+    tests/test_quant.py's AOT gate instead)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(_QUANT_DEVICE)
+        script = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            timeout=600, env=dict(os.environ))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if "QUANTDEV " in ln), None)
+        if proc.returncode or line is None:
+            print(f"quant device bench failed (rc={proc.returncode}):"
+                  f"\n{proc.stderr[-2000:]}", file=sys.stderr)
+            return []
+        return json.loads(line.split("QUANTDEV ", 1)[1])
+    finally:
+        os.unlink(script)
+
+
+def quant_rows(probe_device: bool = True) -> list:
+    """``bench.py --quant``: wire + KV rows always; device rows ONLY
+    when the TPU probe answers (the tunnel has been down since round 5
+    — emitting quant device rows from a CPU run would launder
+    fake-device numbers into the carried-forward table)."""
+    rows = _quant_wire_rows() + [_quant_kv_row("int8"),
+                                 _quant_kv_row("bf16")]
+    if probe_device:
+        ok, detail = backend_available()
+        if ok:
+            rows += _quant_device_rows()
+        else:
+            print("quant: TPU probe failed — device rows NOT emitted "
+                  f"(re-earn on hardware): {detail.splitlines()[0][:120]}",
+                  file=sys.stderr)
+    return rows
+
+
+def _quant_md_section(rows) -> list:
+    lines = ["", "## Quant (block-scale quantized collectives & KV)",
+             "",
+             "`bench.py --quant`: the coll/quant codec across its "
+             "three datapaths.  Wire rows are the 4MB loopback-tcp "
+             "host allreduce with quantize-on-pack off/on (`wire B` "
+             "is measured bytes-on-wire out of the codec stage; the "
+             "byte win pays on a real DCN wire — loopback moves at "
+             "memcpy speed, so latency is codec-dominated there).  "
+             "KV rows are per-block encode+decode cost and the slots-"
+             "per-worker capacity multiplier.  Device rows appear "
+             "ONLY when the TPU probe succeeds.",
+             "",
+             "| row | bytes | lat us | eff GB/s | wire B (orig→enc) | "
+             "ratio/cap x | max rel err |",
+             "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        if not r.get("ok", True):
+            lines.append(f"| {r['coll']} | FAILED | - | - | - | - | "
+                         "- |")
+            continue
+        wire = (f"{r['wire_orig']}→{r['wire_enc']}"
+                if r.get("wire_orig") else "-")
+        factor = r.get("wire_ratio", r.get("capacity_x", "-"))
+        lines.append(
+            f"| {r['coll']} | {r.get('nbytes', '-')} | "
+            f"{r.get('lat_us', '-')} | {r.get('eff_gbs', '-')} | "
+            f"{wire} | {factor} | "
+            f"{round(r['max_rel_err'], 6) if 'max_rel_err' in r else '-'} |")
+    return lines
+
+
+def refresh_quant_tables() -> list:
+    """``bench.py --quant``: run the quant rows, fold them into the
+    committed sweep tables (replacing previous quant rows — the
+    serving-table discipline), and append the wire-on row as a
+    BENCH_HISTORY point so ``otpu_perf --diff`` guards it."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows = quant_rows()
+    try:
+        with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        payload = {"ndev": 0, "results": []}
+    payload["results"] = [r for r in payload.get("results", [])
+                          if not str(r.get("coll", "")).startswith(
+                              "quant_")] + rows
+    _atomic_write(os.path.join(here, "BENCH_SWEEP.json"),
+                  json.dumps(payload, indent=1))
+    md_path = os.path.join(here, "BENCH_SWEEP.md")
+    try:
+        with open(md_path) as f:
+            md = f.read()
+    except OSError:
+        md = "# Collective sweep\n"
+    _atomic_write(md_path, _splice_md_section(
+        md, "## Quant (block-scale quantized collectives & KV)",
+        _quant_md_section(rows)))
+    hist = [{"key": r["coll"], "lat_us": r["lat_us"], "k": 3}
+            for r in rows
+            if r.get("ok", True) and r.get("lat_us")
+            and str(r["coll"]).startswith("quant_wire_")]
+    if hist:
+        append_history(hist, "bench", "host_tcp_n2")
     return rows
 
 
@@ -1669,11 +1943,11 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
                 stale_device_rows=None, stale_rounds=0,
                 mfu=None) -> None:
     here = os.path.dirname(os.path.abspath(__file__))
-    # serving/recovery rows are refreshed by `bench.py --serving` /
-    # `--recovery`, not by the sweep: carry the committed ones forward
-    # so a sweep refresh cannot erase them (the carried-device-rows
-    # discipline)
-    for prefix in ("serving_", "recovery_"):
+    # serving/recovery/quant rows are refreshed by `bench.py --serving`
+    # / `--recovery` / `--quant`, not by the sweep: carry the committed
+    # ones forward so a sweep refresh cannot erase them (the
+    # carried-device-rows discipline)
+    for prefix in ("serving_", "recovery_", "quant_"):
         if not any(str(r.get("coll", "")).startswith(prefix)
                    for r in results):
             try:
@@ -1698,7 +1972,8 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
     lines += [f"Devices: {ndev}", ""] + _table(
         [r for r in results
          if not str(r.get("coll", "")).startswith(("serving_",
-                                                   "recovery_"))])
+                                                   "recovery_",
+                                                   "quant_"))])
     if mfu:
         lines += ["", "## Single-chip MFU", ""]
         for r in mfu:
@@ -1731,6 +2006,10 @@ def write_sweep(ndev, results, multidev_rows, header_note="",
                     if str(r.get("coll", "")).startswith("recovery_")]
     if recovery_now:
         lines += _recovery_md_section(recovery_now)
+    quant_now = [r for r in results
+                 if str(r.get("coll", "")).startswith("quant_")]
+    if quant_now:
+        lines += _quant_md_section(quant_now)
     _atomic_write(os.path.join(here, "BENCH_SWEEP.md"),
                   "\n".join(lines) + "\n")
 
@@ -2379,6 +2658,9 @@ if __name__ == "__main__":
             print(json.dumps(row))
     elif "--recovery" in sys.argv:
         for row in refresh_recovery_tables():
+            print(json.dumps(row))
+    elif "--quant" in sys.argv:
+        for row in refresh_quant_tables():
             print(json.dumps(row))
     elif "--pod-smoke" in sys.argv:
         sys.exit(pod_smoke(dry_run="--dry-run" in sys.argv))

@@ -52,6 +52,13 @@ class Frag:
     offset: int = 0       # stream offset of this fragment (FRAG)
     meta: dict = field(default_factory=dict)
     borrowed: bool = False
+    #: coll/quant wire codec this payload may travel under (stamped by
+    #: the pml, which still knows the dtype; the btl's codec stage
+    #: encodes eligible frames and the receive parse decodes them back
+    #: to the ORIGINAL bytes, so total_len/offset stay in raw-stream
+    #: units).  None = raw bytes; transports without a codec stage
+    #: (sm rings, in-process loopback) ignore it.
+    qcodec: "Optional[str]" = None
 
     def own_data(self) -> None:
         """Replace a borrowed view with an owned copy (idempotent)."""

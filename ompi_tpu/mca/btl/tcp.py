@@ -55,22 +55,32 @@ from ompi_tpu.base.var import VarType
 from ompi_tpu.ft import chaos
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, \
     Btl, Endpoint, Frag
+from ompi_tpu.mca.coll import quant as quant_mod
 from ompi_tpu.runtime import profile, sanitizer, spc, trace
 from ompi_tpu.runtime.hotpath import hot_path
 
 _LEN = struct.Struct("!I")
 _MAX_FRAME = (1 << 32) - 1          # the !I length prefix's ceiling
 
-# header-type byte (per-fragment negotiation)
+# header-type byte (per-fragment negotiation; the bits compose)
 _H_PICKLE = 0
 _H_FAST = 1
-# checksummed variants (htype + _H_CK_BASE): the frame carries a crc32
+# checksummed variants (htype | _H_CK_BASE): the frame carries a crc32
 # of everything after the crc field.  Armed under chaos / OTPU_SANITIZE
 # on the SEND side; the receiver verifies whatever arrives checksummed,
 # so mixed-arming jobs interoperate.  Silent wire corruption becomes a
 # loud, attributed error instead of a downstream mystery.
 _H_CK_BASE = 2
 _CKSUM = struct.Struct("!I")
+# quantized variants (htype | _H_QUANT — the crc32 framing precedent):
+# the payload travels through the coll/quant block-scale codec, with a
+# small quant sub-header [u8 codec][u32 raw_len][u16 block] between the
+# crc (which covers it) and the message header.  Stamped per-fragment
+# by the pml (Frag.qcodec — only it still knows the bytes are f32);
+# the receive parse decodes back to the ORIGINAL byte stream, so the
+# pml's reassembly offsets never see codec bytes.
+_H_QUANT = 4
+_QHDR = struct.Struct("!BIH")
 
 
 def _cksum_armed() -> bool:
@@ -148,6 +158,12 @@ class _Conn:
         # concurrent sendmsg calls over one queue would interleave
         # frames and desynchronise the peer's framing
         self.send_lock = threading.Lock()
+
+
+def _conn_peer(conn: "Optional[_Conn]") -> int:
+    """Attributed rank of a connection (-1: pre-handshake)."""
+    return conn.rank if conn is not None and conn.rank is not None \
+        else -1
 
 
 class TcpBtl(Btl):
@@ -399,9 +415,6 @@ class TcpBtl(Btl):
             raise ConnectionError(
                 f"chaos: injected connection reset to rank "
                 f"{ep.world_rank}")
-        # stage clock: frame build + enqueue, the wire syscall excluded
-        # (that is send.wire, recorded inside _flush_locked)
-        _pt = profile.now() if profile.enabled else 0
         # payload as a flat byte view — memoryview routes an ndarray
         # through the buffer protocol; .cast("B") flattens multi-dim /
         # non-uint8 views so len() counts bytes
@@ -411,10 +424,29 @@ class TcpBtl(Btl):
         if isinstance(payload, memoryview) and (
                 payload.ndim != 1 or payload.itemsize != 1):
             payload = payload.cast("B")
+        # coll/quant codec stage: between the convertor's pack and the
+        # out-queue.  Runs BEFORE the send.queue stage begin (the
+        # encode carries its own quant.encode clock inside encode_wire)
+        # and replaces the payload with an OWNED encoded array, so the
+        # borrowed-remainder machinery below never runs for it.
+        qhdr = b""
+        borrowed = frag.borrowed
+        qbit = 0
+        if quant_mod.wire_enabled and frag.qcodec is not None:
+            enc = quant_mod.encode_wire(payload, frag.qcodec)
+            if enc is not None:
+                qhdr = _QHDR.pack(quant_mod.codec_id(frag.qcodec),
+                                  len(payload), quant_mod.block_elems())
+                payload = memoryview(enc)
+                borrowed = False
+                qbit = _H_QUANT
+        # stage clock: frame build + enqueue, the wire syscall excluded
+        # (that is send.wire, recorded inside _flush_locked)
+        _pt = profile.now() if profile.enabled else 0
         hdr = _fast_header(frag)
         if hdr is not None:
             spc.record("fastpath_hdr_fast")
-            htype = _H_FAST
+            htype = _H_FAST | qbit
         else:
             spc.record("fastpath_hdr_pickle")
             hdr = pickle.dumps(
@@ -422,25 +454,27 @@ class TcpBtl(Btl):
                  frag.kind, frag.total_len, frag.offset, frag.meta),
                 protocol=pickle.HIGHEST_PROTOCOL)
             hdr = _LEN.pack(len(hdr)) + hdr
-            htype = _H_PICKLE
+            htype = _H_PICKLE | qbit
         if _cksum_armed():
-            # checksummed variant: [len][htype+2][crc32][hdr][payload],
-            # crc over everything after the crc field
-            crc = zlib.crc32(payload, zlib.crc32(hdr))
-            frame_len = 1 + _CKSUM.size + len(hdr) + len(payload)
+            # checksummed variant: [len][htype|2][crc32][qhdr][hdr]
+            # [payload], crc over everything after the crc field —
+            # the quant sub-header is covered too
+            crc = zlib.crc32(payload, zlib.crc32(hdr, zlib.crc32(qhdr)))
+            frame_len = 1 + _CKSUM.size + len(qhdr) + len(hdr) \
+                + len(payload)
             if frame_len > _MAX_FRAME:
                 raise self._frame_too_large(frame_len)
-            head = (_LEN.pack(frame_len) + bytes((htype + _H_CK_BASE,))
-                    + _CKSUM.pack(crc) + hdr)
+            head = (_LEN.pack(frame_len) + bytes((htype | _H_CK_BASE,))
+                    + _CKSUM.pack(crc) + qhdr + hdr)
         else:
-            frame_len = 1 + len(hdr) + len(payload)
+            frame_len = 1 + len(qhdr) + len(hdr) + len(payload)
             # re-checked here: a pickle header can outgrow the fast-
             # header size the early payload check assumed — and the
             # check must precede _LEN.pack, which would die on a
             # bare struct.error first
             if frame_len > _MAX_FRAME:
                 raise self._frame_too_large(frame_len)
-            head = _LEN.pack(frame_len) + bytes((htype,)) + hdr
+            head = _LEN.pack(frame_len) + bytes((htype,)) + qhdr + hdr
         if chaos_rule is not None and chaos_rule["fault"] == "corrupt":
             # on-the-wire bit rot, injected AFTER the checksum was
             # computed (the armed receiver catches it loudly); flips a
@@ -460,14 +494,14 @@ class TcpBtl(Btl):
             if profile.enabled:
                 profile.stage_span("send.queue", _pt)
             self._flush_locked(conn)
-            if conn.outq and frag.borrowed and queued == 2:
+            if conn.outq and borrowed and queued == 2:
                 # whatever the kernel did not take must stop aliasing
                 # the caller's buffer before we return (Frag contract:
                 # borrowed views die with this call).  Only the queued
                 # REMAINDER is copied — the common uncongested case
                 # stays zero-copy end to end.
                 self._own_queued_locked(conn, queued)
-            if sanitizer.enabled and frag.borrowed:
+            if sanitizer.enabled and borrowed:
                 # ownership tag: after a borrowed send returns, no queue
                 # entry may still alias the caller's memory
                 owner = payload.obj if isinstance(payload, memoryview) \
@@ -738,7 +772,7 @@ class TcpBtl(Btl):
                             chaos.sleep_ms(rule)
                         elif rule["fault"] == "corrupt" \
                                 and fl > 1 + _CKSUM.size + 1 \
-                                and frame[0] >= _H_CK_BASE:
+                                and frame[0] & _H_CK_BASE:
                             # pre-verify bit rot in the recv scratch:
                             # only on checksummed frames (an unarmed
                             # sender's frame would corrupt silently —
@@ -798,28 +832,36 @@ class TcpBtl(Btl):
     def _parse_frame(self, conn: _Conn, frame,
                      borrowed: bool = False) -> Optional[Frag]:
         """Decode one frame (bytes or memoryview).  ``borrowed`` marks
-        the payload as a view of transient recv scratch.  Checksummed
-        frames (htype >= _H_CK_BASE, armed sender) are verified before
-        any parse: a mismatch is a loud, attributed error, never a
-        silently-corrupt delivery."""
+        the payload as a view of transient recv scratch.  The htype
+        bits compose: checksummed frames (``htype & _H_CK_BASE``, armed
+        sender) are verified before any parse — a mismatch is a loud,
+        attributed error, never a silently-corrupt delivery — and
+        quantized frames (``htype & _H_QUANT``) dequantize straight out
+        of the recv view into an OWNED array of the original bytes."""
         import numpy as np
 
         htype = frame[0]
         off = 1
-        if htype >= _H_CK_BASE:
+        if htype & _H_CK_BASE:
             (want,) = _CKSUM.unpack_from(frame, 1)
             off = 1 + _CKSUM.size
             got = zlib.crc32(memoryview(frame)[off:])
             if got != want:
                 self._corrupt_frame(conn, len(frame), want, got)
-            htype -= _H_CK_BASE
-        if htype == _H_FAST:
+        qmeta = None
+        if htype & _H_QUANT:
+            qmeta = _QHDR.unpack_from(frame, off)
+            off += _QHDR.size
+        if htype & _H_FAST:
             (cid, src, dst, tag, seq, code, total_len, offset,
              req_id) = _FAST.unpack_from(frame, off)
+            data = np.frombuffer(frame, np.uint8,
+                                 offset=off + _FAST.size)
+            if qmeta is not None:
+                data = self._dequant_payload(conn, data, qmeta)
+                borrowed = False
             return Frag(cid, src, dst, tag, seq, _CODE_TO_KIND[code],
-                        np.frombuffer(frame, np.uint8,
-                                      offset=off + _FAST.size),
-                        total_len, offset,
+                        data, total_len, offset,
                         {} if req_id < 0 else {"req_id": req_id},
                         borrowed=borrowed)
         (hlen,) = _LEN.unpack_from(frame, off)
@@ -832,38 +874,70 @@ class TcpBtl(Btl):
                 self._by_rank.setdefault(conn.rank, []).append(conn)
             return None
         cid, src, dst, tag, seq, kind, total_len, offset, meta = obj
-        return Frag(cid, src, dst, tag, seq, kind,
-                    np.frombuffer(frame, np.uint8,
-                                  offset=off + _LEN.size + hlen),
+        data = np.frombuffer(frame, np.uint8,
+                             offset=off + _LEN.size + hlen)
+        if qmeta is not None:
+            data = self._dequant_payload(conn, data, qmeta)
+            borrowed = False
+        return Frag(cid, src, dst, tag, seq, kind, data,
                     total_len, offset, meta, borrowed=borrowed)
+
+    def _dequant_payload(self, conn: Optional[_Conn], data, qmeta):
+        """Receive side of the codec stage: the quant sub-header names
+        the codec/raw-length/block, and the decode MUST be exact — any
+        inconsistency is wire corruption and fails as loudly as a crc32
+        mismatch (show_help + abort event + SanitizeError), never a
+        silently-garbage delivery."""
+        try:
+            return quant_mod.decode_wire(data, qmeta[0], qmeta[1],
+                                         qmeta[2])
+        except (ValueError, KeyError) as exc:
+            from ompi_tpu.base.output import show_help
+
+            peer = _conn_peer(conn)
+            show_help("help-coll-quant", "wire-frame-bad",
+                      peer=peer, error=str(exc))
+            self._wire_fault(
+                "quant_wire_decode_fail", peer, len(data),
+                "quant wire frame",
+                f"btl/tcp quantized frame from rank {peer} does not "
+                f"decode ({exc}): wire corruption detected")
 
     def _corrupt_frame(self, conn: Optional[_Conn], nbytes: int,
                        want: int, got: int) -> None:
         """A checksummed frame failed verification: silent wire
-        corruption made loud and attributed.  Raising from the progress
-        thread alone would only unregister this btl's callback and turn
-        the job into a hang — the abort event lets the launcher tear
-        the job down with the diagnostic on record."""
+        corruption made loud and attributed."""
         from ompi_tpu.base.output import show_help
 
-        peer = conn.rank if conn is not None and conn.rank is not None \
-            else -1
-        spc.record("wire_cksum_fail")
-        if trace.enabled:
-            trace.instant("wire_cksum_fail", "btl",
-                          args={"peer": peer, "nbytes": nbytes})
+        peer = _conn_peer(conn)
         show_help("help-btl-tcp", "frame-corrupt", peer=peer,
                   nbytes=nbytes, want=want, got=got)
-        if self._rte is not None:
-            try:
-                self._rte.event_notify(
-                    "abort", {"code": 1, "why": "wire corruption"})
-            except Exception:
-                pass
-        raise sanitizer.SanitizeError(
+        self._wire_fault(
+            "wire_cksum_fail", peer, nbytes, "wire corruption",
             f"btl/tcp frame from rank {peer} failed its crc32 "
             f"({nbytes} bytes, want {want:#x} got {got:#x}): wire "
             "corruption detected")
+
+    def _wire_fault(self, counter: str, peer: int, nbytes: int,
+                    why: str, message: str) -> None:
+        """Shared tail of a wire-integrity trip (crc mismatch, quant
+        frame that does not decode — each under its OWN counter/trace
+        name so the two fault classes stay distinguishable): counted,
+        trace-instant'ed, abort event posted, SanitizeError raised.
+        Raising from the progress thread alone would only unregister
+        this btl's callback and turn the job into a hang — the abort
+        event (and the progress loop re-raising SanitizeError) lets
+        the launcher tear the job down with the diagnostic on record."""
+        spc.record(counter)
+        if trace.enabled:
+            trace.instant(counter, "btl",
+                          args={"peer": peer, "nbytes": nbytes})
+        if self._rte is not None:
+            try:
+                self._rte.event_notify("abort", {"code": 1, "why": why})
+            except Exception:
+                pass
+        raise sanitizer.SanitizeError(message)
 
     def close(self) -> None:
         # a closed btl must stop publishing telemetry: the sampler may

@@ -27,6 +27,7 @@ from ompi_tpu.api import op as op_mod
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
 from ompi_tpu.mca.coll import algorithms as algs
+from ompi_tpu.mca.coll import quant as quant_mod
 from ompi_tpu.mca.coll.basic import BasicCollModule
 from ompi_tpu.runtime import profile, spc
 from ompi_tpu.runtime.hotpath import hot_path
@@ -248,6 +249,22 @@ class TunedModule:
                 return algs.allreduce_recursive_doubling(comm, sendbuf, op)
             finally:
                 profile.stage_span("coll.alg", _pt)
+        # coll/quant arm of the ladder: the (dtype, size, accuracy_
+        # budget) rule key, armed only by an EXPLICIT per-comm budget
+        # info key and never for non-commutative ops (pick re-checks) —
+        # a force-var stays the user's override and wins outright
+        if (op.commute and not self._c.force_var("allreduce")):
+            qcodec = quant_mod.pick(comm, "allreduce",
+                                    getattr(sendbuf, "dtype", None),
+                                    nbytes, op)
+            if qcodec is not None:
+                _pt = profile.now() if profile.enabled else 0
+                try:
+                    return quant_mod.allreduce_blockq(comm, sendbuf,
+                                                      op, qcodec)
+                finally:
+                    if profile.enabled:
+                        profile.stage_span("coll.alg", _pt)
         default = default_algorithm("allreduce", comm.size, nbytes,
                                     op.commute)
         alg, seg = self._pick("allreduce", comm.size, nbytes, default,
@@ -280,6 +297,19 @@ class TunedModule:
 
     def allgather(self, comm, sendbuf):
         nbytes = _nbytes(sendbuf)
+        # coll/quant arm (see allreduce): explicit budget only
+        if not self._c.force_var("allgather"):
+            qcodec = quant_mod.pick(comm, "allgather",
+                                    getattr(sendbuf, "dtype", None),
+                                    nbytes)
+            if qcodec is not None:
+                _pt = profile.now() if profile.enabled else 0
+                try:
+                    return quant_mod.allgather_blockq(comm, sendbuf,
+                                                      qcodec)
+                finally:
+                    if profile.enabled:
+                        profile.stage_span("coll.alg", _pt)
         default = default_algorithm("allgather", comm.size, nbytes)
         alg, _ = self._pick("allgather", comm.size, nbytes, default)
         return self._run("allgather", alg, default, comm, sendbuf)

@@ -32,6 +32,7 @@ from ompi_tpu.api.errors import ErrorClass, MpiError
 from ompi_tpu.api.request import CompletedRequest
 from ompi_tpu.base.mca import Component
 from ompi_tpu.base.var import VarType
+from ompi_tpu.mca.coll import quant as quant_mod
 from ompi_tpu.runtime import spc, trace
 
 
@@ -222,6 +223,16 @@ class XlaCollModule:
 
     # -- collective slots ------------------------------------------------
     def allreduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM):
+        # coll/quant tier: an EXPLICIT per-comm accuracy budget (the
+        # info key) routes eligible (dtype, size) cells onto the
+        # block-quantized program.  The `in` probe is one dict get;
+        # comms that never declared a budget pay nothing else.
+        if quant_mod.BUDGET_KEY in comm.info and op.jax_reduce == "psum":
+            codec = quant_mod.pick(comm, "allreduce",
+                                   getattr(x, "dtype", None),
+                                   int(getattr(x, "nbytes", 0)), op)
+            if codec is not None:
+                return self._quant_allreduce(comm, x, op, codec)
         # steady-state fast path: one dict probe, then straight into the
         # compiled program
         if isinstance(x, self._jax_array):
@@ -234,6 +245,37 @@ class XlaCollModule:
             lambda: self._shard_map(
                 lambda t: self._reduce_in_shard(op)(t[0]),
                 P(self.axis), P()))
+        return fn(x)
+
+    def _quant_allreduce(self, comm, x, op: op_mod.Op, codec: str):
+        """Block-quantized allreduce: per-shard encode (pallas), gather
+        the int8 payloads + per-block scales over the mesh axis, and a
+        fused dequant-accumulate kernel folds them — the encoded bytes
+        (~3.9x fewer for int8, 2x for bf16) are what cross the links."""
+        import jax
+        import jax.numpy as jnp
+
+        P = self._P
+        ax = self.axis
+
+        def body(t):  # (1, *S) -> (*S), replicated like allreduce
+            from ompi_tpu.ops import pallas_quant as pq
+
+            flat = t[0].reshape(-1)
+            if codec == "bf16":
+                g = jax.lax.all_gather(flat.astype(jnp.bfloat16), ax)
+                return jnp.sum(g.astype(jnp.float32),
+                               axis=0).reshape(t[0].shape)
+            q, s = pq.encode_int8(flat)
+            qg = jax.lax.all_gather(q, ax)
+            sg = jax.lax.all_gather(s, ax)
+            out = pq.dequant_accumulate(qg, sg)
+            return out.reshape(-1)[:flat.shape[0]].reshape(t[0].shape)
+
+        # pick() already required a real dtype, so x carries shape/dtype
+        fn, x = self._get(
+            comm, ("allreduce_quant", codec, op.name, x.shape, x.dtype),
+            x, lambda: self._shard_map(body, P(self.axis), P()))
         return fn(x)
 
     def reduce_array(self, comm, x, op: op_mod.Op = op_mod.SUM,
@@ -340,6 +382,15 @@ class XlaCollModule:
         return fn(x)
 
     def allgather_array(self, comm, x):
+        # coll/quant tier: same explicit-budget gate as allreduce —
+        # each rank's block travels encoded and decodes at every
+        # receiver (within the codec band)
+        if quant_mod.BUDGET_KEY in comm.info:
+            codec = quant_mod.pick(comm, "allgather",
+                                   getattr(x, "dtype", None),
+                                   int(getattr(x, "nbytes", 0)))
+            if codec is not None:
+                return self._quant_allgather(comm, x, codec)
         if isinstance(x, self._jax_array):
             fn = self._fast(self._keyfor("allgather", x))
             if fn is not None:
@@ -352,6 +403,36 @@ class XlaCollModule:
             lambda: self._shard_map(
                 lambda t: jax.lax.all_gather(t[0], self.axis),
                 P(self.axis), P()))
+        return fn(x)
+
+    def _quant_allgather(self, comm, x, codec: str):
+        """Block-quantized allgather: encode per shard, gather the
+        encoded payloads, decode all rows locally (pallas dequant)."""
+        import jax
+        import jax.numpy as jnp
+
+        P = self._P
+        ax = self.axis
+        n = self.n
+
+        def body(t):  # (1, *S) -> (n, *S), replicated
+            from ompi_tpu.ops import pallas_quant as pq
+
+            flat = t[0].reshape(-1)
+            if codec == "bf16":
+                g = jax.lax.all_gather(flat.astype(jnp.bfloat16), ax)
+                return g.astype(jnp.float32).reshape(
+                    (n,) + t[0].shape)
+            q, s = pq.encode_int8(flat)
+            qg = jax.lax.all_gather(q, ax)        # (n, rows, 128)
+            sg = jax.lax.all_gather(s, ax)        # (n, rows, 1)
+            dec = pq.decode_int8(qg, sg)          # (n, rows, 128) f32
+            return dec.reshape(n, -1)[:, :flat.shape[0]].reshape(
+                (n,) + t[0].shape)
+
+        fn, x = self._get(
+            comm, ("allgather_quant", codec, x.shape, x.dtype), x,
+            lambda: self._shard_map(body, P(self.axis), P()))
         return fn(x)
 
     def allgatherv_array(self, comm, x, counts):

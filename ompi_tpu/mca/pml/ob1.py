@@ -28,6 +28,7 @@ from ompi_tpu.base.var import VarType
 from ompi_tpu.datatype import Convertor
 from ompi_tpu.mca.bml import Bml
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, Frag
+from ompi_tpu.mca.coll import quant as quant_mod
 from ompi_tpu.runtime import peruse, profile, spc, trace
 from ompi_tpu.runtime.hotpath import hot_path
 
@@ -296,7 +297,10 @@ class Ob1Pml:
             if profile.enabled:
                 profile.stage_span("send.pack", _pt)
             frag = Frag(comm.cid, src_world, dst_world, tag, seq, MATCH,
-                        data, total_len=req.nbytes, borrowed=borrowed)
+                        data, total_len=req.nbytes, borrowed=borrowed,
+                        qcodec=quant_mod.wire_codec_for(
+                            req.convertor, req.nbytes)
+                        if quant_mod.wire_enabled else None)
             ep.btl.send(ep, frag)
             req.complete()
             if peruse.active():
@@ -318,7 +322,10 @@ class Ob1Pml:
                 self._send_reqs[req.req_id] = req
                 frag = Frag(comm.cid, src_world, dst_world, tag, seq, RNDV,
                             head, total_len=req.nbytes,
-                            meta={"req_id": req.req_id}, borrowed=borrowed)
+                            meta={"req_id": req.req_id}, borrowed=borrowed,
+                            qcodec=quant_mod.wire_codec_for(
+                                req.convertor, req.nbytes)
+                            if quant_mod.wire_enabled else None)
                 ep.btl.send(ep, frag)
             except Exception:
                 # failed setup: the request will never complete, so the
@@ -359,6 +366,11 @@ class Ob1Pml:
         dst_world, peer_req = ack.src, ack.meta["peer_req"]
         rails = self._stripe_rails(dst_world, req.nbytes)
         conv = req.convertor
+        # coll/quant wire stamp, once per stream: the btl's codec stage
+        # only sees opaque packed bytes, so the dtype eligibility check
+        # must happen here, where the convertor still knows it
+        qc = quant_mod.wire_codec_for(conv, req.nbytes) \
+            if quant_mod.wire_enabled else None
         if len(rails) == 1:
             # single-rail fast lane: no finish-time bookkeeping at all
             ep = rails[0]
@@ -372,7 +384,7 @@ class Ob1Pml:
                 btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
                                   -1, 0, FRAG, data, total_len=req.nbytes,
                                   offset=off, meta={"req_id": peer_req},
-                                  borrowed=borrowed))
+                                  borrowed=borrowed, qcodec=qc))
         else:
             assigned = [0] * len(rails)
             while not conv.finished:
@@ -394,7 +406,7 @@ class Ob1Pml:
                 ep.btl.send(ep, Frag(ack.cid, ack.dst, dst_world,
                                      -1, 0, FRAG, data, total_len=req.nbytes,
                                      offset=off, meta={"req_id": peer_req},
-                                     borrowed=borrowed))
+                                     borrowed=borrowed, qcodec=qc))
         self._send_reqs.pop(req.req_id, None)
         req.complete()
         if peruse.active():

@@ -72,6 +72,10 @@ STAGES = {
     "coll.decide": "coll/tuned decision: ladder + rule-file lookup",
     "coll.alg": "coll/tuned algorithm body (schedule execution, wire "
                 "waits included)",
+    "quant.encode": "coll/quant block-scale encode (wire quantize-on-"
+                    "pack, host quant collectives, KV slab write)",
+    "quant.decode": "coll/quant block-scale decode (receive-parse "
+                    "dequant, dequant-accumulate folds, KV slab read)",
 }
 
 #: THE fast-path guard (trace/telemetry/chaos discipline): stage-clock

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Callable
 
+from ompi_tpu.runtime import sanitizer
 from ompi_tpu.runtime.hotpath import hot_path
 
 _LOW_PRIORITY_CADENCE = 8  # opal_progress.c:227
@@ -103,6 +104,15 @@ def progress() -> int:
         for cb in cbs:
             try:
                 events += cb()
+            except sanitizer.SanitizeError:
+                # a sanitizer trip (wire corruption, quant frame that
+                # does not decode, aliasing assert) is a DELIBERATE
+                # fatal integrity stop, not a broken callback:
+                # quarantining it here swallowed the error and turned
+                # detected corruption into a silent hang — propagate,
+                # so the waiting caller dies loudly and the launcher
+                # tears the job down
+                raise
             except Exception:
                 # a broken progress callback must not kill the loop; it is
                 # removed and reported once

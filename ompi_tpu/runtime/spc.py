@@ -49,6 +49,14 @@ _COUNTERS = (
     # emitted message-flow halves — finish/start ratio is the cheap
     # live proxy for the merged-timeline link rate
     "flow_starts", "flow_finishes",
+    # coll/quant block-scale codec (mca/coll/quant): encode/decode
+    # invocations across all three datapaths (device, wire, KV), the
+    # wire stage's measured byte savings (original minus encoded bytes
+    # of every quantized tcp frame), and quant frames that failed to
+    # decode on receive (its OWN counter — the crc did verify, so
+    # folding it into wire_cksum_fail would misattribute the fault)
+    "quant_encodes", "quant_decodes", "quant_wire_bytes_saved",
+    "quant_wire_decode_fail",
 )
 
 _pvars = {}

@@ -218,6 +218,7 @@ class PrefixStore:
         self.capacity = max(1, int(capacity) if capacity is not None
                             else int(_store_cap_var.value or 128))
         self.generation = 0
+        self._codec = ""
         self._lru: OrderedDict = OrderedDict()
 
     def has(self, h: str, generation: int) -> bool:
@@ -251,6 +252,23 @@ class PrefixStore:
         must never match again."""
         self.generation += 1
         self._lru.clear()
+
+    def set_codec(self, codec: str) -> None:
+        """Record the KV slab codec this store's blocks are held under.
+        A codec CHANGE invalidates every held block the way a recovery
+        does — the bytes a hint promised no longer exist in that
+        encoding — so the generation bumps and hints minted against
+        the old codec can never verify again: the stale-hint guarantee
+        ("perf miss, never wrong KV") survives the reconfiguration.
+        An idempotent re-set, or the first set over an empty store, is
+        free (no hint was ever minted against another encoding)."""
+        codec = str(codec or "")
+        if codec == self._codec:
+            return
+        had_blocks = bool(self._lru)
+        self._codec = codec
+        if had_blocks:
+            self.clear()
 
     def __len__(self) -> int:
         return len(self._lru)

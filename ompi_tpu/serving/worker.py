@@ -73,8 +73,10 @@ class ShardWorker:
     def __init__(self, comm, router: Optional[int] = None,
                  role: str = "colocated", peer=None,
                  slots: int = 8, kv_elems: int = 256,
-                 kv_partitions: Optional[int] = None) -> None:
+                 kv_partitions: Optional[int] = None,
+                 kv_codec: Optional[str] = None) -> None:
         from ompi_tpu import serving as _pkg
+        from ompi_tpu.mca.coll import quant as quant_mod
         from ompi_tpu.serving.kv_stream import (KvSlabReceiver,
                                                 KvSlabSender)
         from ompi_tpu.serving.prefix_cache import PrefixStore
@@ -84,12 +86,20 @@ class ShardWorker:
         self.router = _pkg.roles(comm)[0] if router is None else int(router)
         self.role = role
         self.slots, self.kv_elems = int(slots), int(kv_elems)
+        # quantized KV slabs (None = the otpu_coll_quant_kv_codec
+        # default; "" = raw f32): both sides of every slab pairing in
+        # this job resolve the same var, so the pairings agree
+        self._kv_codec = quant_mod.kv_codec() if kv_codec is None \
+            else str(kv_codec or "")
         self._kv: dict = {}          # rid -> local KV block (decode state)
         self._stopped = False
         # prefix store: which block hashes this worker's cache still
         # holds, generation-stamped (the router's routing hints are
-        # verified against it — see serving/prefix_cache.py)
+        # verified against it — see serving/prefix_cache.py).  The
+        # codec stamp makes a codec RECONFIGURATION look like a
+        # recovery to every outstanding hint (generation bump).
         self._prefix = PrefixStore()
+        self._prefix.set_codec(self._kv_codec)
         self._prefix_hits = 0
         self._preport_installed: list = []
         self._preport_evicted: list = []
@@ -107,11 +117,13 @@ class ShardWorker:
                                "prefill worker needs >= 1 decode peer")
             for p in peers:
                 self._senders[p] = KvSlabSender(comm, p, self.slots,
-                                                self.kv_elems, TAG_KV)
+                                                self.kv_elems, TAG_KV,
+                                                codec=self._kv_codec)
         elif role == "decode":
             self._receiver = KvSlabReceiver(comm, int(peer), self.slots,
                                             self.kv_elems, TAG_KV,
-                                            partitions=kv_partitions)
+                                            partitions=kv_partitions,
+                                            codec=self._kv_codec)
 
     # -- compute ----------------------------------------------------------
     def _prefill(self, rid: int, prompt_len: int) -> np.ndarray:
@@ -274,7 +286,22 @@ class ShardWorker:
                 if self._receiver.slot_arrived(slot):
                     block = self._receiver.read_slot(slot)
                     expect = toy_kv(rid, self.kv_elems)
-                    if not np.array_equal(block, expect):
+                    if self._kv_codec:
+                        # quantized slab: the decoded block must land
+                        # within the codec's band of the exact KV —
+                        # outside it is transport corruption, not
+                        # quantization
+                        from ompi_tpu.mca.coll import quant as _q
+
+                        tol = _q.CODEC_BANDS[self._kv_codec] \
+                            * max(1e-6, float(np.abs(expect).max()))
+                        if not np.allclose(block, expect, atol=tol,
+                                           rtol=0.0):
+                            raise AssertionError(
+                                f"KV stream corrupted rid {rid} slot "
+                                f"{slot} (outside the "
+                                f"{self._kv_codec} band)")
+                    elif not np.array_equal(block, expect):
                         raise AssertionError(
                             f"KV stream corrupted rid {rid} slot {slot}")
                     self._kv[rid] = block

@@ -117,6 +117,12 @@ def main(argv=None) -> int:
                          "STAGES), the stage-clock / sampling-profiler "
                          "MCA vars, and the perf-history file "
                          "otpu_perf reads")
+    ap.add_argument("--quant", action="store_true",
+                    help="Show the coll/quant plane: the quantization "
+                         "MCA vars (codec block, wire enable, KV "
+                         "codec), the accuracy-budget comm info key, "
+                         "the quant stage clocks, and the quant SPC "
+                         "counters — all registry-enumerated")
     ap.add_argument("--serving", action="store_true",
                     help="Show the serving-fleet plane: the "
                          "registry-enumerated serving MCA vars (prefix "
@@ -245,6 +251,29 @@ def main(argv=None) -> int:
                         f"{DEFAULT_HISTORY} (bench.py --history / "
                         "--ladder append; otpu_perf --diff/--check "
                         "compare)", p))
+
+    if args.all or args.quant:
+        # registry-enumerated like --telemetry/--profile: the coll/
+        # quant var group (registered by the coll framework scan
+        # above), the declared quant stage clocks out of the STAGES
+        # table, and the declared quant_* SPC counters — never a
+        # hand-kept list
+        from ompi_tpu.mca.coll import quant as _quant
+        from ompi_tpu.runtime import profile as _qprofile
+        from ompi_tpu.runtime import spc as _qspc
+
+        out.append(_fmt("quant budget info key", _quant.BUDGET_KEY, p))
+        for var in registry.all_vars("coll/quant"):
+            out.append(_fmt(f"quant var {var.name}",
+                            f"{var.value!r} — {var.help}", p))
+        for stage, desc in _qprofile.STAGES.items():
+            if stage.startswith("quant."):
+                out.append(_fmt(f"quant stage {stage}", desc, p))
+        for cname in _qspc._COUNTERS:
+            if cname.startswith("quant_"):
+                out.append(_fmt(f"quant counter {cname}",
+                                "SPC counter (see --pvars for values)",
+                                p))
 
     if args.all or args.serving:
         # registry-enumerated like --telemetry/--profile: the serving

@@ -254,6 +254,27 @@ def cases(mesh1d, mesh2d):
     case("vpu_reduce_stack_max", lambda: (
         pr.reduce_stack, ("MAX", _sds((8, PAY), f32, one, P())),
         {"interpret": False}))
+
+    # -- coll/quant codec kernels: the block-quantized collective tier
+    # is re-earnable on hardware the moment the tunnel returns — these
+    # prove encode / dequant-accumulate / decode lower through Mosaic
+    # at sweep scale (1M-element operands, 8-rank stacks).
+    from ompi_tpu.ops import pallas_quant as pq
+
+    QROWS = ((1 << 20) // pq.LANES)        # 1M f32 elements
+    case("quant_encode_int8_1m", lambda: (
+        pq.encode_int8, (_sds((QROWS, pq.LANES), f32, one, P()),),
+        {"interpret": False}))
+    case("quant_dequant_accumulate_8x", lambda: (
+        pq.dequant_accumulate,
+        (_sds((8, QROWS, pq.LANES), jnp.int8, one, P()),
+         _sds((8, QROWS, 1), f32, one, P())),
+        {"interpret": False}))
+    case("quant_decode_int8_1m", lambda: (
+        pq.decode_int8,
+        (_sds((QROWS, pq.LANES), jnp.int8, one, P()),
+         _sds((QROWS, 1), f32, one, P())),
+        {"interpret": False}))
     return out
 
 

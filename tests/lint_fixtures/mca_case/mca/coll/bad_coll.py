@@ -1,0 +1,13 @@
+"""Known-bad: a coll component missing its required query slot."""
+from ompi_tpu.base.mca import Component
+
+
+class HalfCollComponent(Component):     # BAD: no 'comm_query' slot
+    name = "halfcoll"
+    priority = 5
+
+    def register_vars(self, fw):
+        pass
+
+
+COMPONENT = HalfCollComponent()

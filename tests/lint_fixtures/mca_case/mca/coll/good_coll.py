@@ -1,0 +1,17 @@
+"""Known-good twin: the contract-complete coll component (the
+coll/quant shape: a codec/config home whose comm_query declines)."""
+from ompi_tpu.base.mca import Component
+
+
+class FineCollComponent(Component):
+    name = "finecoll"
+    priority = 5
+
+    def register_vars(self, fw):
+        pass
+
+    def comm_query(self, comm):
+        return None
+
+
+COMPONENT = FineCollComponent()

@@ -34,3 +34,11 @@ def clocked(profile):
 
 def linked():
     trace.flow_start("mystery_flow", "1.2.3.4")  # BAD: no such category
+
+
+def qcount():
+    spc.record("quant_encodez")               # BAD: not in _COUNTERS
+
+
+def qclocked(profile):
+    profile.stage_mark("quant.encooode")      # BAD: not in STAGES

@@ -12,6 +12,7 @@ def diagnose():
 
 def count():
     spc.record("fast_frames")                 # declared in _COUNTERS
+    spc.record("quant_encodes")               # declared in _COUNTERS
     spc.record(_dynamic_name())               # non-literal: out of scope
 
 
@@ -49,6 +50,7 @@ def clocked(profile):
     t0 = profile.now()
     profile.stage_span("send.pack", t0)       # declared in STAGES
     profile.stage_mark("recv.parse")          # declared in STAGES
+    profile.stage_mark("quant.encode")        # declared in STAGES
     profile.stage_span(_dynamic_name(), 0)    # non-literal: out of scope
 
 

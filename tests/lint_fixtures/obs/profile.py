@@ -4,4 +4,5 @@ the stage_span/stage_mark rule checks literal names against."""
 STAGES = {
     "send.pack": "convertor pack",
     "recv.parse": "frame parse",
+    "quant.encode": "block-scale encode",
 }

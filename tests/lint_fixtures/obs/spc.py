@@ -1,5 +1,5 @@
 """Fixture stand-in for runtime/spc.py: the declared counter set."""
 
 _COUNTERS = (
-    "send", "recv", "fast_frames",
+    "send", "recv", "fast_frames", "quant_encodes",
 )

@@ -98,10 +98,12 @@ def test_observability_contracts():
                    FIXTURES / "obs" / "telemetry.py",
                    FIXTURES / "obs" / "profile.py",
                    FIXTURES / "obs" / "trace.py")
-    assert len(bad) == 7, bad
+    assert len(bad) == 9, bad
     msgs = " | ".join(f.message for f in bad)
     assert "no matching register_help" in msgs
     assert "not declared in runtime/spc.py" in msgs
+    assert "quant_encodez" in msgs            # the quant counter twin
+    assert "quant.encooode" in msgs           # the quant stage twin
     assert "never consumed" in msgs
     assert "not a key of runtime/telemetry.py SCHEMA" in msgs
     assert "no registered help-flight template" in msgs
@@ -122,9 +124,13 @@ def test_mca_conformance():
     assert "'name' class attribute" in msgs
     assert "os.environ" in msgs
     assert "group 'transport'" in msgs
-    # the good component in the same tree contributes nothing
+    # the coll twin: the quant-shaped component must implement its
+    # framework's query slot even when it always declines selection
+    assert "required coll-framework slot 'comm_query'" in msgs
+    # the good components in the same tree contribute nothing
     assert not any("good_btl" in f.path for f in bad)
-    assert len(bad) == 5, bad
+    assert not any("good_coll" in f.path for f in bad)
+    assert len(bad) == 6, bad
 
 
 # -- suppressions ------------------------------------------------------
