@@ -710,29 +710,37 @@ def serving_rows() -> list:
     rows = []
     try:
         for mode in ("colocated", "stages"):
-            proc = subprocess.run(
-                [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "3",
-                 sys.executable, script, mode],
-                capture_output=True, text=True, timeout=300,
-                env=dict(os.environ, JAX_PLATFORMS="cpu"))
-            line = next((ln for ln in proc.stdout.splitlines()
-                         if "SERVING " in ln), None)
-            if proc.returncode or line is None:
-                print(f"serving bench ({mode}) failed "
-                      f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}",
-                      file=sys.stderr)
-                rows.append({"coll": f"serving_poisson_{mode}",
-                             "ok": False})
-                continue
-            rep = _json.loads(line.split("SERVING ", 1)[1])
-            rows.append({
-                "coll": f"serving_poisson_{mode}",
-                "nbytes": rep["requests"],
-                "p50_ms": rep["p50_ms"], "p99_ms": rep["p99_ms"],
-                "p99_exact_ms": rep["p99_exact_ms"],
-                "tokens_per_s": rep["tokens_per_s"],
-                "req_per_s": rep["req_per_s"],
-            })
+            with tempfile.TemporaryDirectory() as td:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+                     "-n", "3",
+                     "--mca", "otpu_trace_enable", "1",
+                     "--mca", "otpu_trace_requests", "1",
+                     "--mca", "otpu_trace_dir", td,
+                     sys.executable, script, mode],
+                    capture_output=True, text=True, timeout=300,
+                    env=dict(os.environ, JAX_PLATFORMS="cpu"))
+                line = next((ln for ln in proc.stdout.splitlines()
+                             if "SERVING " in ln), None)
+                if proc.returncode or line is None:
+                    print(f"serving bench ({mode}) failed "
+                          f"(rc={proc.returncode}):\n"
+                          f"{proc.stderr[-2000:]}",
+                          file=sys.stderr)
+                    rows.append({"coll": f"serving_poisson_{mode}",
+                                 "ok": False})
+                    continue
+                rep = _json.loads(line.split("SERVING ", 1)[1])
+                row = {
+                    "coll": f"serving_poisson_{mode}",
+                    "nbytes": rep["requests"],
+                    "p50_ms": rep["p50_ms"], "p99_ms": rep["p99_ms"],
+                    "p99_exact_ms": rep["p99_exact_ms"],
+                    "tokens_per_s": rep["tokens_per_s"],
+                    "req_per_s": rep["req_per_s"],
+                }
+                row.update(_req_stage_medians(td))
+                rows.append(row)
     finally:
         os.unlink(script)
     return rows
@@ -780,33 +788,77 @@ def fleet_rows() -> list:
         script = f.name
     rows = []
     try:
-        proc = subprocess.run(
-            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "5",
-             "--pool", "m_a:1,2", "--pool", "m_b:3,4",
-             sys.executable, script],
-            capture_output=True, text=True, timeout=300,
-            env=dict(os.environ, JAX_PLATFORMS="cpu"))
-        line = next((ln for ln in proc.stdout.splitlines()
-                     if "FLEET " in ln), None)
-        if proc.returncode or line is None:
-            print(f"fleet bench failed (rc={proc.returncode}):\n"
-                  f"{proc.stderr[-2000:]}", file=sys.stderr)
-            return [{"coll": "serving_fleet", "ok": False}]
-        rep = _json.loads(line.split("FLEET ", 1)[1])
-        for name, tr in sorted(rep["tenants"].items()):
-            rows.append({
-                "coll": f"serving_fleet_{name}",
-                "nbytes": tr["requests"],
-                "p50_ms": tr["p50_ms"], "p99_ms": tr["p99_ms"],
-                "p99_exact_ms": tr["p99_exact_ms"],
-                "tokens_per_s": tr["tokens_per_s"],
-                "req_per_s": round(tr["requests"] / rep["elapsed_s"],
-                                   1),
-                "prefix_hit_rate": rep["prefix_hit_rate"],
-            })
+        with tempfile.TemporaryDirectory() as td:
+            proc = subprocess.run(
+                [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "5",
+                 "--pool", "m_a:1,2", "--pool", "m_b:3,4",
+                 "--mca", "otpu_trace_enable", "1",
+                 "--mca", "otpu_trace_requests", "1",
+                 "--mca", "otpu_trace_dir", td,
+                 sys.executable, script],
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if "FLEET " in ln), None)
+            if proc.returncode or line is None:
+                print(f"fleet bench failed (rc={proc.returncode}):\n"
+                      f"{proc.stderr[-2000:]}", file=sys.stderr)
+                return [{"coll": "serving_fleet", "ok": False}]
+            rep = _json.loads(line.split("FLEET ", 1)[1])
+            stages = _req_stage_medians(td)
+            for name, tr in sorted(rep["tenants"].items()):
+                row = {
+                    "coll": f"serving_fleet_{name}",
+                    "nbytes": tr["requests"],
+                    "p50_ms": tr["p50_ms"], "p99_ms": tr["p99_ms"],
+                    "p99_exact_ms": tr["p99_exact_ms"],
+                    "tokens_per_s": tr["tokens_per_s"],
+                    "req_per_s": round(tr["requests"]
+                                       / rep["elapsed_s"], 1),
+                    "prefix_hit_rate": rep["prefix_hit_rate"],
+                }
+                # the fleet trace is one merged timeline over both
+                # pools — the stage decomposition is fleet-wide, so
+                # every tenant row carries the same medians
+                row.update(stages)
+                rows.append(row)
     finally:
         os.unlink(script)
     return rows
+
+
+def _req_stage_medians(trace_dir: str) -> dict:
+    """Per-request stage medians from the per-rank traces a
+    request-armed (``otpu_trace_requests``) serving run exported —
+    the REAL ``otpu_analyze --requests`` decomposition over the
+    merged timeline, not a shadow estimator in the bench script.
+    Empty dict when the run produced no decomposable requests (the
+    row simply doesn't grow the column; the pin test treats that as
+    a regression)."""
+    from ompi_tpu.tools import otpu_analyze
+    try:
+        events = otpu_analyze.load_events([trace_dir])
+    except (SystemExit, OSError, ValueError):
+        return {}
+    rep = otpu_analyze.requests_report(events)
+    med = rep.get("stage_median_us") or {}
+    if not med:
+        return {}
+    return {"stage_median_ms": {s: round(v / 1000.0, 3)
+                                for s, v in med.items()},
+            "req_decomposed": int(rep.get("decomposed", 0))}
+
+
+def _stage_cell(r: dict) -> str:
+    """Compact q/d/p/k/dec/str stage-median cell for the md table
+    (absent stages — e.g. prefill/kv on a colocated row whose engine
+    prefills inline — render as '-')."""
+    from ompi_tpu.tools.otpu_analyze import REQ_STAGES
+    med = r.get("stage_median_ms")
+    if not med:
+        return "-"
+    return "/".join(f"{med[s]:g}" if s in med else "-"
+                    for s in REQ_STAGES)
 
 
 def _serving_md_section(rows) -> list:
@@ -818,22 +870,27 @@ def _serving_md_section(rows) -> list:
              "Open-loop queueing numbers, not ping-pong latency. "
              "`serving_fleet_*` rows are PER TENANT from the two-pool "
              "/ two-tenant fleet run (weighted fair-share admission, "
-             "prefix-aware routing — `pfx%` is the cache hit rate).",
+             "prefix-aware routing — `pfx%` is the cache hit rate). "
+             "`stage med ms` is the otpu-req per-request decomposition "
+             "(queue/dispatch/prefill/kv/decode/stream medians from "
+             "`otpu_analyze --requests` over the run's merged "
+             "timeline; fleet rows share one fleet-wide cell).",
              "",
              "| mode | requests | p50 ms | p99 ms | p99 exact ms | "
-             "tokens/s | req/s | pfx% |",
-             "|---|---|---|---|---|---|---|---|"]
+             "tokens/s | req/s | pfx% | stage med ms (q/d/p/k/dec/str) |",
+             "|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if not r.get("ok", True):
             lines.append(f"| {r['coll']} | FAILED | - | - | - | - | "
-                         "- | - |")
+                         "- | - | - |")
             continue
         pfx = r.get("prefix_hit_rate")
         pfx_s = f"{100.0 * pfx:.0f}%" if pfx is not None else "-"
         lines.append(
             f"| {r['coll']} | {r['nbytes']} | {r['p50_ms']} | "
             f"{r['p99_ms']} | {r['p99_exact_ms']} | "
-            f"{r['tokens_per_s']} | {r['req_per_s']} | {pfx_s} |")
+            f"{r['tokens_per_s']} | {r['req_per_s']} | {pfx_s} | "
+            f"{_stage_cell(r)} |")
     return lines
 
 
@@ -861,6 +918,22 @@ def refresh_serving_tables() -> list:
     the device/host rows are left untouched."""
     here = os.path.dirname(os.path.abspath(__file__))
     rows = serving_rows() + fleet_rows()
+    # stage medians double as BENCH_HISTORY points so otpu_perf --diff
+    # guards the per-stage numbers run over run (bench-kind rows need a
+    # positive lat_us; zero-width stages just don't emit a point)
+    hist: dict = {}
+    for r in rows:
+        if not r.get("ok", True):
+            continue
+        for s, v in (r.get("stage_median_ms") or {}).items():
+            if v > 0:
+                hist[f"serving_stage/{r['coll']}/{s}"] = {
+                    "key": f"serving_stage/{r['coll']}/{s}",
+                    "lat_us": round(1000.0 * v, 1),
+                    "k": int(r.get("req_decomposed", 0))}
+    if hist:
+        append_history(sorted(hist.values(), key=lambda h: h["key"]),
+                       "bench", "host_serving")
     try:
         with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
             payload = json.load(f)

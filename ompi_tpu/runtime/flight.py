@@ -113,7 +113,20 @@ def _payload(rank: int, reason: str, detail: str,
         # counts: the post-crash bundle shows where host time was going
         # (None when neither profile half was armed)
         "profile": profile.export_payload(),
+        # otpu-req SLO state: a crashed fleet leaves its rolling-window
+        # goodput/breach/burn accounting behind (None off the router
+        # rank or while no SLO target was ever set)
+        "slo": _slo_state(),
     }
+
+
+def _slo_state() -> Optional[dict]:
+    try:
+        from ompi_tpu.runtime import telemetry
+
+        return telemetry.slo_snapshot()
+    except Exception:
+        return None
 
 
 def _recent_rpcs() -> list:
@@ -230,7 +243,25 @@ def maybe_dump_postmortem(rte) -> Optional[str]:
 
 def _excepthook(tp, val, tb):
     try:
-        dump("uncaught", detail=repr(val))
+        # classify by the failure already observed: when this process
+        # saw peers die, the exception unwinding it now is almost
+        # always secondary fallout of that death (the documented
+        # fleet-soak flake: a survivor's recovery-path coord RPC times
+        # out and the dump said 'uncaught' instead of 'proc-failed').
+        # The failed-set wins; the exception rides along as detail.
+        failed = []
+        try:
+            from ompi_tpu.ft import state as ft_state
+
+            failed = sorted(ft_state.failed_ranks())
+        except Exception:
+            pass
+        if failed:
+            dump("proc-failed",
+                 detail=",".join(str(r) for r in failed)
+                 + f" (then {val!r})")
+        else:
+            dump("uncaught", detail=repr(val))
     except Exception:
         pass
     hook = _orig_excepthook or sys.__excepthook__

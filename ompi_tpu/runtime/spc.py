@@ -57,6 +57,15 @@ _COUNTERS = (
     # folding it into wire_cksum_fail would misattribute the fault)
     "quant_encodes", "quant_decodes", "quant_wire_bytes_saved",
     "quant_wire_decode_fail",
+    # otpu-req per-request tracing (runtime/trace requests layer):
+    # requests whose causal chain was stamped, and per-request stage
+    # spans emitted — both stay EXACTLY flat while otpu_trace_requests
+    # is off (the zero-overhead identity pin)
+    "req_traced", "req_stages",
+    # SLO accounting (runtime/telemetry slo plane): completions beating
+    # the otpu_serving_slo_p99_ms target vs breaching it — both inert
+    # while no SLO target is set
+    "slo_goodput", "slo_breaches",
 )
 
 _pvars = {}

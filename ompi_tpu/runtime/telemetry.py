@@ -73,6 +73,10 @@ SCHEMA = {
     "fleet": "serving-fleet control plane: per-pool worker/queue "
              "tables, prefix-cache hit/miss, reserve size, and recent "
              "autoscale decisions (serving/fleet.py)",
+    "slo": "per-pool/per-tenant rolling-window SLO accounting against "
+           "otpu_serving_slo_p99_ms: goodput (within-SLO completions "
+           "per second), breach counts, and error-budget burn rate "
+           "(this module's SloAccountant; otpu-req)",
 }
 
 #: keys the sampler itself produces; component sources may only claim
@@ -249,6 +253,145 @@ class Sampler:
                 pass
 
 
+# -- SLO accounting (otpu-req) -------------------------------------------
+
+#: error budget of a p99 SLO: 1% of requests may breach the latency
+#: target.  Burn rate is the observed breach fraction divided by this
+#: allowance — 1.0 means the window consumed its budget exactly, above
+#: it the budget is burning down (the SRE burn-rate convention).
+SLO_BUDGET = 0.01
+
+_slo_window_var = registry.register(
+    "serving", None, "slo_window_s", vtype=VarType.FLOAT, default=60.0,
+    help="Rolling window in seconds of the SLO accountant: goodput, "
+         "breach counts and error-budget burn rate are computed over "
+         "completions no older than this (full-run totals are kept "
+         "alongside).  The accountant itself is inert until "
+         "otpu_serving_slo_p99_ms sets a latency target")
+
+
+class SloAccountant:
+    """Per-(pool, tenant) rolling-window SLO accounting.
+
+    Fed one ``observe`` per completed serving request by the router's
+    finish path; publishes through the ``slo`` SCHEMA key, renders as
+    the otpu_top burn column, and rides flight-recorder dumps so a
+    crashed fleet leaves its SLO state behind.  Inert — no state, no
+    SPC traffic — while ``otpu_serving_slo_p99_ms`` is unset/0: the
+    target var is registered by ``serving/fleet.py``, looked up lazily
+    so this runtime module never imports the serving package.
+
+    ``observe`` runs on the router's engine-tick thread and
+    ``snapshot`` on the sampler thread: both take the accountant's own
+    lock for O(window) at worst (amortized O(1): each completion is
+    appended once and pruned once)."""
+
+    _GUARDED_BY = {"_win": "_lock", "_totals": "_lock"}
+
+    def __init__(self) -> None:
+        import collections
+
+        self._lock = threading.Lock()
+        #: (pool, tenant) -> deque[(monotonic_s, ok_bool)]
+        self._win: dict = collections.defaultdict(
+            lambda: collections.deque(maxlen=65536))
+        #: (pool, tenant) -> [total, breaches]  (full-run)
+        self._totals: dict = {}
+        self._target_var = None
+
+    def target_ms(self) -> float:
+        """The live SLO target (0 disables accounting).  The var
+        belongs to the serving group (``serving/fleet.py``) — lazy
+        registry lookup, cached once found."""
+        if self._target_var is None:
+            self._target_var = registry.lookup("otpu_serving_slo_p99_ms")
+            if self._target_var is None:
+                return 0.0
+        return float(self._target_var.value or 0.0)
+
+    def observe(self, pool: str, tenant: str, dur_ms: float) -> bool:
+        """Account one completed request; returns True when it beat
+        the SLO target (always True — and a no-op — with no target)."""
+        from ompi_tpu.runtime import spc
+
+        target = self.target_ms()
+        if target <= 0:
+            return True
+        ok = float(dur_ms) <= target
+        key = (str(pool), str(tenant or "-"))
+        t = time.monotonic()
+        with self._lock:
+            self._win[key].append((t, ok))
+            tot = self._totals.get(key)
+            if tot is None:
+                tot = self._totals[key] = [0, 0]
+            tot[0] += 1
+            if not ok:
+                tot[1] += 1
+        if ok:
+            spc.record("slo_goodput")
+        else:
+            spc.record("slo_breaches")
+        return ok
+
+    def snapshot(self) -> Optional[dict]:
+        """The ``slo`` sample value: {target_ms, window_s, budget,
+        pools: {pool: {tenant: {total, breaches, goodput_rps, burn}}}}
+        over the rolling window, with full-run totals alongside.  None
+        while nothing was ever accounted (keeps samples compact)."""
+        target = self.target_ms()
+        window = max(1e-3, float(_slo_window_var.value or 60.0))
+        horizon = time.monotonic() - window
+        with self._lock:
+            if not self._totals:
+                return None
+            pools: dict = {}
+            for (pool, tenant), dq in self._win.items():
+                while dq and dq[0][0] < horizon:
+                    dq.popleft()
+                n = len(dq)
+                breaches = sum(1 for _, ok in dq if not ok)
+                run_tot, run_breach = self._totals[(pool, tenant)]
+                # elapsed covered by the window: bounded by the window
+                # itself, but a younger window (the run just started)
+                # uses its real span so goodput is not diluted
+                span = window
+                if dq:
+                    span = min(window,
+                               max(1e-3, time.monotonic() - dq[0][0]))
+                frac = (breaches / n) if n else 0.0
+                pools.setdefault(pool, {})[tenant] = {
+                    "total": n,
+                    "breaches": breaches,
+                    "goodput_rps": round((n - breaches) / span, 3),
+                    "burn": round(frac / SLO_BUDGET, 3),
+                    "run_total": run_tot,
+                    "run_breaches": run_breach,
+                }
+        return {"target_ms": target, "window_s": window,
+                "budget": SLO_BUDGET, "pools": pools}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._win.clear()
+            self._totals.clear()
+        self._target_var = None
+
+
+#: the process-wide accountant (router finish path feeds it; the
+#: sampler, otpu_top, and the flight recorder read it)
+slo = SloAccountant()
+
+
+def slo_observe(pool: str, tenant: str, dur_ms: float) -> bool:
+    """Module-level convenience used by ``serving/router.py``."""
+    return slo.observe(pool, tenant, dur_ms)
+
+
+def slo_snapshot() -> Optional[dict]:
+    return slo.snapshot()
+
+
 def start(rte) -> bool:
     """Arm the sampler for this rank (called from the instance boot).
 
@@ -277,6 +420,11 @@ def stop() -> None:
     if s is not None:
         s.stop()
 
+
+# the accountant is module-owned (never collected), registered like
+# any component source: one dict insert, sampled only while the
+# sampler runs, skipped (None) until something was accounted
+register_source("slo", slo.snapshot)
 
 from ompi_tpu.base.output import register_help as _rh
 

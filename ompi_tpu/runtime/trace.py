@@ -62,6 +62,13 @@ enabled = False
 #: otpu-crit existed.
 flow_enabled = False
 
+#: the request layer's guard (otpu-req): true only while ``enabled``
+#: AND the ``otpu_trace_requests`` cvar is set.  Serving call sites
+#: (router stage stamps, worker prefill/kv/decode spans, the kv-slab
+#: per-sequence flow hops) read this and branch — a requests-disabled
+#: run records exactly what it did before otpu-req existed.
+requests_enabled = False
+
 #: Declared span categories (the registry ``otpu_info --trace``
 #: enumerates; every ``trace.span``/``instant`` call site uses one).
 CATEGORIES = {
@@ -76,6 +83,9 @@ CATEGORIES = {
     "part": "partitioned communication (Pready/Parrived)",
     "pml": "point-to-point send/recv completion spans",
     "serving": "continuous-batching serving ticks",
+    "serve_req": "per-request serving stage spans (otpu-req: queue/"
+                 "dispatch/prefill/kv/decode/stream, args carry the "
+                 "rid — otpu_analyze --requests consumes them)",
     "staging": "accelerator staging-pool checkouts",
     "step": "application/training step windows (critical-path unit)",
     "flow": "Chrome flow events binding send completion to recv "
@@ -95,6 +105,12 @@ FLOW_CATEGORIES = {
                   "carries the same (cid, cseq) key in its args; the "
                   "analyzer builds last-arrival->all-release barrier "
                   "edges from it",
+    "serve_req": "one serving-request hop: id 'rid.hop' where hop "
+                 "numbers the causal chain router dispatch (0) -> "
+                 "prefill shard -> KV slab Pready/Parrived (1) -> "
+                 "decode/token stream (2) -> router completion; a "
+                 "merged timeline renders one arrow chain per request "
+                 "across router and worker ranks",
 }
 
 _ring: Optional[list] = None
@@ -132,6 +148,15 @@ def _sync_flow() -> None:
     flow_enabled = enabled and (var is None or bool(var.value))
 
 
+def _sync_requests() -> None:
+    # same defensive lookup as _sync_flow, same reason — but note the
+    # inverted default: flow rides enabled tracing unless opted OUT,
+    # the request layer stays off unless opted IN
+    global requests_enabled
+    var = globals().get("_requests_var")
+    requests_enabled = enabled and var is not None and bool(var.value)
+
+
 def _set_enabled(value: bool) -> None:
     global enabled, _ring, _ring_n
     if value:
@@ -143,6 +168,7 @@ def _set_enabled(value: bool) -> None:
             _ring = [None] * want
     enabled = bool(value)
     _sync_flow()
+    _sync_requests()
 
 
 # buffer/dir/flow register first: registering the enable var applies
@@ -166,6 +192,17 @@ _flow_var = registry.register(
          "while tracing is enabled; off pins the pre-otpu-crit "
          "record path",
     on_set=lambda _v: _sync_flow())
+_requests_var = registry.register(
+    "trace", None, "requests", vtype=VarType.BOOL, default=False,
+    help="Thread every serving request through the trace as a "
+         "request-scoped span/flow layer: per-stage 'serve_req' spans "
+         "(queue/dispatch/prefill/kv/decode/stream, keyed by rid) and "
+         "a 'rid.hop' flow-arrow chain router -> prefill -> decode -> "
+         "router riding the KV slab's per-sequence Pready keys — what "
+         "otpu_analyze --requests decomposes.  Default off: the "
+         "serving hot path pays nothing until a request-granular "
+         "question is asked",
+    on_set=lambda _v: _sync_requests())
 _enable_var = registry.register(
     "trace", None, "enable", vtype=VarType.BOOL, default=False,
     help="Record span/instant events (pml, coll host+device, osc epochs, "
@@ -773,7 +810,7 @@ def skew_report(payloads: list) -> str:
 
 def reset_for_testing() -> None:
     """Drop all tracer state and re-arm from the cvar (tests only)."""
-    global _ring, _ring_n, _slot, enabled, flow_enabled
+    global _ring, _ring_n, _slot, enabled, flow_enabled, requests_enabled
     with _hist_lock:
         _hist.clear()
     _ring = None
@@ -782,4 +819,5 @@ def reset_for_testing() -> None:
     _coll_seq.clear()
     enabled = False
     flow_enabled = False
+    requests_enabled = False
     _set_enabled(bool(_enable_var.value))

@@ -509,6 +509,19 @@ class FleetController:
             if router.registry is not None:
                 entry["prefix"] = router.registry.stats()
             pools[name] = entry
+        # otpu-req SLO plane: fold each pool's worst-tenant burn rate
+        # into its entry (the controller rank runs every router, so
+        # its SLO accountant holds every pool's rolling window)
+        from ompi_tpu.runtime import telemetry
+
+        slo = telemetry.slo_snapshot()
+        if slo:
+            for name, tenants in (slo.get("pools") or {}).items():
+                entry = pools.get(name)
+                if entry is not None and tenants:
+                    entry["slo_burn"] = max(
+                        float(t.get("burn", 0.0))
+                        for t in tenants.values())
         with self._lock:
             reserve = len(self._reserve)
             decisions = list(self._decision_log)[-8:]

@@ -45,7 +45,7 @@ import numpy as np
 
 from ompi_tpu.api.errors import ErrorClass, MpiError
 from ompi_tpu.mca.coll import quant as quant_mod
-from ompi_tpu.runtime import spc
+from ompi_tpu.runtime import spc, trace
 
 
 class _KvSlabBase:
@@ -140,12 +140,19 @@ class KvSlabSender(_KvSlabBase):
         self.slab[s, :n] = row[:n]
         self.slab[s, n:] = 0.0
 
-    def slot_ready(self, slot: int) -> None:
+    def slot_ready(self, slot: int, rid: Optional[int] = None) -> None:
         """``Pready`` for one finished sequence — its block starts
-        travelling while later sequences are still prefilling."""
+        travelling while later sequences are still prefilling.  With a
+        ``rid`` (otpu-req armed) the Pready doubles as the producing
+        half of the request's hop-1 flow edge: the per-sequence
+        partition key the slab already carries IS the causal link
+        prefill -> decode, so the arrow costs one ring slot, no wire
+        bytes."""
         s = self._check_slot(slot)
         self.req.pready(s)
         self._readied.add(s)
+        if rid is not None:
+            trace.flow_start("serve_req", (rid, 1))
 
     def finish_epoch(self, wait: bool = True) -> None:
         """Flush the unused remainder of the slab (one aggregated tail
@@ -202,15 +209,20 @@ class KvSlabReceiver(_KvSlabBase):
         lo = s * self._parts_per_slot
         return self.req.parrived_range(lo, lo + self._parts_per_slot - 1)
 
-    def read_slot(self, slot: int) -> np.ndarray:
+    def read_slot(self, slot: int,
+                  rid: Optional[int] = None) -> np.ndarray:
         """COPY one arrived block out — the next epoch reuses the slab,
         so decode state must not alias it.  With a codec armed the
-        block is dequantized here (the decode owns its memory)."""
+        block is dequantized here (the decode owns its memory).  A
+        ``rid`` closes the request's hop-1 flow edge (the consuming
+        half of the arrow :meth:`KvSlabSender.slot_ready` launched)."""
         s = self._check_slot(slot)
         if not self.slot_arrived(s):
             raise MpiError(ErrorClass.ERR_REQUEST,
                            f"KV slot {s} read before it arrived "
                            f"(epoch {self.epoch})")
+        if rid is not None:
+            trace.flow_finish("serve_req", (rid, 1))
         if self.codec:
             return quant_mod.decode_f32(self.slab[s], self.codec,
                                         self.elems_per_slot,

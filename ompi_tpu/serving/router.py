@@ -38,7 +38,7 @@ from typing import Optional
 from ompi_tpu.api.errhandler import ERRORS_RETURN
 from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
                                  RevokedError)
-from ompi_tpu.runtime import spc, trace
+from ompi_tpu.runtime import spc, telemetry, trace
 from ompi_tpu.serving import prefix_cache
 from ompi_tpu.serving.scheduler import (ContinuousBatchScheduler,
                                         RequestState, ServeRequest)
@@ -303,6 +303,16 @@ class Router:
                 # a pairing that sat out a round)
                 epoch = self._pair_epoch.get((pre, dec), -1) + 1
                 self._pair_epoch[(pre, dec)] = epoch
+                if trace.requests_enabled:
+                    # otpu-req hop 0 opens at the dispatch decision
+                    # (router -> prefill shard; the prefill rank closes
+                    # it at command receipt).  The stamp is written
+                    # exactly once and _finish's stage decomposition
+                    # reuses it — never a second now() for this instant
+                    for r in reqs:
+                        r.dispatch_ns = trace.now()
+                        trace.flow_start("serve_req", (r.rid, 0),
+                                         r.dispatch_ns)
                 self.comm.send_obj(
                     ("prefill", dec, epoch,
                      [(r.rid, r.slot, r.prompt_len,
@@ -317,6 +327,12 @@ class Router:
                 msg = self._expect(pre, "prefilled")
                 self._fold_preport(pre, msg[3])
                 self._expect(dec, "kv_ready")
+                if trace.requests_enabled:
+                    # kv_ready means the decode side holds the slab:
+                    # the decode window of every request in this
+                    # pairing opens here
+                    for r in per_pair[(pre, dec)]:
+                        r.decode_ns = trace.now()
         # a fresh COLOCATED request prefills with its first work cmd —
         # that cmd carries the prefix hashes + routing hint (paired
         # requests already streamed theirs above)
@@ -333,6 +349,13 @@ class Router:
             n = min(self.decode_chunk, r.remaining)
             if n > 0:
                 first = r.rid in fresh_colocated
+                if first and trace.requests_enabled:
+                    # colocated hop 0: the work cmd carries the
+                    # prefill, and decode starts in the same dispatch —
+                    # both stage stamps coincide by construction
+                    r.dispatch_ns = r.decode_ns = trace.now()
+                    trace.flow_start("serve_req", (r.rid, 0),
+                                     r.dispatch_ns)
                 entry = (r.rid, r.prompt_len, len(r.tokens), n,
                          self._fresh_hashes(r) if first else (),
                          r.hint if first else None)
@@ -360,6 +383,8 @@ class Router:
                             ErrorClass.ERR_INTERN,
                             f"rid {rid} token {base + i} corrupted")
                 req.tokens.extend(toks)
+                if trace.requests_enabled:
+                    req.last_res_ns = trace.now()
                 if req.remaining <= 0:
                     self._finish(req)
         self._maybe_autoscale()
@@ -409,6 +434,12 @@ class Router:
         self.sched.mark_done(req)
         self._completed.append(req)
         self._recent_done.append(req.rid)   # KV eviction notice
+        # single-stamp discipline: mark_done stamped done_ns — a second
+        # now() here would hand the SLO plane a different e2e than the
+        # one the stage spans decompose (the otpu-req audit's
+        # double-read family)
+        dur = (req.done_ns or trace.now()) - req.arrival_ns
+        telemetry.slo_observe(self.pool or "", req.tenant, dur / 1e6)
         if trace.enabled:
             # request latency (arrival -> last token) into the log2
             # histogram the percentile estimator reads; "size" is the
@@ -417,7 +448,6 @@ class Router:
             # percentile populations never merge (the driver resets
             # each family per run), which is what per-tenant p99
             # reporting and the per-pool autoscaling signal read.
-            dur = trace.now() - req.arrival_ns
             trace.hist_record(_HIST, req.cost, dur)
             if req.tenant:
                 trace.hist_record(TENANT_HIST_PREFIX + req.tenant,
@@ -425,6 +455,40 @@ class Router:
             if self.pool:
                 trace.hist_record(POOL_HIST_PREFIX + self.pool,
                                   req.cost, dur)
+        if trace.requests_enabled:
+            self._trace_request(req)
+
+    def _trace_request(self, req: ServeRequest) -> None:
+        """Emit the router-side otpu-req stage spans and close the
+        request's flow chain.  Four spans, all from lifecycle stamps
+        written exactly once on the hot path (queue: arrival -> admit;
+        dispatch: admit -> first cmd out; decode: decode window open ->
+        last token result; stream: last result -> done); the worker
+        ranks contribute req_prefill / req_kv, and ``otpu_analyze
+        --requests`` folds all six into the per-request decomposition.
+        A requeued-and-replayed request may lack pre-failure stamps —
+        emit what is known, never invent an interval."""
+        args = {"rid": req.rid, "tenant": req.tenant,
+                "pool": self.pool or "", "worker": req.worker}
+        n = 0
+        if req.admit_ns is not None:
+            trace.span("req_queue", "serve_req", req.arrival_ns,
+                       req.admit_ns, args=args)
+            n += 1
+            if req.dispatch_ns is not None:
+                trace.span("req_dispatch", "serve_req", req.admit_ns,
+                           req.dispatch_ns, args=args)
+                n += 1
+        if req.decode_ns is not None and req.last_res_ns is not None:
+            trace.span("req_decode", "serve_req", req.decode_ns,
+                       req.last_res_ns, args=args)
+            trace.span("req_stream", "serve_req", req.last_res_ns,
+                       req.done_ns or req.last_res_ns, args=args)
+            n += 2
+        trace.flow_finish("serve_req", (req.rid, 2), req.done_ns)
+        spc.record("req_traced")
+        if n:
+            spc.record("req_stages", n)
 
     # -- failure handling --------------------------------------------------
     def _failed_workers(self) -> list:

@@ -76,7 +76,8 @@ class ServeRequest:
     __slots__ = ("rid", "prompt_len", "max_new_tokens", "arrival_ns",
                  "state", "tokens", "slot", "worker", "prefilled",
                  "admit_ns", "done_ns", "tenant", "model", "prompt",
-                 "hashes", "hint", "prefill_skipped")
+                 "hashes", "hint", "prefill_skipped",
+                 "dispatch_ns", "decode_ns", "last_res_ns")
 
     def __init__(self, prompt_len: int, max_new_tokens: int,
                  rid: Optional[int] = None, tenant: str = "",
@@ -105,6 +106,15 @@ class ServeRequest:
         self.hashes: Optional[tuple] = None   # router-computed digests
         self.hint: Optional[tuple] = None     # (hash, generation)
         self.prefill_skipped = False
+        # otpu-req stage stamps, written ONLY while trace.requests_
+        # enabled (the zero-overhead identity keeps the record path
+        # byte-identical with requests tracing off).  Each lifecycle
+        # point stamps its time exactly once and every later consumer
+        # reuses the stamp — double-now() reads made the queue-wait and
+        # dispatch stages overlap in the decomposition.
+        self.dispatch_ns: Optional[int] = None  # first cmd sent
+        self.decode_ns: Optional[int] = None    # decode window opened
+        self.last_res_ns: Optional[int] = None  # last token chunk in
 
     @property
     def cost(self) -> int:
@@ -353,6 +363,12 @@ class ContinuousBatchScheduler:
                 r.worker = None
                 r.prefilled = False
                 r.hint = None
+                # the replay is a fresh attempt: stale stage stamps
+                # from the dead worker's dispatch would fold a bogus
+                # pre-failure window into the decomposition
+                r.dispatch_ns = None
+                r.decode_ns = None
+                r.last_res_ns = None
                 q = self._tq.get(r.tenant)
                 if q is None:
                     self._tenants[r.tenant] = 1

@@ -41,7 +41,7 @@ import numpy as np
 from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
                                  RevokedError)
 from ompi_tpu.api.errhandler import ERRORS_RETURN
-from ompi_tpu.runtime import spc
+from ompi_tpu.runtime import spc, trace
 
 #: user-space tags of the serving protocol (below the 2^20 cap)
 TAG_CMD = 601
@@ -92,6 +92,10 @@ class ShardWorker:
         self._kv_codec = quant_mod.kv_codec() if kv_codec is None \
             else str(kv_codec or "")
         self._kv: dict = {}          # rid -> local KV block (decode state)
+        #: rids whose otpu-req flow hops this rank already emitted (a
+        #: rid gets many work commands; its hop-0 finish and hop-2
+        #: start must fire exactly once).  Trimmed with the KV cache.
+        self._req_seen: set = set()
         self._stopped = False
         # prefix store: which block hashes this worker's cache still
         # holds, generation-stamped (the router's routing hints are
@@ -232,20 +236,43 @@ class ShardWorker:
             # count=k' dies on the (k+1)-th micro-batch, mid-load with
             # results unsent (tests/test_serving.py's victim schedule)
             chaos.kill_point("serve_work")
+            # designed-slow-worker drills: 'delay:ms=8,rank=2,
+            # site=serve_work' paces every micro-batch on that rank —
+            # the tail cohort otpu_analyze --requests must attribute
+            chaos.pace("serve_work")
+        req_on = trace.requests_enabled
+        firsts = set()                 # rids first seen THIS command
         results = []
         for rid, prompt_len, tokens_done, n, phashes, hint in batch:
+            if req_on and rid not in self._req_seen:
+                self._req_seen.add(rid)
+                firsts.add(rid)
             if rid not in self._kv:
                 if self.role == "decode":
                     raise MpiError(
                         ErrorClass.ERR_INTERN,
                         f"decode work for rid {rid} before its KV block")
+                if rid in firsts:
+                    # colocated: this work cmd carried the dispatch
+                    # (otpu-req hop 0) AND runs the prefill stage
+                    trace.flow_finish("serve_req", (rid, 0))
+                    t0 = trace.now()
                 self._kv[rid] = self._prefill_or_skip(rid, prompt_len,
                                                       phashes, hint)
+                if rid in firsts:
+                    trace.span("req_prefill", "serve_req", t0,
+                               args={"rid": rid})
+                    spc.record("req_stages")
             toks = self._decode(rid, tokens_done, n)
             spc.record("serve_tokens", len(toks))
+            if rid in firsts:
+                # hop 2 opens at this rid's first token chunk; the
+                # router closes it when the request completes
+                trace.flow_start("serve_req", (rid, 2))
             results.append((rid, toks))
         for rid in free_rids:          # router-confirmed evictions
             self._kv.pop(rid, None)
+            self._req_seen.discard(rid)
         self.comm.send_obj(("res", results, self._take_preport()),
                            self.router, TAG_RES)
 
@@ -260,11 +287,22 @@ class ShardWorker:
                            f"{peer} but no slab pairing exists "
                            f"(peers: {sorted(self._senders)})")
         sender.begin_epoch(epoch)
+        req_on = trace.requests_enabled
         rids = []
         for rid, slot, prompt_len, phashes, hint in batch:
+            if req_on:
+                # otpu-req hop 0 closes at command receipt; the prefill
+                # stage span covers compute + slab write, and slot_ready
+                # opens hop 1 (prefill -> decode, riding the Pready key)
+                trace.flow_finish("serve_req", (rid, 0))
+                t0 = trace.now()
             sender.write_slot(slot, self._prefill_or_skip(
                 rid, prompt_len, phashes, hint))
-            sender.slot_ready(slot)
+            sender.slot_ready(slot, rid=rid if req_on else None)
+            if req_on:
+                trace.span("req_prefill", "serve_req", t0,
+                           args={"rid": rid})
+                spc.record("req_stages")
             rids.append(rid)
         sender.finish_epoch(wait=True)
         self.comm.send_obj(("prefilled", epoch, rids,
@@ -278,13 +316,18 @@ class ShardWorker:
         from ompi_tpu.runtime.progress import progress
 
         self._receiver.begin_epoch(epoch)
+        req_on = trace.requests_enabled
+        t0 = trace.now() if req_on else 0
         pending = list(batch)
         rids = []
         while pending:
             still = []
             for rid, slot in pending:
                 if self._receiver.slot_arrived(slot):
-                    block = self._receiver.read_slot(slot)
+                    # read_slot closes otpu-req hop 1 for this rid
+                    # (the arrow the KV slab's Pready key launched)
+                    block = self._receiver.read_slot(
+                        slot, rid=rid if req_on else None)
                     expect = toy_kv(rid, self.kv_elems)
                     if self._kv_codec:
                         # quantized slab: the decoded block must land
@@ -305,6 +348,12 @@ class ShardWorker:
                         raise AssertionError(
                             f"KV stream corrupted rid {rid} slot {slot}")
                     self._kv[rid] = block
+                    if req_on:
+                        # KV intake wait for this rid: epoch start ->
+                        # its slab partition arrived and verified
+                        trace.span("req_kv", "serve_req", t0,
+                                   args={"rid": rid})
+                        spc.record("req_stages")
                     rids.append(rid)
                 else:
                     still.append((rid, slot))
@@ -368,6 +417,7 @@ class ShardWorker:
                     pass               # stream rode the dead comm
         self._senders = {}
         self._receiver = None
+        self._req_seen.clear()         # replays re-emit their hops
         self._prefix.clear()
         self._preport_installed = []
         self._preport_evicted = []

@@ -557,6 +557,189 @@ def _stage_blame(on_path_all: dict, ix: dict, profiles: dict) -> dict:
     return out
 
 
+# -- per-request decomposition (otpu-req) --------------------------------
+
+#: serve_req span name -> stage key of the six-way decomposition
+_REQ_SPAN_STAGE = {"req_queue": "queue", "req_dispatch": "dispatch",
+                   "req_prefill": "prefill", "req_kv": "kv",
+                   "req_decode": "decode", "req_stream": "stream"}
+#: report order of the six per-request stages
+REQ_STAGES = ("queue", "dispatch", "prefill", "kv", "decode", "stream")
+
+
+def _req_collect(events: list) -> tuple:
+    """Group the otpu-req layer's artifacts by request id: ``serve_req``
+    stage spans (router + worker ranks of the merged timeline) and the
+    ``rid.hop`` flow halves of each request's causal arrow chain."""
+    spans: dict = {}
+    flows: dict = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X" and ev.get("cat") == "serve_req":
+            eargs = ev.get("args") or {}
+            rid = eargs.get("rid")
+            stage = _REQ_SPAN_STAGE.get(ev.get("name"))
+            if rid is None or stage is None:
+                continue
+            ts = float(ev["ts"])
+            spans.setdefault(int(rid), {}).setdefault(stage, []).append(
+                (ts, ts + float(ev.get("dur", 0.0)),
+                 int(ev.get("pid", 0)), eargs))
+        elif ph in ("s", "f") and ev.get("name") == "serve_req":
+            rid_s, _, hop_s = str(ev.get("id", "")).rpartition(".")
+            try:
+                rid, hop = int(rid_s), int(hop_s)
+            except ValueError:
+                continue
+            flows.setdefault(rid, {}).setdefault(hop, {})[ph] = (
+                int(ev.get("pid", 0)), float(ev.get("ts", 0.0)))
+    return spans, flows
+
+
+def _req_decompose(stages: dict) -> Optional[dict]:
+    """One request's six-stage decomposition, or None when the request
+    is not reconstructable (the router's four lifecycle spans plus the
+    worker prefill span must all have survived the ring — an
+    incomplete request cannot reconcile against its own e2e)."""
+    if any(s not in stages
+           for s in ("queue", "dispatch", "decode", "stream", "prefill")):
+        return None
+    # the router spans emit exactly once (at _finish); worker
+    # prefill/kv spans may repeat across requeue replays, so those
+    # stages SUM
+    row = {s: round(sum(e - t for t, e, _p, _a in stages.get(s, ())), 1)
+           for s in REQ_STAGES}
+    first = stages["queue"][-1]
+    last = stages["stream"][-1]
+    e2e = last[1] - first[0]
+    if e2e <= 0:
+        return None
+    # colocated mode runs prefill INSIDE the decode window (the first
+    # work command carries it): clip the overlap out of the decode
+    # stage so the six stages partition the e2e instead of double-
+    # counting it.  Staged mode's prefill/kv sit in the dispatch ->
+    # decode gap, so nothing clips there.
+    dlo, dhi = stages["decode"][-1][0], stages["decode"][-1][1]
+    overlap = 0.0
+    for s in ("prefill", "kv"):
+        for t, e, _p, _a in stages.get(s, ()):
+            overlap += max(0.0, min(e, dhi) - max(t, dlo))
+    row["decode"] = round(max(0.0, row["decode"] - overlap), 1)
+    # staged mode pipelines the decode-side slab read against the
+    # prefill compute (the per-sequence Pready keys make blocks
+    # visible as they land), so the kv wait's head is covered by
+    # prefill time — clip it too, same double-count rule
+    kv_overlap = 0.0
+    for tp, ep, _pp, _ap in stages.get("prefill", ()):
+        for tk, ek, _pk, _ak in stages.get("kv", ()):
+            kv_overlap += max(0.0, min(ep, ek) - max(tp, tk))
+    row["kv"] = round(max(0.0, row["kv"] - kv_overlap), 1)
+    eargs = last[3]
+    return {"stages": row, "e2e_us": round(e2e, 1),
+            "ratio": round(sum(row.values()) / e2e, 3),
+            "tenant": str(eargs.get("tenant") or ""),
+            "pool": str(eargs.get("pool") or ""),
+            "worker": eargs.get("worker"),
+            "prefill_rank": stages["prefill"][-1][2]}
+
+
+def requests_report(events: list,
+                    slo_ms: Optional[float] = None) -> dict:
+    """The --requests section: per-request six-stage decompositions
+    reconciled against each request's own e2e, the exact-p99 tail
+    cohort with its dominant stage / hottest tenant / bounding worker,
+    flow-chain completeness (one causal arrow chain per request across
+    router and worker ranks), and — given ``--slo-ms`` — the exact
+    per-request breach fraction the telemetry plane's rolling-window
+    burn rate must agree with."""
+    spans, flows = _req_collect(events)
+    reqs: dict = {}
+    for rid, st in spans.items():
+        d = _req_decompose(st)
+        if d is not None:
+            reqs[rid] = d
+    total = len(spans)
+    out: dict = {
+        "requests_seen": total,
+        "decomposed": len(reqs),
+        "decomposed_fraction": round(len(reqs) / total, 3)
+        if total else 0.0,
+    }
+    if not reqs:
+        out["note"] = ("no decomposable serve_req spans — run with "
+                       "otpu_trace_requests set and analyze the MERGED "
+                       "timeline (router and worker ranks each hold "
+                       "half the stages)")
+        return out
+    e2e_sorted = sorted(d["e2e_us"] for d in reqs.values())
+    ratios = sorted(d["ratio"] for d in reqs.values())
+    out["stage_median_us"] = {
+        s: round(_percentile(sorted(d["stages"][s]
+                                    for d in reqs.values()), 0.50), 1)
+        for s in REQ_STAGES}
+    out["e2e_us"] = {"p50": round(_percentile(e2e_sorted, 0.50), 1),
+                     "p99": round(_percentile(e2e_sorted, 0.99), 1),
+                     "max": round(e2e_sorted[-1], 1)}
+    out["stage_over_e2e"] = {"min": ratios[0],
+                             "p50": round(_percentile(ratios, 0.50), 3),
+                             "max": ratios[-1]}
+    # exact p99 tail cohort (the rolling histograms estimate p99; the
+    # cohort is computed from the exact per-request samples)
+    p99 = _percentile(e2e_sorted, 0.99)
+    cohort = {rid: d for rid, d in reqs.items() if d["e2e_us"] >= p99}
+    stage_sums = {s: sum(d["stages"][s] for d in cohort.values())
+                  for s in REQ_STAGES}
+    dom = max(stage_sums, key=stage_sums.get)
+    tenants: dict = {}
+    workers: dict = {}
+    for d in cohort.values():
+        tenants[d["tenant"]] = tenants.get(d["tenant"], 0) + 1
+        # blame lands on the rank that RAN the dominant stage: the
+        # prefill rank for prefill/kv tails, the decode worker else
+        w = d["prefill_rank"] if dom in ("prefill", "kv") \
+            else d["worker"]
+        workers[w] = workers.get(w, 0.0) + d["e2e_us"]
+    tail_total = sum(stage_sums.values()) or 1.0
+    out["tail"] = {
+        "p99_us": round(p99, 1),
+        "cohort": len(cohort),
+        "rids": sorted(cohort)[:8],
+        "dominant_stage": dom,
+        "dominant_share": round(stage_sums[dom] / tail_total, 3),
+        "hottest_tenant": max(tenants, key=tenants.get),
+        "bounding_worker": max(workers, key=workers.get),
+    }
+    # flow-chain completeness: every emitted hop has both halves and
+    # the chain runs dispatch (0) .. completion (2) — the merged
+    # timeline renders one arrow chain per complete request
+    complete = 0
+    sample = None
+    for rid in sorted(flows):
+        hops = flows[rid]
+        if 0 in hops and max(hops) == 2 and all(
+                "s" in h and "f" in h for h in hops.values()):
+            complete += 1
+            if sample is None or len(hops) > len(sample["hops"]):
+                sample = {"rid": rid, "hops": [
+                    f"{hop}:r{h['s'][0]}->r{h['f'][0]}"
+                    for hop, h in sorted(hops.items())]}
+    out["flows"] = {"chains_seen": len(flows),
+                    "chains_complete": complete,
+                    "sample": sample}
+    if slo_ms:
+        from ompi_tpu.runtime.telemetry import SLO_BUDGET
+
+        breaches = sum(1 for v in e2e_sorted
+                       if v / 1000.0 > float(slo_ms))
+        frac = breaches / len(e2e_sorted)
+        out["slo_exact"] = {"target_ms": float(slo_ms),
+                            "requests": len(e2e_sorted),
+                            "breaches": breaches,
+                            "breach_fraction": round(frac, 4),
+                            "burn": round(frac / SLO_BUDGET, 3)}
+    return out
+
+
 _LADDER_VERSION = 1
 
 
@@ -632,12 +815,14 @@ def suggest_ladder(report: dict, comm_size: int) -> str:
 def analyze(events: list, step_span: Optional[str] = None,
             profiles: Optional[dict] = None,
             meta: Optional[dict] = None,
-            critical_path: bool = False) -> dict:
+            critical_path: bool = False,
+            requests: bool = False,
+            slo_ms: Optional[float] = None) -> dict:
     """The full report over one clock-aligned event list (see module
     docstring for the sections).  ``meta`` is :func:`load_run`'s third
     element (overflow counters + payload ranks); ``critical_path``
     adds the otpu-crit section (it walks every step, so it is opt-in
-    on the CLI)."""
+    on the CLI); ``requests`` adds the otpu-req per-request section."""
     ranks = sorted({int(e.get("pid", 0)) for e in events}
                    | set((meta or {}).get("payload_ranks") or []))
     per_coll: dict = {}
@@ -762,6 +947,8 @@ def analyze(events: list, step_span: Optional[str] = None,
     if critical_path:
         report["critical_path"] = critical_path_report(
             events, profiles=profiles, step_span=step_span)
+    if requests:
+        report["requests"] = requests_report(events, slo_ms=slo_ms)
     return report
 
 
@@ -836,6 +1023,31 @@ def render_text(report: dict, parsable: bool = False) -> str:
                          f"{cp['critical_exposed_comm']}")
             for k, us in cp["coll_critical_us"].items():
                 lines.append(f"coll_critical_us:{k}:{us}")
+        rq = report.get("requests") or {}
+        if rq:
+            lines.append(f"req:{rq['decomposed']}:"
+                         f"{rq['requests_seen']}:"
+                         f"{rq['decomposed_fraction']}")
+        if rq.get("stage_median_us"):
+            for s in REQ_STAGES:
+                lines.append(
+                    f"req_stage_median:{s}:{rq['stage_median_us'][s]}")
+            e = rq["e2e_us"]
+            lines.append(f"req_e2e:{e['p50']}:{e['p99']}:{e['max']}")
+            ra = rq["stage_over_e2e"]
+            lines.append(f"req_ratio:{ra['min']}:{ra['p50']}:{ra['max']}")
+            t = rq["tail"]
+            lines.append(
+                f"req_tail:{t['cohort']}:{t['dominant_stage']}:"
+                f"{t['dominant_share']}:{t['hottest_tenant']}:"
+                f"{t['bounding_worker']}")
+            fl = rq["flows"]
+            lines.append(f"req_flows:{fl['chains_complete']}:"
+                         f"{fl['chains_seen']}")
+            se = rq.get("slo_exact")
+            if se:
+                lines.append(f"req_slo:{se['target_ms']}:"
+                             f"{se['breach_fraction']}:{se['burn']}")
         sk = report["skew_us"]
         lines.append(f"skew_us:{sk['mean']}:{sk['p50']}:{sk['p99']}:"
                      f"{sk['max']}")
@@ -909,6 +1121,47 @@ def render_text(report: dict, parsable: bool = False) -> str:
                     f"{prof['gil_wait']}, top phases "
                     + ", ".join(f"{k}={v}" for k, v in
                                 list(prof["phases"].items())[:4]))
+    rq = report.get("requests")
+    if rq is not None:
+        lines.append("")
+        lines.append(
+            f"per-request decomposition (otpu-req): "
+            f"{rq['decomposed']}/{rq['requests_seen']} requests "
+            f"decomposed ({100 * rq['decomposed_fraction']:.0f}%)")
+        if rq.get("stage_median_us"):
+            med = rq["stage_median_us"]
+            lines.append("  stage medians (us): " + "  ".join(
+                f"{s} {med[s]}" for s in REQ_STAGES))
+            e = rq["e2e_us"]
+            ra = rq["stage_over_e2e"]
+            lines.append(
+                f"  e2e us: p50 {e['p50']}  p99 {e['p99']}  max "
+                f"{e['max']}; stage-sum/e2e {ra['min']}..{ra['max']} "
+                f"(p50 {ra['p50']})")
+            t = rq["tail"]
+            lines.append(
+                f"  p99 tail cohort ({t['cohort']} requests >= "
+                f"{t['p99_us']}us): dominant stage "
+                f"{t['dominant_stage']} "
+                f"({100 * t['dominant_share']:.0f}% of cohort stage "
+                f"time), hottest tenant {t['hottest_tenant']!r}, "
+                f"bounding worker rank {t['bounding_worker']}")
+            fl = rq["flows"]
+            lines.append(
+                f"  flow chains: {fl['chains_complete']}/"
+                f"{fl['chains_seen']} complete"
+                + (f"; e.g. rid {fl['sample']['rid']}: "
+                   + " ".join(fl["sample"]["hops"])
+                   if fl.get("sample") else ""))
+            se = rq.get("slo_exact")
+            if se:
+                lines.append(
+                    f"  exact SLO check vs {se['target_ms']}ms: "
+                    f"{se['breaches']}/{se['requests']} breaches "
+                    f"(fraction {se['breach_fraction']}), burn "
+                    f"{se['burn']}x budget")
+        elif rq.get("note"):
+            lines.append(f"  {rq['note']}")
     cp = report.get("critical_path")
     if cp is not None:
         lines.append("")
@@ -974,6 +1227,18 @@ def main(argv=None) -> int:
                          "contributions as a draft coll/tuned dynamic-"
                          "rules file ('-' = stdout); implies "
                          "--critical-path")
+    ap.add_argument("--requests", action="store_true",
+                    dest="requests",
+                    help="Reconstruct per-request stage decompositions "
+                         "(otpu-req serve_req spans + rid.hop flow "
+                         "chains) and attribute the p99 tail cohort")
+    ap.add_argument("--slo-ms", default=None, type=float,
+                    dest="slo_ms", metavar="MS",
+                    help="With --requests: check the exact per-request "
+                         "e2e samples against this SLO target and "
+                         "report the exact breach fraction / burn the "
+                         "telemetry plane's rolling window must agree "
+                         "with")
     ap.add_argument("--diff", default=None, metavar="OLD",
                     help="Compare against a previous JSON report and "
                          "print the deltas")
@@ -982,7 +1247,9 @@ def main(argv=None) -> int:
     report = analyze(events, step_span=args.step_span,
                      profiles=profiles, meta=meta,
                      critical_path=bool(args.critical_path
-                                        or args.suggest_ladder))
+                                        or args.suggest_ladder),
+                     requests=bool(args.requests or args.slo_ms),
+                     slo_ms=args.slo_ms)
     if args.suggest_ladder:
         text = suggest_ladder(report, comm_size=len(report["ranks"]))
         if args.suggest_ladder == "-":

@@ -284,6 +284,24 @@ def main(argv=None) -> int:
         for var in registry.all_vars("serving"):
             out.append(_fmt(f"serving var {var.name}",
                             f"{var.value!r} — {var.help}", p))
+        # otpu-req request tracing rides the trace group but is a
+        # serving-plane switch — surface it here, with the slo
+        # telemetry key and the declared req_*/slo_* SPC counters
+        # (enumerated from their registries, never a hand-kept list)
+        from ompi_tpu.runtime import spc as _sspc
+        from ompi_tpu.runtime import telemetry as _stelemetry
+
+        var = registry.lookup("otpu_trace_requests")
+        if var is not None:
+            out.append(_fmt(f"serving var {var.name}",
+                            f"{var.value!r} — {var.help}", p))
+        out.append(_fmt("serving telemetry key slo",
+                        _stelemetry.SCHEMA["slo"], p))
+        for cname in _sspc._COUNTERS:
+            if cname.startswith(("req_", "slo_")):
+                out.append(_fmt(f"serving counter {cname}",
+                                "SPC counter (see --pvars for values)",
+                                p))
         for pname, size, source in _pset_rows():
             if pname.startswith("mpi://serving/"):
                 out.append(_fmt(f"serving pset {pname}",

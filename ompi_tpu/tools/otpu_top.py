@@ -180,6 +180,22 @@ def _fleet_cell(sample: dict) -> str:
     return f"q{queued}/-"
 
 
+def _slo_cell(sample: dict) -> str:
+    """otpu-req SLO burn of a rank publishing the ``slo`` key (the
+    router/controller rank): the worst per-(pool, tenant) error-budget
+    burn rate in the rolling window — '0.6x' sustainable, '>1x' is
+    budget-eating ('-' off the router rank or with no SLO target)."""
+    slo = sample.get("slo")
+    if not slo:
+        return "-"
+    burns = [float(t.get("burn", 0.0))
+             for tenants in (slo.get("pools") or {}).values()
+             for t in tenants.values()]
+    if not burns:
+        return "-"
+    return f"{max(burns):.1f}x"
+
+
 def render_table(session: TopSession, samples: dict, coll: str,
                  parsable: bool = False) -> str:
     """The per-rank live table (or ``:``-separated rows)."""
@@ -189,7 +205,7 @@ def render_table(session: TopSession, samples: dict, coll: str,
         out = []
         for rank, s, stale in rows:
             if s is None:
-                out.append(f"{rank}:-:-:-:-:-:-:-:-:{int(stale)}")
+                out.append(f"{rank}:-:-:-:-:-:-:-:-:-:{int(stale)}")
                 continue
             tcp = s.get("tcp") or {}
             chaos = s.get("chaos") or {}
@@ -200,18 +216,19 @@ def render_table(session: TopSession, samples: dict, coll: str,
                 _coll_cell(s, coll), tcp.get("outq_frags", 0),
                 sum(chaos.values()),
                 "-" if pct is None else round(pct, 1),
-                _fleet_cell(s), int(stale))))
+                _fleet_cell(s), _slo_cell(s), int(stale))))
         return "\n".join(out)
     hdr = (f"{'rank':>4}  {'seq':>6}  {'msg/s':>8}  {'bytes/s':>8}  "
            f"{coll + ' p50/p99':>16}  {'outq':>5}  {'stage':>6}  "
            f"{'serveq':>6}  {'chaos':>5}  {'host%/gil':>10}  "
-           f"{'fleet':>8}  flag")
+           f"{'fleet':>8}  {'burn':>5}  flag")
     lines = [hdr]
     for rank, s, stale in rows:
         if s is None:
             lines.append(f"{rank:>4}  {'-':>6}  {'-':>8}  {'-':>8}  "
                          f"{'-':>16}  {'-':>5}  {'-':>6}  {'-':>6}  "
-                         f"{'-':>5}  {'-':>10}  {'-':>8}  STALE")
+                         f"{'-':>5}  {'-':>10}  {'-':>8}  {'-':>5}  "
+                         "STALE")
             continue
         tcp = s.get("tcp") or {}
         staging = s.get("staging") or {}
@@ -228,6 +245,7 @@ def render_table(session: TopSession, samples: dict, coll: str,
             f"{sum(chaos.values()):>5}  "
             f"{_host_cell(s):>10}  "
             f"{_fleet_cell(s):>8}  "
+            f"{_slo_cell(s):>5}  "
             f"{'STALE' if stale else 'ok'}")
     return "\n".join(lines)
 

@@ -42,3 +42,16 @@ def qcount():
 
 def qclocked(profile):
     profile.stage_mark("quant.encooode")      # BAD: not in STAGES
+
+
+def rcount():
+    spc.record("req_tracez")                  # BAD: not in _COUNTERS
+    spc.record("slo_breachez")                # BAD: not in _COUNTERS
+
+
+def rpublish(telemetry):
+    telemetry.register_source("slo_extra", dict)  # BAD: not a SCHEMA key
+
+
+def rlinked():
+    trace.flow_start("serve_reqz", "9.1")     # BAD: no such category

@@ -13,6 +13,8 @@ def diagnose():
 def count():
     spc.record("fast_frames")                 # declared in _COUNTERS
     spc.record("quant_encodes")               # declared in _COUNTERS
+    spc.record("req_traced")                  # declared in _COUNTERS
+    spc.record("slo_breaches")                # declared in _COUNTERS
     spc.record(_dynamic_name())               # non-literal: out of scope
 
 
@@ -40,6 +42,7 @@ _rh("help-flight", "good-reason", "Dump at {path}.")
 def publish(telemetry):
     telemetry.register_source("tcp", dict)    # declared in SCHEMA
     telemetry.register_source("fleet", dict)  # the fleet control plane
+    telemetry.register_source("slo", dict)    # the otpu-req SLO plane
 
 
 def crash(flight):
@@ -57,4 +60,6 @@ def clocked(profile):
 def linked():
     trace.flow_start("pml_msg", "1.2.3.4")    # declared category
     trace.flow_finish("coll_round", "7.0")    # declared category
+    trace.flow_start("serve_req", "9.1")      # declared category
+    trace.flow_finish("serve_req", "9.1")     # declared category
     trace.flow_start(_dynamic_name(), "x")    # non-literal: out of scope

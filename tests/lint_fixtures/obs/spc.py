@@ -2,4 +2,5 @@
 
 _COUNTERS = (
     "send", "recv", "fast_frames", "quant_encodes",
+    "req_traced", "slo_breaches",
 )

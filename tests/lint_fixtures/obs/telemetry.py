@@ -5,4 +5,5 @@ SCHEMA = {
     "tcp": "transport out-queue depth",
     "serving": "scheduler queue depth",
     "fleet": "serving-fleet pool/prefix/autoscale tables",
+    "slo": "per-pool/per-tenant SLO burn accounting",
 }

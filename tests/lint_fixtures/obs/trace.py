@@ -5,4 +5,5 @@ against."""
 FLOW_CATEGORIES = {
     "pml_msg": "point-to-point message flow",
     "coll_round": "collective round key",
+    "serve_req": "per-serving-request hop key (rid.hop)",
 }

@@ -118,6 +118,36 @@ def test_serving_rows_pinned(pins):
         assert r["p99_exact_ms"] <= 2.0 * r["p99_ms"] + 1.0
 
 
+def test_serving_stage_medians_pinned(pins):
+    """Every serving row must carry the otpu-req per-request stage
+    decomposition (all six stages present; a vanished column means the
+    --serving run stopped arming otpu_trace_requests or the analyzer
+    stopped decomposing), the decomposed count must cover the row's
+    requests, and the decode median — the dominant compute stage —
+    must not collapse by more than the same wide open-loop band the
+    p99 pins use."""
+    sweep = _load("BENCH_SWEEP.json")
+    rows = {r.get("coll"): r for r in sweep["results"]}
+    for key, pin in pins["serving_stage_median_ms"].items():
+        r = rows.get(key)
+        assert r is not None, f"pinned serving row {key} vanished"
+        assert r.get("ok", True), f"{key}: serving bench FAILED"
+        med = r.get("stage_median_ms")
+        assert med, f"{key}: stage_median_ms column vanished"
+        assert set(med) >= {"queue", "dispatch", "prefill", "kv",
+                            "decode", "stream"}, (
+            f"{key}: incomplete stage decomposition {sorted(med)}")
+        # fleet rows share one fleet-wide decomposition, so the floor
+        # is per-run, not per-tenant
+        assert r.get("req_decomposed", 0) >= 0.5 * r["nbytes"], (
+            f"{key}: only {r.get('req_decomposed')} of {r['nbytes']} "
+            "requests decomposed")
+        got = med["decode"]
+        assert 0.0 < got <= 4.0 * pin, (
+            f"{key}: decode median {got}ms vs pin {pin}ms — >4x "
+            "regression in the per-request decode stage")
+
+
 def test_recovery_rows_pinned(pins):
     """The recovery benchmark row (bench.py --recovery: elastic
     train-through-failure, detect→resume latency over 3 chaos-scheduled

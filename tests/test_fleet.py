@@ -597,8 +597,15 @@ def test_otpu_info_serving_surface():
         capture_output=True, text=True, timeout=180, cwd=REPO, env=env)
     assert out.returncode == 0, out.stderr
     for var in ("otpu_serving_prefix_block", "otpu_serving_slo_p99_ms",
-                "otpu_serving_scale_cooldown"):
+                "otpu_serving_scale_cooldown", "otpu_serving_slo_window_s",
+                "otpu_trace_requests"):
         assert var in out.stdout, var
+    # the otpu-req surfaces: SLO telemetry key and the registry-
+    # enumerated request/SLO SPC counters
+    assert "serving telemetry key slo" in out.stdout
+    for ctr in ("req_traced", "req_stages", "slo_goodput",
+                "slo_breaches"):
+        assert f"serving counter {ctr}" in out.stdout, ctr
     par = subprocess.run(
         [sys.executable, "-m", "ompi_tpu.tools.otpu_info", "--serving",
          "--parsable"],

@@ -98,12 +98,16 @@ def test_observability_contracts():
                    FIXTURES / "obs" / "telemetry.py",
                    FIXTURES / "obs" / "profile.py",
                    FIXTURES / "obs" / "trace.py")
-    assert len(bad) == 9, bad
+    assert len(bad) == 13, bad
     msgs = " | ".join(f.message for f in bad)
     assert "no matching register_help" in msgs
     assert "not declared in runtime/spc.py" in msgs
     assert "quant_encodez" in msgs            # the quant counter twin
     assert "quant.encooode" in msgs           # the quant stage twin
+    assert "req_tracez" in msgs               # the otpu-req counter twin
+    assert "slo_breachez" in msgs             # the SLO counter twin
+    assert "slo_extra" in msgs                # the slo SCHEMA-key twin
+    assert "serve_reqz" in msgs               # the request-flow twin
     assert "never consumed" in msgs
     assert "not a key of runtime/telemetry.py SCHEMA" in msgs
     assert "no registered help-flight template" in msgs

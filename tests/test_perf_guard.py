@@ -533,6 +533,69 @@ def test_flow_disabled_zero_overhead():
         rt.reset_for_testing()
 
 
+def test_requests_disabled_zero_overhead():
+    """otpu-req satellite pin: with ``otpu_trace_requests`` off (the
+    default) the request layer is an identity even while tracing is
+    fully ON — a whole serving run emits no serve_req spans, no
+    rid.hop flow halves, no rid keys anywhere in the trace, requests
+    never grow the request-layer lifecycle stamps, and the req_*/slo_*
+    SPC counters stay flat (SLO accounting is gated by its own target
+    var, unset here)."""
+    import threading
+
+    import ompi_tpu
+    from ompi_tpu.base.var import registry as _registry
+    from ompi_tpu.runtime import init as rt
+    from ompi_tpu.runtime import spc, trace
+
+    rt.reset_for_testing()
+    _registry.set("otpu_trace_enable", True)
+    trace.reset_for_testing()
+    try:
+        assert trace.enabled is True and trace.requests_enabled is False
+        w = ompi_tpu.init()
+        from ompi_tpu.serving import (ContinuousBatchScheduler, Router,
+                                      ShardWorker)
+        from ompi_tpu.serving.driver import PoissonDriver
+
+        before = (spc.read("req_traced"), spc.read("req_stages"),
+                  spc.read("slo_goodput"), spc.read("slo_breaches"))
+        workers = [ShardWorker(w.as_rank(r), router=0) for r in (1, 2)]
+        threads = [threading.Thread(target=wk.serve, daemon=True)
+                   for wk in workers]
+        for t in threads:
+            t.start()
+        r = Router(w.as_rank(0),
+                   scheduler=ContinuousBatchScheduler(
+                       max_batch=4, max_batch_tokens=4096),
+                   workers=[1, 2], decode_chunk=4)
+        rep = PoissonDriver(rate_rps=800, n_requests=8,
+                            seed=2).run(r, max_wall_s=60)
+        r.shutdown()
+        for t in threads:
+            t.join(timeout=10)
+        assert rep["requests"] == 8
+        evs = trace.chrome_events()
+        assert not [e for e in evs if e.get("cat") == "serve_req"]
+        assert not [e for e in evs if e.get("ph") in ("s", "f")
+                    and e.get("name") == "serve_req"]
+        for e in evs:
+            assert "rid" not in (e.get("args") or {}), e
+        # the request-layer stamps never fired (admit/done stamp
+        # unconditionally — they predate otpu-req; the three new
+        # single-write stamps are requests-gated)
+        for q in r.completed():
+            assert q.dispatch_ns is None and q.decode_ns is None \
+                and q.last_res_ns is None, q.rid
+        assert (spc.read("req_traced"), spc.read("req_stages"),
+                spc.read("slo_goodput"),
+                spc.read("slo_breaches")) == before
+    finally:
+        _registry.set("otpu_trace_enable", False)
+        trace.reset_for_testing()
+        rt.reset_for_testing()
+
+
 def test_telemetry_disabled_zero_overhead():
     """otpu-top satellite pin: with otpu_telemetry_interval_ms at its
     default (0), the telemetry plane is an identity — no sampler
