@@ -1335,6 +1335,121 @@ def refresh_quant_tables() -> list:
     return rows
 
 
+_MOE_WORKER = """
+import json, os, shutil, tempfile, time
+import ompi_tpu
+from ompi_tpu.parallel.elastic import ElasticTrainer
+from ompi_tpu.parallel.moe import MoeTrainer
+
+E, D, T, STEPS, WARM = 8, 32, 256, 24, 4
+w = ompi_tpu.init()
+# every rank must see the SAME checkpoint tree: derive it from the
+# coord address (identical across ranks, unique per live job)
+base = os.path.join(tempfile.gettempdir(), "otpu_moebench_"
+                    + os.environ["OTPU_COORD"].replace(":", "_")
+                    .replace("/", "_"))
+if w.rank == 0:
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base)
+w.barrier()
+tr = MoeTrainer(w, base + "/moe", n_experts=E, expert_dim=D,
+                tokens_per_step=T, ckpt_every=1 << 30)
+tr.train(WARM)
+w.barrier(); t0 = time.perf_counter()
+tr.train(WARM + STEPS)
+w.barrier(); moe_s = time.perf_counter() - t0
+rep = tr.report()
+dn = ElasticTrainer(w, base + "/dense", model_size=E * D,
+                    global_batch=T, ckpt_every=1 << 30)
+dn.train(WARM)
+w.barrier(); t0 = time.perf_counter()
+dn.train(WARM + STEPS)
+w.barrier(); dense_s = time.perf_counter() - t0
+if w.rank == 0:
+    rows = [
+        {"coll": "moe_host_n2", "nbytes": T, "ok": True,
+         "lat_us": round(moe_s / STEPS * 1e6, 1),
+         "tokens_per_s": round(T * STEPS / moe_s, 1),
+         "imbalance": rep["imbalance_max"],
+         "dropped": rep["dropped"]},
+        {"coll": "moe_dense_n2", "nbytes": T, "ok": True,
+         "lat_us": round(dense_s / STEPS * 1e6, 1),
+         "tokens_per_s": round(T * STEPS / dense_s, 1)},
+    ]
+    print("MOEBENCH " + json.dumps(rows))
+ompi_tpu.finalize()
+"""
+
+
+def moe_rows(n: int = 2) -> list:
+    """``bench.py --moe``: expert-parallel training throughput vs the
+    dense trainer at MATCHED params (same weight count E*D, same token
+    batch, same lr schedule) over one tpurun world — tokens/sec, the
+    per-step latency, and the gating load-imbalance factor (a pure
+    function of the seeded plan, so the committed value is exact, not
+    a noisy measurement)."""
+    return _run_history_worker(_MOE_WORKER, "MOEBENCH", n)
+
+
+def _moe_md_section(rows) -> list:
+    lines = ["", "## MoE (expert-parallel host trainer vs dense)",
+             "",
+             "`bench.py --moe`: the `parallel/moe` expert-parallel "
+             "trainer (top-2 gating, capacity-factor dispatch over "
+             "the ragged alltoallv/allgatherv tier) against the dense "
+             "`parallel/elastic` trainer at matched parameter count "
+             "and token batch.  `imbalance` is max-expert-load over "
+             "mean — deterministic for the committed seed, so it is "
+             "pinned exactly; latency/token rows carry the usual "
+             "CI-host noise bands.",
+             "",
+             "| row | tokens | step us | tokens/s | imbalance | "
+             "dropped |",
+             "|---|---|---|---|---|---|"]
+    for r in rows:
+        if not r.get("ok", True):
+            lines.append(f"| {r['coll']} | FAILED | - | - | - | - |")
+            continue
+        lines.append(
+            f"| {r['coll']} | {r.get('nbytes', '-')} | "
+            f"{r.get('lat_us', '-')} | {r.get('tokens_per_s', '-')} | "
+            f"{r.get('imbalance', '-')} | {r.get('dropped', '-')} |")
+    return lines
+
+
+def refresh_moe_tables() -> list:
+    """``bench.py --moe``: run the MoE-vs-dense rows, fold them into
+    the committed sweep tables (replacing previous moe rows — the
+    serving-table discipline), and append them as BENCH_HISTORY points
+    so ``otpu_perf --diff`` guards the per-step latency."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows = moe_rows()
+    try:
+        with open(os.path.join(here, "BENCH_SWEEP.json")) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        payload = {"ndev": 0, "results": []}
+    payload["results"] = [r for r in payload.get("results", [])
+                          if not str(r.get("coll", "")).startswith(
+                              "moe_")] + rows
+    _atomic_write(os.path.join(here, "BENCH_SWEEP.json"),
+                  json.dumps(payload, indent=1))
+    md_path = os.path.join(here, "BENCH_SWEEP.md")
+    try:
+        with open(md_path) as f:
+            md = f.read()
+    except OSError:
+        md = "# Collective sweep\n"
+    _atomic_write(md_path, _splice_md_section(
+        md, "## MoE (expert-parallel host trainer vs dense)",
+        _moe_md_section(rows)))
+    hist = [{"key": r["coll"], "lat_us": r["lat_us"], "k": 3}
+            for r in rows if r.get("ok", True) and r.get("lat_us")]
+    if hist:
+        append_history(hist, "bench", "host_sm_n2")
+    return rows
+
+
 _STAGING_OSU = """
 import json, statistics, sys, time
 import numpy as np
@@ -2734,6 +2849,9 @@ if __name__ == "__main__":
             print(json.dumps(row))
     elif "--quant" in sys.argv:
         for row in refresh_quant_tables():
+            print(json.dumps(row))
+    elif "--moe" in sys.argv:
+        for row in refresh_moe_tables():
             print(json.dumps(row))
     elif "--pod-smoke" in sys.argv:
         sys.exit(pod_smoke(dry_run="--dry-run" in sys.argv))

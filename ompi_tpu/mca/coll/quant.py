@@ -61,8 +61,10 @@ _CODEC_IDS = {"int8": 1, "bf16": 2}
 _CODEC_BY_ID = {v: k for k, v in _CODEC_IDS.items()}
 
 #: collectives the quant tier implements (dequant-accumulate fold for
-#: the reduction; decode-only for allgather)
-QUANT_COLLS = ("allreduce", "allgather")
+#: the reduction; decode-only for allgather and alltoallv — the latter
+#: is the MoE token-dispatch payload, parallel/moe.dispatch_tokens,
+#: pure routing with no reduction so commutativity never gates it)
+QUANT_COLLS = ("allreduce", "allgather", "alltoallv")
 
 DEFAULT_BLOCK = 128        # elements per scale block (= one lane row)
 DEFAULT_MIN_BYTES = 64 << 10

@@ -44,6 +44,33 @@ _MENUS = {
     "scatter": algs.SCATTER,
 }
 
+#: the DEVICE-tier ladder cells (ops/pallas_overlap): communication-
+#: fused matmul programs consulted by name from jit-adjacent call sites
+#: (the MoE expert FFN, parallel/moe.py).  Deliberately NOT rows in
+#: ``_MENUS`` — host menu entries take ``(comm, buf, ...)`` while these
+#: take ``(a, b, mesh, axis)`` — but they ride the same component so
+#: one force-var surface (``otpu_coll_tuned_fused_cells``) governs both
+#: tiers' overrides.
+DEVICE_CELLS = ("matmul_allreduce", "matmul_reduce_scatter")
+
+
+def device_cell(name: str):
+    """Resolve a device-tier fused ladder cell, honoring the force-var.
+
+    Returns the ``ops/pallas_overlap`` kernel callable, or None when
+    the fused tier is disabled (``fused_cells=off``) or the var forces
+    a DIFFERENT cell — the caller then falls back to its unfused
+    einsum+psum form, mirroring ``_run``'s safe-default discipline."""
+    if name not in DEVICE_CELLS:
+        raise KeyError(f"no device ladder cell {name!r} (known: "
+                       f"{', '.join(DEVICE_CELLS)})")
+    forced = COMPONENT.fused_cells_var()
+    if forced == "off" or (forced and forced != name):
+        return None
+    from ompi_tpu.ops import pallas_overlap
+
+    return getattr(pallas_overlap, name)
+
 
 def _nbytes(buf) -> int:
     # ndarrays answer .nbytes directly — np.asarray on the hot path
@@ -374,6 +401,13 @@ class TunedCollComponent(Component):
             self._seg[coll] = self.register_var(
                 f"{coll}_segsize", vtype=VarType.INT, default=default,
                 help=f"Segment size in bytes for segmented {coll} algorithms")
+        self._fused = self.register_var(
+            "fused_cells", vtype=VarType.STRING, default="",
+            help="Device-tier fused ladder cells (ops/pallas_overlap) "
+                 f"consulted via device_cell(): one of "
+                 f"{', '.join(DEVICE_CELLS)} to force that cell only, "
+                 "'off' to disable the fused tier (callers fall back to "
+                 "unfused einsum+psum), empty = ladder decides")
         self._eager_lane = self.register_var(
             "eager_lane_max", vtype=VarType.SIZE, default="4k",
             help="Allreduces below this take the SPC-counted small-"
@@ -403,6 +437,10 @@ class TunedCollComponent(Component):
     def segsize(self, coll: str) -> int:
         v = self._seg.get(coll)
         return int(v.value) if v is not None else 1 << 20
+
+    def fused_cells_var(self) -> str:
+        v = getattr(self, "_fused", None)
+        return (v.value or "").strip() if v is not None else ""
 
     def eager_lane_max(self) -> int:
         v = getattr(self, "_eager_lane", None)

@@ -482,6 +482,34 @@ class Ob1Pml:
         spc.record("recv")
         return self.irecv(comm, buf, source, tag).wait()
 
+    def _probe_liveness(self, comm, source: int, spins: int) -> None:
+        """Keep a blocking probe out of the one FT hole request
+        completion cannot cover: a probe is never a posted request, so
+        ``_peer_failed`` cannot complete it in error — poll the ft
+        state like coll/sm's counter waits.  ULFM probe semantics: a
+        named failed source raises ERR_PROC_FAILED, a revoked comm
+        raises ERR_REVOKED; ANY_SOURCE is left pending (the
+        ``_peer_failed`` precedent)."""
+        if spins % 2048:
+            return
+        if comm.is_revoked():
+            from ompi_tpu.api.errors import RevokedError
+
+            raise RevokedError(f"{comm.name} revoked during a "
+                               "blocking probe")
+        if source == ANY_SOURCE:
+            return
+        from ompi_tpu.ft import state as ft_state
+
+        src_world = (comm.remote_group if comm.is_inter
+                     else comm.group).world_rank(source)
+        if ft_state.is_failed(src_world):
+            from ompi_tpu.api.errors import ProcFailedError
+
+            raise ProcFailedError(
+                f"peer world rank {src_world} failed during a "
+                "blocking probe", (src_world,))
+
     def probe(self, comm, source: int, tag: int, blocking: bool):
         spc.record("probe" if blocking else "iprobe")
         from ompi_tpu.runtime.progress import progress
@@ -489,6 +517,7 @@ class Ob1Pml:
         probe_req = RecvRequest(self, comm, np.empty(0, np.uint8), source, tag)
         dst_world = comm.world_rank(comm.rank)
         key = (comm.cid, dst_world)
+        spins = 0
         while True:
             with self._lock:
                 st = self._match.setdefault(key, _MatchState())
@@ -513,6 +542,8 @@ class Ob1Pml:
                             return True, status
                 return False, None
             progress()
+            spins += 1
+            self._probe_liveness(comm, source, spins)
 
     def mprobe(self, comm, source: int, tag: int, blocking: bool):
         from ompi_tpu.runtime.progress import progress
@@ -520,6 +551,7 @@ class Ob1Pml:
         probe_req = RecvRequest(self, comm, np.empty(0, np.uint8), source, tag)
         dst_world = comm.world_rank(comm.rank)
         key = (comm.cid, dst_world)
+        spins = 0
         while True:
             with self._lock:
                 st = self._match.setdefault(key, _MatchState())
@@ -535,6 +567,8 @@ class Ob1Pml:
             if not blocking:
                 return False, None
             progress()
+            spins += 1
+            self._probe_liveness(comm, source, spins)
 
     def _cancel_recv(self, req: RecvRequest) -> bool:
         with self._lock:

@@ -1734,9 +1734,6 @@ def all_gather_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
         return x
     chunk_rows = int(chunk_rows)
     R = int(x.shape[1])
-    Rp = -(-R // chunk_rows) * chunk_rows
-    if Rp != R:
-        x = jnp.pad(x, ((0, 0), (0, Rp - R), (0, 0)))
     # clamp to the block size (see all_to_all_v: an oversized count
     # means out-of-bounds remote DMA on hardware)
     counts = jnp.clip(jnp.asarray(counts, jnp.int32), 0, R)
@@ -1744,6 +1741,15 @@ def all_gather_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
         raise ValueError(
             f"all_gather_v needs ({n},) counts, got "
             f"{tuple(counts.shape)}")
+    if R == 0 or x.shape[2] == 0:
+        # zero-row / zero-width slab: every count clamps to 0 valid
+        # rows, so the gather is a no-op.  Return without building a
+        # kernel — an empty block has no (chunk, W) window to slice
+        # (interpret-mode DMA discharge rejects the slice statically)
+        return x
+    Rp = -(-R // chunk_rows) * chunk_rows
+    if Rp != R:
+        x = jnp.pad(x, ((0, 0), (0, Rp - R), (0, 0)))
     fn = _jit_all_gather_v(mesh, axis, Rp, int(x.shape[2]), chunk_rows,
                            str(x.dtype), interpret)
     out = fn(counts, x)
@@ -1798,11 +1804,6 @@ def all_to_all_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
         return x
     chunk_rows = int(chunk_rows)
     R = int(x.shape[2])
-    # the kernel slices fixed (chunk, W) windows: the row dim must be a
-    # whole number of chunks or the last window overruns the buffer
-    Rp = -(-R // chunk_rows) * chunk_rows
-    if Rp != R:
-        x = jnp.pad(x, ((0, 0), (0, 0), (0, Rp - R), (0, 0)))
     # clamp to the block size: a count beyond R would drive the chunk
     # loops past the block on hardware — out-of-bounds remote DMA into
     # the neighbor's adjacent slot, not an error
@@ -1811,6 +1812,17 @@ def all_to_all_v(x, counts, mesh, axis: str, chunk_rows: int = 8,
         raise ValueError(
             f"all_to_all_v needs an ({n}, {n}) counts table, got "
             f"{tuple(counts.shape)}")
+    if R == 0 or x.shape[3] == 0:
+        # zero-row / zero-width slab: every count clamps to 0 valid
+        # rows, so the exchange is a no-op.  Return without building a
+        # kernel — an empty block has no (chunk, W) window to slice
+        # (interpret-mode DMA discharge rejects the slice statically)
+        return x
+    # the kernel slices fixed (chunk, W) windows: the row dim must be a
+    # whole number of chunks or the last window overruns the buffer
+    Rp = -(-R // chunk_rows) * chunk_rows
+    if Rp != R:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, Rp - R), (0, 0)))
     fn = _jit_all_to_all_v(mesh, axis, Rp, int(x.shape[3]), chunk_rows,
                            str(x.dtype), interpret)
     out = fn(counts, x)

@@ -1,4 +1,4 @@
-"""Device-mesh construction for the (dp, pp, sp, tp) axis set."""
+"""Device-mesh construction for the (dp, pp, sp, tp[, expert]) axis set."""
 from __future__ import annotations
 
 import dataclasses
@@ -7,6 +7,11 @@ import numpy as np
 
 AXES = ("dp", "pp", "sp", "tp")
 
+#: the MoE axis name: appended after the dense axes only when the spec
+#: asks for expert parallelism (ep > 1), so every dense caller keeps
+#: the 4-axis mesh it always had
+EXPERT_AXIS = "expert"
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
@@ -14,13 +19,19 @@ class MeshSpec:
     pp: int = 1
     sp: int = 1
     tp: int = 1
+    # expert-parallel ways (parallel/moe.py); defaulted so every
+    # existing MeshSpec(...) construction and equality pin is unchanged
+    ep: int = 1
 
     @property
     def n(self) -> int:
-        return self.dp * self.pp * self.sp * self.tp
+        return self.dp * self.pp * self.sp * self.tp * self.ep
 
     def sizes(self) -> dict:
-        return {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp}
+        d = {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp}
+        if self.ep > 1:
+            d["ep"] = self.ep
+        return d
 
 
 def _prime_factors(n: int) -> list:
@@ -50,7 +61,14 @@ def default_axis_sizes(n_devices: int) -> MeshSpec:
 
 
 def make_mesh(devices, spec: MeshSpec = None):
-    """Build a jax Mesh with axes (dp, pp, sp, tp) over the given devices."""
+    """Build a jax Mesh over the given devices.
+
+    Dense specs (ep == 1) get the exact 4-axis (dp, pp, sp, tp) mesh
+    this function always built; an expert-parallel spec appends the
+    ``expert`` axis innermost — expert dispatch is the densest
+    all-to-all in the program, so it rides the fastest links, the HiCCL
+    hierarchical-composition ordering (PAPERS.md arxiv 2408.05962).
+    """
     from jax.sharding import Mesh
 
     devices = list(devices)
@@ -59,5 +77,9 @@ def make_mesh(devices, spec: MeshSpec = None):
     if spec.n != len(devices):
         raise ValueError(f"mesh spec {spec} needs {spec.n} devices, "
                          f"got {len(devices)}")
+    if spec.ep > 1:
+        grid = np.array(devices).reshape(
+            spec.dp, spec.pp, spec.sp, spec.tp, spec.ep)
+        return Mesh(grid, AXES + (EXPERT_AXIS,)), spec
     grid = np.array(devices).reshape(spec.dp, spec.pp, spec.sp, spec.tp)
     return Mesh(grid, AXES), spec

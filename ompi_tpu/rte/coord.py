@@ -200,6 +200,7 @@ class CoordServer:
                     resp = self._replay_or_claim(cid, rid)
                     if resp is None:
                         try:
+                            self._maybe_stall(req)
                             resp = self._handle(req, conn)
                         except Exception as exc:
                             # a malformed/version-skewed request must
@@ -247,6 +248,22 @@ class CoordServer:
             while len(self._rpc_cache) > 4096:
                 self._rpc_cache.popitem(last=False)
             self._rpc_cond.notify_all()
+
+    def _maybe_stall(self, req: dict) -> None:
+        """Chaos seam: a ``stall`` rule armed IN THIS PROCESS delays the
+        server's processing of a fresh (non-replayed) request — the
+        overloaded-coord model the client's timeout-retry path is
+        regression-tested against.  Real multi-process jobs arm chaos in
+        the ranks, never in the launcher, so this is inert there; only
+        an in-process chaos-armed test reaches it.  Consulted AFTER the
+        replay-cache claim: an adopted retry must not burn a firing."""
+        from ompi_tpu.ft import chaos
+
+        if not chaos.enabled:
+            return
+        rule = chaos.coord_stall("server:" + str(req.get("op")))
+        if rule is not None:
+            chaos.sleep_ms(rule)
 
     def _handle(self, req: dict, conn: socket.socket) -> dict:
         """Process one request; returns the response frame.  Replies are
@@ -611,9 +628,6 @@ class CoordClient:
                 return _recv_frame(self._sock)
             except TimeoutError:
                 if not dialing:
-                    # the server is reachable but the op never finished
-                    # within otpu_coord_rpc_timeout: loud, not retried
-                    # (retrying a stuck fence would just wait again).
                     # The socket is CLOSED first — the server's handler
                     # may still be blocked inside the op, and a later
                     # RPC on this client must not queue behind it (or
@@ -624,13 +638,25 @@ class CoordClient:
                     except OSError:
                         pass
                     self._sock = None
-                    show_help("help-coord", "rpc-timeout",
-                              rank=self._rank_label, op=op,
-                              seconds=self._rpc_timeout)
-                    raise RuntimeError(
-                        f"coordination RPC {op!r} timed out after "
-                        f"{self._rpc_timeout:g}s at rank "
-                        f"{self._rank_label} (otpu_coord_rpc_timeout)")
+                    # a fence that never finished is a PEER problem
+                    # (someone this fence waits on is hung without
+                    # having died): loud, never retried — retrying a
+                    # stuck fence would just wait again.  Any OTHER op
+                    # is server-side-instantaneous, so expiry means the
+                    # coord was too LOADED to answer in time (the
+                    # fleet-soak shrink-path flake): retry within
+                    # otpu_coord_retry_max — the replay cache keeps the
+                    # retry exactly-once (a completed original replays,
+                    # an in-flight one is adopted and its result
+                    # awaited) — and only an exhausted ladder is loud
+                    if op == "fence" or attempts >= self._retry_max:
+                        show_help("help-coord", "rpc-timeout",
+                                  rank=self._rank_label, op=op,
+                                  seconds=self._rpc_timeout)
+                        raise RuntimeError(
+                            f"coordination RPC {op!r} timed out after "
+                            f"{self._rpc_timeout:g}s at rank "
+                            f"{self._rank_label} (otpu_coord_rpc_timeout)")
                 self._retry_or_raise(op, attempts)
                 attempts += 1
             except (ConnectionError, OSError):

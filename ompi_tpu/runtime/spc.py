@@ -66,6 +66,12 @@ _COUNTERS = (
     # the otpu_serving_slo_p99_ms target vs breaching it — both inert
     # while no SLO target is set
     "slo_goodput", "slo_breaches",
+    # MoE expert parallelism (parallel/moe): tokens entering the ragged
+    # dispatch, tokens dropped by the capacity policy, and the
+    # high-water per-step load-imbalance factor in milli-units
+    # (max-expert-load / mean-load * 1000 — a gauge kept as a
+    # monotonic high-water so the counter plane stays append-only)
+    "moe_dispatch_tokens", "moe_dropped_tokens", "moe_imbalance_max",
 )
 
 _pvars = {}

@@ -77,6 +77,9 @@ SCHEMA = {
            "otpu_serving_slo_p99_ms: goodput (within-SLO completions "
            "per second), breach counts, and error-budget burn rate "
            "(this module's SloAccountant; otpu-req)",
+    "moe": "MoE expert-parallel layer: per-step dispatch/dropped token "
+           "totals, expert count and capacity, and the latest per-step "
+           "load-imbalance factor (parallel/moe.py)",
 }
 
 #: keys the sampler itself produces; component sources may only claim

@@ -107,7 +107,7 @@ class PoolSpec:
     def __init__(self, name: str, workers, prefill=None, decode=None,
                  max_batch: int = 8, max_batch_tokens: int = 1 << 14,
                  slots: Optional[int] = None, decode_chunk: int = 4,
-                 kv_elems: int = 256) -> None:
+                 kv_elems: int = 256, experts: int = 0) -> None:
         self.name = str(name)
         self.workers = [int(w) for w in workers]
         if not self.workers:
@@ -124,6 +124,10 @@ class PoolSpec:
         self.slots = slots
         self.decode_chunk = int(decode_chunk)
         self.kv_elems = int(kv_elems)
+        #: > 0: an expert-sharded MoE decode pool — each decode worker
+        #: homes a contiguous expert range (parallel/moe sharding) and
+        #: the router prefers a request's expert home on prefix miss
+        self.experts = int(experts)
 
 
 def pool_specs_from_psets(comm) -> list:
@@ -207,6 +211,7 @@ class FleetController:
                 comm, scheduler=sched, workers=spec.workers,
                 prefill_ranks=spec.prefill, decode_ranks=spec.decode,
                 prefix_registry=reg, pool=spec.name,
+                experts=spec.experts,
                 manage_recovery=False, decode_chunk=spec.decode_chunk,
                 kv_elems=spec.kv_elems)
             with self._lock:
@@ -508,6 +513,12 @@ class FleetController:
                 entry["tenants"] = st["tenants"]
             if router.registry is not None:
                 entry["prefix"] = router.registry.stats()
+            if router.experts:
+                # expert placement snapshot: {expert: home worker} —
+                # recomputed from the live table, so a shrink shows
+                # the re-shard here immediately
+                entry["experts"] = {str(e): w for e, w in
+                                    router.expert_table().items()}
             pools[name] = entry
         # otpu-req SLO plane: fold each pool's worst-tenant burn rate
         # into its entry (the controller rank runs every router, so

@@ -72,6 +72,7 @@ class Router:
                  decode_ranks: Optional[list] = None,
                  prefix_registry=None,
                  pool: Optional[str] = None,
+                 experts: int = 0,
                  manage_recovery: bool = True,
                  scale_watermark: Optional[int] = None,
                  scale_step: int = 1, scale_patience: int = 3,
@@ -94,6 +95,10 @@ class Router:
         self.kv_elems = int(kv_elems)
         self.pool = pool
         self.registry = prefix_registry
+        #: expert-sharded decode pool (parallel/moe serving): > 0 means
+        #: the pool's decode workers each HOME a contiguous expert
+        #: range and fresh admissions prefer their expert's home rank
+        self.experts = int(experts)
         self.manage_recovery = bool(manage_recovery)
         # explicit stage pools (fleet: sized independently); None means
         # the legacy half-split of the worker list
@@ -171,6 +176,39 @@ class Router:
                 load[r.worker] += 1
         return min(decode_ranks, key=lambda w: (load[w], w))
 
+    # -- expert-sharded decode pool (parallel/moe serving) -----------------
+    def expert_of(self, req) -> int:
+        """Deterministic expert for a request: a rolling integer hash
+        of the prompt tokens (the request's content decides its hot
+        expert, mirroring MoE gating), rid-based when there is no
+        prompt.  Pure modular arithmetic — PYTHONHASHSEED-proof, the
+        parallel/moe gating discipline."""
+        toks = req.prompt or []
+        acc = len(toks)
+        for t in toks:
+            acc = (acc * 8191 + int(t)) % (1 << 30)
+        if not toks:
+            acc = int(req.rid or 0)
+        return acc % self.experts
+
+    def expert_table(self) -> dict:
+        """{expert: home worker rank} over the CURRENT decode ranks —
+        contiguous ``partition`` slices, the same one-notion-of-
+        sharding the MoE trainer uses, so re-binding after a shrink
+        re-shards the experts over the survivors automatically."""
+        from ompi_tpu.parallel.elastic import partition
+
+        _pre, dec, extra = self._stage_split()
+        homes = dec + extra
+        table = {}
+        if not self.experts or not homes:
+            return table
+        for i, w in enumerate(homes):
+            lo, hi = partition(i, len(homes), self.experts)
+            for e in range(lo, hi):
+                table[e] = w
+        return table
+
     def _assign(self, req, decode_ranks, extra_ranks,
                 prefill_ranks) -> None:
         """Pick the worker for a fresh admission — prefix-cache-aware
@@ -178,7 +216,10 @@ class Router:
         tokens: the deepest registered block's holder wins (for a
         stage pool, the decode rank mapped onto the holding PREFILL
         rank), with the ``(hash, generation)`` hint attached for the
-        worker to verify; everything else, least-loaded."""
+        worker to verify; then the request's EXPERT home rank when the
+        pool is expert-sharded (cached KV beats expert-weight affinity
+        — a hit skips the prefill outright, re-routing an expert costs
+        only locality); everything else, least-loaded."""
         candidates = decode_ranks + extra_ranks
         if self.registry is not None and req.prompt:
             if req.hashes is None:
@@ -205,6 +246,11 @@ class Router:
                 # between insert and lookup): drop the stale entries
                 self.registry.invalidate_worker(hit.worker)
             spc.record("serve_prefix_misses")
+        if self.experts:
+            home = self.expert_table().get(self.expert_of(req))
+            if home in candidates:
+                req.worker = home
+                return
         req.worker = self._pick_worker(candidates)
 
     # -- public API --------------------------------------------------------

@@ -123,6 +123,13 @@ def main(argv=None) -> int:
                          "codec), the accuracy-budget comm info key, "
                          "the quant stage clocks, and the quant SPC "
                          "counters — all registry-enumerated")
+    ap.add_argument("--moe", action="store_true",
+                    help="Show the parallel/moe plane: the expert-"
+                         "parallel MCA vars (gating top-k, capacity "
+                         "factor, drop policy, designed-imbalance "
+                         "knobs), the moe telemetry key, and the "
+                         "moe_* SPC counters — all registry-"
+                         "enumerated")
     ap.add_argument("--serving", action="store_true",
                     help="Show the serving-fleet plane: the "
                          "registry-enumerated serving MCA vars (prefix "
@@ -272,6 +279,26 @@ def main(argv=None) -> int:
         for cname in _qspc._COUNTERS:
             if cname.startswith("quant_"):
                 out.append(_fmt(f"quant counter {cname}",
+                                "SPC counter (see --pvars for values)",
+                                p))
+
+    if args.all or args.moe:
+        # registry-enumerated like --quant/--serving: importing the
+        # subsystem registers the 'moe' var group; the telemetry key
+        # and the moe_* SPC counters come from their declared tables,
+        # never a hand-kept list
+        import ompi_tpu.parallel.moe  # noqa: F401  (registers moe vars)
+        from ompi_tpu.runtime import spc as _mspc
+        from ompi_tpu.runtime import telemetry as _mtelemetry
+
+        for var in registry.all_vars("moe"):
+            out.append(_fmt(f"moe var {var.name}",
+                            f"{var.value!r} — {var.help}", p))
+        out.append(_fmt("moe telemetry key moe",
+                        _mtelemetry.SCHEMA["moe"], p))
+        for cname in _mspc._COUNTERS:
+            if cname.startswith("moe_"):
+                out.append(_fmt(f"moe counter {cname}",
                                 "SPC counter (see --pvars for values)",
                                 p))
 

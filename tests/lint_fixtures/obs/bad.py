@@ -55,3 +55,11 @@ def rpublish(telemetry):
 
 def rlinked():
     trace.flow_start("serve_reqz", "9.1")     # BAD: no such category
+
+
+def mcount():
+    spc.record("moe_dispatch_tokenz")         # BAD: not in _COUNTERS
+
+
+def mpublish(telemetry):
+    telemetry.register_source("moe_extra", dict)  # BAD: not a SCHEMA key

@@ -15,6 +15,7 @@ def count():
     spc.record("quant_encodes")               # declared in _COUNTERS
     spc.record("req_traced")                  # declared in _COUNTERS
     spc.record("slo_breaches")                # declared in _COUNTERS
+    spc.record("moe_dispatch_tokens")         # declared in _COUNTERS
     spc.record(_dynamic_name())               # non-literal: out of scope
 
 
@@ -43,6 +44,7 @@ def publish(telemetry):
     telemetry.register_source("tcp", dict)    # declared in SCHEMA
     telemetry.register_source("fleet", dict)  # the fleet control plane
     telemetry.register_source("slo", dict)    # the otpu-req SLO plane
+    telemetry.register_source("moe", dict)    # the expert-parallel plane
 
 
 def crash(flight):

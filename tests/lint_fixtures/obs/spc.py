@@ -2,5 +2,5 @@
 
 _COUNTERS = (
     "send", "recv", "fast_frames", "quant_encodes",
-    "req_traced", "slo_breaches",
+    "req_traced", "slo_breaches", "moe_dispatch_tokens",
 )

@@ -6,4 +6,5 @@ SCHEMA = {
     "serving": "scheduler queue depth",
     "fleet": "serving-fleet pool/prefix/autoscale tables",
     "slo": "per-pool/per-tenant SLO burn accounting",
+    "moe": "MoE dispatch/dropped-token and load-imbalance tables",
 }

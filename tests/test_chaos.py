@@ -267,6 +267,43 @@ def test_coord_rpc_timeout_is_loud_and_client_stays_usable():
         srv.close()
 
 
+def test_coord_timeout_on_non_fence_op_retries_exactly_once():
+    """The fleet-soak shrink-path flake: a recovery-path coord RPC
+    (pset/KV traffic, NOT a fence) that expires because the coord was
+    too loaded to answer in time must retry within otpu_coord_retry_max
+    instead of surfacing as a survivor exception — and the replay cache
+    must keep the retried op exactly-once.  Induced via the chaos coord
+    hooks: the server consults the same ``stall`` rules, so firing 2
+    stalls the server past a shrunken otpu_coord_rpc_timeout while the
+    op is in flight (firing 1 is consumed by the harmless client-side
+    pre-send hook)."""
+    from ompi_tpu.base.var import registry
+    from ompi_tpu.rte.coord import CoordClient
+    from ompi_tpu.runtime import spc
+
+    srv = _server()
+    var = registry.lookup("otpu_coord_rpc_timeout")
+    old = var.value
+    var.set(0.5)
+    try:
+        spc.init()
+        before = spc.read("coord_rpc_retries")
+        c = CoordClient(retries=4)
+        chaos.install_spec("stall:p=1,ms=900,n=2", rank=0)
+        # firing 1: client pre-send (a delay, harmless); firing 2: the
+        # SERVER stalls past the timeout -> client times out mid-RPC,
+        # retries, and the retry is adopted against the in-flight
+        # original (exactly-once: the counter advances by 1 total)
+        assert c.fetch_add(-1, "ctr", 1) == 0
+        assert spc.read("coord_rpc_retries") >= before + 1
+        chaos.uninstall()
+        assert c.fetch_add(-1, "ctr", 1) == 1    # applied exactly once
+        c.close()
+    finally:
+        var.set(old)
+        srv.close()
+
+
 def test_coord_malformed_request_is_loud_not_stuck():
     """A request whose server-side handling raises (malformed /
     version-skewed frame) must come back as a loud error response, not

@@ -98,8 +98,10 @@ def test_observability_contracts():
                    FIXTURES / "obs" / "telemetry.py",
                    FIXTURES / "obs" / "profile.py",
                    FIXTURES / "obs" / "trace.py")
-    assert len(bad) == 13, bad
+    assert len(bad) == 15, bad
     msgs = " | ".join(f.message for f in bad)
+    assert "moe_dispatch_tokenz" in msgs      # the moe counter twin
+    assert "moe_extra" in msgs                # the moe SCHEMA-key twin
     assert "no matching register_help" in msgs
     assert "not declared in runtime/spc.py" in msgs
     assert "quant_encodez" in msgs            # the quant counter twin
