@@ -827,6 +827,197 @@ def fleet_rows() -> list:
     return rows
 
 
+_SPEC = """
+import json, sys, time
+import ompi_tpu
+from ompi_tpu.serving import Router, ShardWorker
+
+k = int(sys.argv[1])
+w = ompi_tpu.init()
+if w.rank == 0:
+    r = Router(w, workers=[1, 2], decode_chunk=8)
+    # closed-loop saturation: every request is in the queue before the
+    # first tick, so tokens/sec measures the decode engine, not the
+    # arrival process (the open-loop Poisson rows are arrival-limited
+    # and would read a multiplier of ~1.0 no matter what decode does)
+    for i in range(16):
+        r.submit(8, 32, rid=2000 + i, tenant="bench")
+    t0 = time.perf_counter()
+    done = r.serve_until_drained(max_ticks=200000)
+    dt = time.perf_counter() - t0
+    toks = sum(len(q.tokens) for q in done)
+    assert len(done) == 16, len(done)
+    r.shutdown()
+    print("SPEC " + json.dumps(
+        {"k": k, "tokens": toks, "elapsed_s": round(dt, 4),
+         "tokens_per_s": round(toks / dt, 1)}), flush=True)
+else:
+    ShardWorker(w, router=0, spec_k=k).serve()
+ompi_tpu.finalize()
+"""
+
+_OVERLOAD = """
+import json
+import ompi_tpu
+from ompi_tpu.base.var import registry
+from ompi_tpu.serving import (FleetController, MixedPoissonDriver,
+                              ShardWorker)
+
+w = ompi_tpu.init()
+if w.rank == 0:
+    registry.set("otpu_serving_slo_p99_ms", 800.0)
+    fleet = FleetController(
+        w, tenants={"int": 2, "bat": 1},
+        autoscale=dict(poll_ticks=10**9, idle_patience=10**9),
+        frontdoor=dict(queue_cap=6, backlog=3, retry_s=0.01,
+                       hold_ticks=20, window=16))
+    drv = MixedPoissonDriver({
+        "int": dict(model="m_a", rate_rps=150, n_requests=28,
+                    prompt_lens=(4, 8), decode_lens=(2, 4),
+                    slo="interactive"),
+        "bat": dict(model="m_a", rate_rps=400, n_requests=36,
+                    prompt_lens=(4, 8), decode_lens=(6, 12),
+                    slo="batch"),
+    }, seed=13)
+    rep = drv.run(fleet, max_wall_s=180, check_invariants=True)
+    st = fleet.frontdoor.stats()
+    fleet.shutdown()
+    cls = rep["slo_classes"]
+    print("OVERLOAD " + json.dumps(
+        {"requests": rep["requests"], "elapsed_s": rep["elapsed_s"],
+         "shed": rep["shed"], "retried": rep["retried"],
+         "preempts": st["preempts"], "classes": cls}), flush=True)
+else:
+    ShardWorker(w, router=0).serve()
+ompi_tpu.finalize()
+"""
+
+
+def frontdoor_rows() -> list:
+    """``bench.py --serving``'s front-door half (ROADMAP item 5):
+
+    * ``serving_spec_k{0,4}``: the speculative-decoding A/B — the SAME
+      closed-loop saturated workload on the SAME 2 chips, plain decode
+      vs draft-propose/target-verify, plus the derived
+      ``serving_spec_multiplier`` row (tokens/sec ratio; the pin says
+      it must stay > 1 or speculation is a loss);
+    * ``serving_overload_{interactive,batch}``: the sustained-overload
+      contract — MixedPoissonDriver above pool capacity through the
+      armed door, per-class exact p99 and the shed/retry ledger.
+
+    Every row carries ``fd: True`` so the Poisson table renderer can
+    route it to the front-door subsection."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    rows = []
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_SPEC)
+        script = f.name
+    reps = {}
+    try:
+        for k in (0, 4):
+            proc = subprocess.run(
+                [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+                 "-n", "3", sys.executable, script, str(k)],
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if "SPEC " in ln), None)
+            if proc.returncode or line is None:
+                print(f"spec bench (k={k}) failed "
+                      f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}",
+                      file=sys.stderr)
+                rows.append({"coll": f"serving_spec_k{k}", "fd": True,
+                             "ok": False})
+                continue
+            rep = _json.loads(line.split("SPEC ", 1)[1])
+            reps[k] = rep
+            rows.append({"coll": f"serving_spec_k{k}", "fd": True,
+                         "nbytes": rep["tokens"],
+                         "tokens_per_s": rep["tokens_per_s"],
+                         "elapsed_s": rep["elapsed_s"]})
+    finally:
+        os.unlink(script)
+    if 0 in reps and 4 in reps:
+        mult = reps[4]["tokens_per_s"] / reps[0]["tokens_per_s"]
+        rows.append({"coll": "serving_spec_multiplier", "fd": True,
+                     "nbytes": reps[4]["tokens"],
+                     "multiplier": round(mult, 2)})
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_OVERLOAD)
+        script = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-n", "3",
+             "--pool", "m_a:1,2", sys.executable, script],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if "OVERLOAD " in ln), None)
+        if proc.returncode or line is None:
+            print(f"overload bench failed (rc={proc.returncode}):\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            rows.append({"coll": "serving_overload", "fd": True,
+                         "ok": False})
+            return rows
+        rep = _json.loads(line.split("OVERLOAD ", 1)[1])
+        total = rep["requests"] + rep["shed"]
+        for cls in ("interactive", "batch"):
+            c = rep["classes"].get(cls)
+            if c is None:
+                continue
+            rows.append({
+                "coll": f"serving_overload_{cls}", "fd": True,
+                "nbytes": c["requests"],
+                "p50_ms": c["p50_ms"],
+                "p99_exact_ms": c["p99_exact_ms"],
+                "shed": c["shed"], "retried": c["retried"],
+                "shed_rate": round(rep["shed"] / total, 4),
+                "preempts": rep["preempts"],
+            })
+    finally:
+        os.unlink(script)
+    return rows
+
+
+def _frontdoor_md_lines(fd_rows) -> list:
+    lines = ["", "### Front door (overload shedding + speculative "
+             "decode)", "",
+             "`serving_spec_k*` is the closed-loop saturation A/B at "
+             "matched chips (router + 2 workers, 16 requests queued "
+             "up-front): plain decode pays one target pass per token, "
+             "speculative decode verifies a k-token draft window per "
+             "target pass — `serving_spec_multiplier` is the "
+             "tokens/sec ratio and must stay > 1. "
+             "`serving_overload_*` rows drive Poisson arrivals above "
+             "pool capacity through the armed front door "
+             "(`otpu_serving_slo_p99_ms` 800): per-SLO-class exact "
+             "p99, requests shed at the door (each re-arrived after "
+             "its retry-after), and batch preemptions.", "",
+             "| row | n | tokens/s | mult | p50 ms | p99 exact ms | "
+             "shed | retried | shed rate | preempts |",
+             "|---|---|---|---|---|---|---|---|---|---|"]
+
+    def _c(r, key, fmt="{}"):
+        v = r.get(key)
+        return fmt.format(v) if v is not None else "-"
+
+    for r in fd_rows:
+        if not r.get("ok", True):
+            lines.append(f"| {r['coll']} | FAILED | - | - | - | - | - "
+                         "| - | - | - |")
+            continue
+        lines.append(
+            f"| {r['coll']} | {r.get('nbytes', '-')} | "
+            f"{_c(r, 'tokens_per_s')} | {_c(r, 'multiplier')} | "
+            f"{_c(r, 'p50_ms')} | {_c(r, 'p99_exact_ms')} | "
+            f"{_c(r, 'shed')} | {_c(r, 'retried')} | "
+            f"{_c(r, 'shed_rate')} | {_c(r, 'preempts')} |")
+    return lines
+
+
 def _req_stage_medians(trace_dir: str) -> dict:
     """Per-request stage medians from the per-rank traces a
     request-armed (``otpu_trace_requests``) serving run exported —
@@ -862,6 +1053,11 @@ def _stage_cell(r: dict) -> str:
 
 
 def _serving_md_section(rows) -> list:
+    # front-door rows (speculative A/B, overload contract) carry a
+    # different column set — route them to their own subsection instead
+    # of KeyError-ing on p50_ms/p99_ms below
+    fd_rows = [r for r in rows if r.get("fd")]
+    rows = [r for r in rows if not r.get("fd")]
     lines = ["", "## Serving (Poisson open-loop, router + 2 workers)",
              "",
              "Request latency percentiles come from the otpu-trace "
@@ -891,6 +1087,8 @@ def _serving_md_section(rows) -> list:
             f"{r['p99_ms']} | {r['p99_exact_ms']} | "
             f"{r['tokens_per_s']} | {r['req_per_s']} | {pfx_s} | "
             f"{_stage_cell(r)} |")
+    if fd_rows:
+        lines += _frontdoor_md_lines(fd_rows)
     return lines
 
 
@@ -917,7 +1115,7 @@ def refresh_serving_tables() -> list:
     the committed sweep tables (replacing any previous serving rows) —
     the device/host rows are left untouched."""
     here = os.path.dirname(os.path.abspath(__file__))
-    rows = serving_rows() + fleet_rows()
+    rows = serving_rows() + fleet_rows() + frontdoor_rows()
     # stage medians double as BENCH_HISTORY points so otpu_perf --diff
     # guards the per-stage numbers run over run (bench-kind rows need a
     # positive lat_us; zero-width stages just don't emit a point)
@@ -931,6 +1129,20 @@ def refresh_serving_tables() -> list:
                     "key": f"serving_stage/{r['coll']}/{s}",
                     "lat_us": round(1000.0 * v, 1),
                     "k": int(r.get("req_decomposed", 0))}
+        # front-door points: us-per-token for the spec A/B legs (so the
+        # rolling-min gate catches a decode-throughput regression) and
+        # the overload interactive exact p99
+        if r.get("fd") and r.get("tokens_per_s", 0) > 0:
+            key = f"serving_spec/us_per_token/{r['coll']}"
+            hist[key] = {"key": key,
+                         "lat_us": round(1e6 / r["tokens_per_s"], 1),
+                         "k": int(r.get("nbytes", 0))}
+        if (r.get("coll") == "serving_overload_interactive"
+                and r.get("p99_exact_ms", 0) > 0):
+            key = "serving_overload/interactive_p99"
+            hist[key] = {"key": key,
+                         "lat_us": round(1000.0 * r["p99_exact_ms"], 1),
+                         "k": int(r.get("nbytes", 0))}
     if hist:
         append_history(sorted(hist.values(), key=lambda h: h["key"]),
                        "bench", "host_serving")

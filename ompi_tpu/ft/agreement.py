@@ -27,6 +27,7 @@ assumption ULFM's detector makes.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Iterable, Optional
 
@@ -60,6 +61,18 @@ def _traced_agree(fn):
 
 def _key(instance: tuple, kind: str) -> str:
     return f"ftagree:{kind}:" + ":".join(str(x) for x in instance)
+
+
+def _recovery_scope(client):
+    """The coord client's recovery budget
+    (``CoordClient.recovery_scope``): agreement rounds ARE the
+    recovery path — right after a failure every survivor hits the
+    coordination server at once, and the steady-state retry ladder
+    was measured too short for that burst (the fleet-soak
+    coord-timeout flake).  Clients without the scope (tests' fakes)
+    get a null context."""
+    scope = getattr(client, "recovery_scope", None)
+    return scope() if scope is not None else contextlib.nullcontext()
 
 
 def _setup_instance(rte, instance: tuple, contribution: Any,
@@ -115,31 +128,36 @@ def agree_kv(
     client = _setup_instance(rte, instance, contribution, prev_instance)
     deadline = time.monotonic() + timeout
 
-    while True:
-        # the decision slot is global (rank namespace -1) and written with
-        # an atomic first-writer-wins put, so one value wins uniformly no
-        # matter how many coordinators race for it
-        got = client.get(-1, dkey, wait=False)
-        if got is not None:
-            return got
-        # am I the lowest live participant? then gather, decide, race
-        live = [r for r in participants if not ft_state.is_failed(r)]
-        if not live:
-            raise AgreementError(f"agreement {instance}: no live participants")
-        if live[0] == me:
-            decision = _decide(rte, instance, participants, combine,
-                               deadline, poll)
-            return client.put_new(-1, dkey, decision)
-        if time.monotonic() > deadline:
-            raise AgreementError(f"agreement {instance} timed out at rank {me}")
-        # park on the decision slot with ONE server-side waiting get
-        # instead of busy-polling (O(n^2) RPC load across the job otherwise)
-        try:
-            got = client.get(-1, dkey, wait=True, timeout=0.5)
-        except Exception:
-            got = None
-        if got is not None:
-            return got
+    with _recovery_scope(client):
+        while True:
+            # the decision slot is global (rank namespace -1) and written
+            # with an atomic first-writer-wins put, so one value wins
+            # uniformly no matter how many coordinators race for it
+            got = client.get(-1, dkey, wait=False)
+            if got is not None:
+                return got
+            # am I the lowest live participant? then gather, decide, race
+            live = [r for r in participants
+                    if not ft_state.is_failed(r)]
+            if not live:
+                raise AgreementError(
+                    f"agreement {instance}: no live participants")
+            if live[0] == me:
+                decision = _decide(rte, instance, participants, combine,
+                                   deadline, poll)
+                return client.put_new(-1, dkey, decision)
+            if time.monotonic() > deadline:
+                raise AgreementError(
+                    f"agreement {instance} timed out at rank {me}")
+            # park on the decision slot with ONE server-side waiting get
+            # instead of busy-polling (O(n^2) RPC load across the job
+            # otherwise)
+            try:
+                got = client.get(-1, dkey, wait=True, timeout=0.5)
+            except Exception:
+                got = None
+            if got is not None:
+                return got
 
 
 @_traced_agree

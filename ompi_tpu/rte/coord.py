@@ -22,6 +22,7 @@ with a loud ``show_help`` naming the rank, the op, and how long it waited
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import random
@@ -65,6 +66,20 @@ _backoff_var = registry.register(
     "coord", None, "retry_backoff", vtype=VarType.FLOAT, default=0.05,
     help="Base of the reconnect exponential backoff in seconds "
          "(doubled per attempt, jittered, capped at 2s)")
+_recovery_retry_max_var = registry.register(
+    "coord", None, "recovery_retry_max", vtype=VarType.INT, default=24,
+    help="Reconnect-and-retry budget for RPCs issued inside a recovery "
+         "scope (ULFM shrink / agreement rounds): every survivor slams "
+         "the coordination server at once right after a failure, so "
+         "recovery RPCs get a longer ladder than the steady-state "
+         "otpu_coord_retry_max instead of flaking the whole shrink.  "
+         "0 inherits otpu_coord_retry_max")
+_recovery_rpc_timeout_var = registry.register(
+    "coord", None, "recovery_rpc_timeout", vtype=VarType.FLOAT,
+    default=0.0,
+    help="Socket-level ceiling on one coordination RPC while inside a "
+         "recovery scope; 0 (the default) inherits "
+         "otpu_coord_rpc_timeout")
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -549,10 +564,15 @@ class CoordClient:
                            else int(_retry_max_var.value or 0))
         self._backoff = float(_backoff_var.value or 0.05)
         self._rank_label = os.environ.get("OTPU_RANK", "?")
+        #: >0 while inside recovery_scope(): RPCs take the recovery
+        #: retry/timeout budget instead of the steady-state one (plain
+        #: int under the GIL; scopes nest)
+        self._recovery_depth = 0
         self._jitter = random.Random(f"coord-jitter:{self._rank_label}")
         self._cid = uuid.uuid4().hex      # idempotent-retry identity
         self._rid = 0
         self._closed = False
+        self._applied_rto = self._rpc_timeout
         self._sock: Optional[socket.socket] = self._dial()
         self._lock = threading.Lock()
         self._event_since = 0
@@ -588,6 +608,39 @@ class CoordClient:
         rows (the flight recorder's coord-activity tail)."""
         return [list(e) for e in self._recent]
 
+    @contextlib.contextmanager
+    def recovery_scope(self):
+        """RPCs issued inside take the recovery budget
+        (``otpu_coord_recovery_retry_max`` /
+        ``otpu_coord_recovery_rpc_timeout``) instead of the
+        steady-state one.  The recovery paths (shrink agreement
+        rounds) wrap their coord traffic in this: right after a
+        failure every survivor hits the server at once, and the
+        steady-state ladder was measured too short for that burst
+        (the documented fleet-soak coord-timeout flake).  Scopes
+        nest; the budget reverts when the outermost exits."""
+        self._recovery_depth += 1
+        try:
+            yield self
+        finally:
+            self._recovery_depth -= 1
+
+    def _effective_retry_max(self) -> int:
+        if self._recovery_depth > 0:
+            rec = int(_recovery_retry_max_var.value or 0)
+            if rec > 0:
+                # never SHORTER than steady state: a caller that tuned
+                # retry_max up keeps at least that much in recovery
+                return max(rec, self._retry_max)
+        return self._retry_max
+
+    def _effective_rpc_timeout(self) -> float:
+        if self._recovery_depth > 0:
+            rto = float(_recovery_rpc_timeout_var.value or 0.0)
+            if rto > 0.0:
+                return rto
+        return self._rpc_timeout
+
     def _rpc_locked(self, req: dict) -> dict:
         """One idempotent RPC round: send → (maybe injected fault) →
         recv; connection errors reconnect with exponential backoff +
@@ -611,6 +664,13 @@ class CoordClient:
                     # past here a timeout is an RPC timeout again: the
                     # dial succeeded, the server is reachable
                     dialing = False
+                    self._applied_rto = self._rpc_timeout
+                rto = self._effective_rpc_timeout()
+                if rto != self._applied_rto:
+                    # recovery scope widens the per-RPC ceiling (and the
+                    # first post-recovery RPC narrows it back)
+                    self._sock.settimeout(rto)
+                    self._applied_rto = rto
                 if chaos.enabled:
                     rule = chaos.coord_stall(op)
                     if rule is not None:
@@ -649,13 +709,14 @@ class CoordClient:
                     # retry exactly-once (a completed original replays,
                     # an in-flight one is adopted and its result
                     # awaited) — and only an exhausted ladder is loud
-                    if op == "fence" or attempts >= self._retry_max:
+                    if op == "fence" \
+                            or attempts >= self._effective_retry_max():
                         show_help("help-coord", "rpc-timeout",
                                   rank=self._rank_label, op=op,
-                                  seconds=self._rpc_timeout)
+                                  seconds=self._applied_rto)
                         raise RuntimeError(
                             f"coordination RPC {op!r} timed out after "
-                            f"{self._rpc_timeout:g}s at rank "
+                            f"{self._applied_rto:g}s at rank "
                             f"{self._rank_label} (otpu_coord_rpc_timeout)")
                 self._retry_or_raise(op, attempts)
                 attempts += 1
@@ -666,7 +727,8 @@ class CoordClient:
     def _retry_or_raise(self, op: str, attempts: int) -> None:
         """Connection-error path: close, back off (exponential +
         deterministic jitter), let the caller retry — or fail loudly
-        once the ladder (otpu_coord_retry_max) is exhausted."""
+        once the ladder (otpu_coord_retry_max, or the recovery-scope
+        budget otpu_coord_recovery_retry_max) is exhausted."""
         from ompi_tpu.base.output import show_help
         from ompi_tpu.runtime import spc
 
@@ -676,8 +738,9 @@ class CoordClient:
         except OSError:
             pass
         self._sock = None
-        if self._closed or attempts >= self._retry_max:
-            if self._retry_max > 0 and not self._closed:
+        budget = self._effective_retry_max()
+        if self._closed or attempts >= budget:
+            if budget > 0 and not self._closed:
                 # only the self-healing path announces exhaustion;
                 # retries=0 components (detector, poller, finalize
                 # fence) opted out and handle the error themselves

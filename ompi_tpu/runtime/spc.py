@@ -72,6 +72,15 @@ _COUNTERS = (
     # (max-expert-load / mean-load * 1000 — a gauge kept as a
     # monotonic high-water so the counter plane stays append-only)
     "moe_dispatch_tokens", "moe_dropped_tokens", "moe_imbalance_max",
+    # serving front door (serving/frontdoor) + speculative decode
+    # (serving/worker): requests shed at admission with a retry-after,
+    # batch-class decodes preempted back into the queue on an
+    # interactive-p99 breach, and draft-model tokens the target model
+    # accepted vs rejected in the batched verify step — all EXACTLY
+    # flat while the front door / spec_k are off (identity pins in
+    # test_perf_guard and test_frontdoor)
+    "serve_shed", "serve_preempt", "serve_spec_accepts",
+    "serve_spec_rejects",
 )
 
 _pvars = {}

@@ -80,6 +80,10 @@ SCHEMA = {
     "moe": "MoE expert-parallel layer: per-step dispatch/dropped token "
            "totals, expert count and capacity, and the latest per-step "
            "load-imbalance factor (parallel/moe.py)",
+    "frontdoor": "serving admission plane: per-class queue depths and "
+                 "caps, per-tenant token-bucket levels, shed/preempt "
+                 "totals with the last retry-after hint, and the "
+                 "interactive-p99 ladder state (serving/frontdoor.py)",
 }
 
 #: keys the sampler itself produces; component sources may only claim

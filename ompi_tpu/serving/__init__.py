@@ -91,8 +91,13 @@ from ompi_tpu.serving.kv_stream import (KvSlabReceiver,  # noqa: E402
                                         KvSlabSender)
 from ompi_tpu.serving.prefix_cache import (PrefixRegistry,  # noqa: E402
                                            PrefixStore, block_hashes)
+from ompi_tpu.serving.frontdoor import (Decision, FrontDoor,  # noqa: E402
+                                        SLO_BATCH, SLO_INTERACTIVE,
+                                        TokenBucket)
 from ompi_tpu.serving.router import Router  # noqa: E402
-from ompi_tpu.serving.worker import ShardWorker, worker_main  # noqa: E402
+from ompi_tpu.serving.worker import (ShardWorker,  # noqa: E402
+                                     toy_draft_token, toy_token,
+                                     worker_main)
 from ompi_tpu.serving.fleet import (FleetAutoscaler,  # noqa: E402
                                     FleetController, PoolSpec,
                                     PSET_POOL_PREFIX,
@@ -106,6 +111,9 @@ __all__ = [
     "KvSlabSender", "KvSlabReceiver",
     "PrefixRegistry", "PrefixStore", "block_hashes",
     "Router", "ShardWorker", "worker_main",
+    "toy_token", "toy_draft_token",
+    "FrontDoor", "TokenBucket", "Decision",
+    "SLO_INTERACTIVE", "SLO_BATCH",
     "FleetController", "FleetAutoscaler", "PoolSpec",
     "pool_specs_from_psets",
     "PoissonDriver", "MixedPoissonDriver",

@@ -29,6 +29,7 @@ import numpy as np
 
 from ompi_tpu.base.var import registry
 from ompi_tpu.runtime import trace
+from ompi_tpu.serving.frontdoor import SLO_INTERACTIVE
 from ompi_tpu.serving.router import POOL_HIST_PREFIX, TENANT_HIST_PREFIX
 
 
@@ -184,11 +185,16 @@ class MixedPoissonDriver:
                     plen = int(rng.integers(plens[0], plens[1] + 1))
                     events.append((float(arrivals[i]), name, model,
                                    plen, decode, None))
-            self.tenants[name] = {"model": model, "n_requests": n}
+            self.tenants[name] = {"model": model, "n_requests": n,
+                                  "slo": str(cfg.get("slo", ""))}
         events.sort(key=lambda e: e[0])
         self.events = events
         self.n_requests = len(events)
         self._next = 0
+        # shed/retry accounting per tenant — filled by run() when the
+        # fleet has a front door armed, zero otherwise
+        self._shed: dict = {}
+        self._retried: dict = {}
 
     def due(self, elapsed_s: float) -> list:
         """(tenant, model, prompt_len, decode_len, prompt-tokens)
@@ -204,12 +210,28 @@ class MixedPoissonDriver:
     def exhausted(self) -> bool:
         return self._next >= self.n_requests
 
-    def _submit(self, fleet, tenant, model, plen, dlen, prompt) -> None:
-        if hasattr(fleet, "routers"):
+    def _submit(self, fleet, tenant, model, plen, dlen,
+                prompt) -> Optional[float]:
+        """Submit one arrival.  Returns ``None`` when admitted, or the
+        front door's retry-after hint (seconds) when shed — the run
+        loop re-arrives the request after exactly that delay."""
+        cls = self.tenants[tenant].get("slo", "")
+        fd = getattr(fleet, "frontdoor", None)
+        if fd is not None:
+            used = cls or SLO_INTERACTIVE
+            self.tenants[tenant]["slo_used"] = used
+            dec = fd.submit(tenant, model, prompt_len=plen,
+                            max_new_tokens=dlen, slo=used,
+                            prompt=prompt)
+            if not dec.admitted:
+                return dec.retry_after_s
+        elif hasattr(fleet, "routers"):
             fleet.submit(tenant, model, prompt_len=plen,
-                         max_new_tokens=dlen, prompt=prompt)
+                         max_new_tokens=dlen, prompt=prompt, slo=cls)
         else:                          # a bare Router works too
-            fleet.submit(plen, dlen, tenant=tenant, prompt=prompt)
+            fleet.submit(plen, dlen, tenant=tenant, prompt=prompt,
+                         slo=cls)
+        return None
 
     @staticmethod
     def _idle(fleet) -> bool:
@@ -238,6 +260,12 @@ class MixedPoissonDriver:
         for model in models:
             trace.hist_reset(POOL_HIST_PREFIX + model)
         prefills0, hits0 = self._prefix_counts(fleet)
+        self._shed = {}
+        self._retried = {}
+        #: shed arrivals waiting out their retry-after hint:
+        #: (due_s, tenant, model, plen, dlen, prompt)
+        pending: list = []
+        fd = getattr(fleet, "frontdoor", None)
         t0 = time.perf_counter()
         try:
             while True:
@@ -247,15 +275,35 @@ class MixedPoissonDriver:
                         f"mixed driver exceeded {max_wall_s}s with "
                         f"{len(fleet.completed())}/{self.n_requests} "
                         "requests complete")
-                for tenant, model, plen, dlen, prompt in \
-                        self.due(elapsed):
-                    self._submit(fleet, tenant, model, plen, dlen,
-                                 prompt)
+                arrivals = list(self.due(elapsed))
+                if pending:
+                    # honor retry-after: a shed request re-arrives only
+                    # once its hinted delay has fully elapsed
+                    due_now = [e for e in pending if e[0] <= elapsed]
+                    if due_now:
+                        pending = [e for e in pending
+                                   if e[0] > elapsed]
+                        for e in due_now:
+                            self._retried[e[1]] = \
+                                self._retried.get(e[1], 0) + 1
+                        arrivals.extend(e[1:] for e in due_now)
+                for tenant, model, plen, dlen, prompt in arrivals:
+                    retry = self._submit(fleet, tenant, model, plen,
+                                         dlen, prompt)
+                    if retry is not None:
+                        self._shed[tenant] = \
+                            self._shed.get(tenant, 0) + 1
+                        pending.append((elapsed + retry, tenant, model,
+                                        plen, dlen, prompt))
                 fleet.tick()
                 if check_invariants and hasattr(fleet, "routers"):
                     for router in fleet.routers.values():
                         router.sched.check_invariants()
-                if self.exhausted and self._idle(fleet):
+                    if fd is not None:
+                        fd.check_invariants()
+                if (self.exhausted and not pending
+                        and (fd is None or not fd.depth())
+                        and self._idle(fleet)):
                     break
                 if tick_sleep_s:
                     time.sleep(tick_sleep_s)
@@ -297,7 +345,36 @@ class MixedPoissonDriver:
                 "p99_ms": round(
                     trace.hist_percentile(fam, 0.99) / 1000.0, 3),
                 "p99_exact_ms": round(_exact_p99(lat_ms), 3),
+                # front-door accounting (0/0 without a door): every
+                # shed eventually re-arrives, so shed <= retried at
+                # drain time and completed == n_requests
+                "shed": self._shed.get(name, 0),
+                "retried": self._retried.get(name, 0),
             }
+        # per-SLO-class rollup: latency populations from the done
+        # requests' own class stamps, shed/retried attributed through
+        # each tenant's effective submit class
+        by_cls: dict = {}
+        for r in done:
+            by_cls.setdefault(r.slo or "unclassified", []).append(r)
+        slo_classes = {}
+        for cls, reqs in sorted(by_cls.items()):
+            lat = sorted((r.done_ns - r.arrival_ns) / 1e6 for r in reqs
+                         if r.done_ns is not None)
+            slo_classes[cls] = {
+                "requests": len(reqs),
+                "tokens": sum(len(r.tokens) for r in reqs),
+                "p50_ms": round(lat[len(lat) // 2], 3) if lat else 0.0,
+                "p99_exact_ms": round(_exact_p99(lat), 3),
+                "shed": 0, "retried": 0,
+            }
+        for name, info in self.tenants.items():
+            cls = info.get("slo_used") or info.get("slo") \
+                or "unclassified"
+            if cls in slo_classes:
+                slo_classes[cls]["shed"] += self._shed.get(name, 0)
+                slo_classes[cls]["retried"] += \
+                    self._retried.get(name, 0)
         prefills_now, hits_now = self._prefix_counts(fleet)
         prefills = prefills_now - prefills0
         hits = hits_now - hits0
@@ -308,6 +385,9 @@ class MixedPoissonDriver:
             "tokens_per_s": round(tokens / elapsed_s, 1),
             "req_per_s": round(len(done) / elapsed_s, 1),
             "tenants": per_tenant,
+            "slo_classes": slo_classes,
+            "shed": sum(self._shed.values()),
+            "retried": sum(self._retried.values()),
             # the prefix-cache evidence: full prefill passes actually
             # computed vs worker-verified hits that skipped them
             "prefills": int(prefills),

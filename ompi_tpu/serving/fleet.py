@@ -172,6 +172,7 @@ class FleetController:
                  tenants: Optional[dict] = None,
                  spawn_argv: Optional[list] = None,
                  autoscale: Optional[dict] = None,
+                 frontdoor: Optional[dict] = None,
                  publish_psets: bool = True) -> None:
         comm.set_errhandler(ERRORS_RETURN)
         self.comm = comm
@@ -221,6 +222,15 @@ class FleetController:
         self._publish = bool(publish_psets)
         self._publish_pool_psets()
         self.autoscaler = FleetAutoscaler(self, **(autoscale or {}))
+        #: the admission plane is strictly opt-in (a kwargs dict, {} for
+        #: defaults): with frontdoor=None nothing here runs, no queue
+        #: objects exist, and frontdoor.enabled stays False — the
+        #: disabled-is-identity pin in test_perf_guard
+        self.frontdoor = None
+        if frontdoor is not None:
+            from ompi_tpu.serving.frontdoor import FrontDoor
+
+            self.frontdoor = FrontDoor(self.routers, **frontdoor)
         from ompi_tpu.runtime import telemetry
 
         telemetry.register_source("fleet", self.stats)
@@ -256,17 +266,21 @@ class FleetController:
 
     # -- public API --------------------------------------------------------
     def submit(self, tenant: str, model: str, prompt_len: int = 0,
-               max_new_tokens: int = 8, prompt=None, rid=None):
+               max_new_tokens: int = 8, prompt=None, rid=None,
+               slo: str = ""):
         """Admit one request for ``tenant`` against ``model``'s pool
         (fair-share queued; prompt tokens, when given, feed the
-        prefix-cache router)."""
+        prefix-cache router).  This path bypasses the front door even
+        when one is armed — callers who want admission control submit
+        via ``fleet.frontdoor.submit`` and honor its Decision."""
         router = self.routers.get(str(model))
         if router is None:
             raise MpiError(ErrorClass.ERR_ARG,
                            f"no serving pool for model {model!r} "
                            f"(pools: {sorted(self.routers)})")
         return router.submit(prompt_len or 0, max_new_tokens,
-                             rid=rid, tenant=tenant, prompt=prompt)
+                             rid=rid, tenant=tenant, prompt=prompt,
+                             slo=slo)
 
     def completed(self) -> list:
         out = []
@@ -293,6 +307,11 @@ class FleetController:
         autoscaler evaluates.  Any ULFM error anywhere routes through
         the ONE shared recovery."""
         try:
+            if self.frontdoor is not None:
+                # admission first: forwards land before this tick's
+                # admit round, and the breach ladder sees last tick's
+                # completions
+                self.frontdoor.pump()
             for router in self.routers.values():
                 router.tick()
             self.autoscaler.step()
@@ -305,12 +324,16 @@ class FleetController:
         while True:
             busy = any(r.sched.depth() or r.sched.running()
                        for r in self.routers.values())
+            if self.frontdoor is not None and self.frontdoor.depth():
+                busy = True        # door-held work still needs forwarding
             if not busy:
                 break
             self.tick()
             if check_invariants:
                 for router in self.routers.values():
                     router.sched.check_invariants()
+                if self.frontdoor is not None:
+                    self.frontdoor.check_invariants()
             ticks += 1
             if ticks >= max_ticks:
                 raise MpiError(ErrorClass.ERR_INTERN,
@@ -321,6 +344,8 @@ class FleetController:
     def shutdown(self) -> None:
         """Stop every worker this fleet can reach — pool members AND
         parked reserve ranks (they idle on the same serve loop)."""
+        if self.frontdoor is not None:
+            self.frontdoor.close()
         with self._lock:
             reserve = list(self._reserve)
         targets = set()

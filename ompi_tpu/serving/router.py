@@ -39,6 +39,7 @@ from ompi_tpu.api.errhandler import ERRORS_RETURN
 from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
                                  RevokedError)
 from ompi_tpu.runtime import spc, telemetry, trace
+from ompi_tpu.serving import frontdoor as frontdoor_mod
 from ompi_tpu.serving import prefix_cache
 from ompi_tpu.serving.scheduler import (ContinuousBatchScheduler,
                                         RequestState, ServeRequest)
@@ -256,11 +257,11 @@ class Router:
     # -- public API --------------------------------------------------------
     def submit(self, prompt_len: int, max_new_tokens: int,
                rid: Optional[int] = None, tenant: str = "",
-               prompt=None) -> ServeRequest:
+               prompt=None, slo: str = "") -> ServeRequest:
         return self.sched.submit(
             ServeRequest(prompt_len, max_new_tokens, rid=rid,
                          tenant=tenant, model=self.pool or "",
-                         prompt=prompt))
+                         prompt=prompt, slo=slo))
 
     def completed(self) -> list:
         return list(self._completed)
@@ -486,6 +487,10 @@ class Router:
         # double-read family)
         dur = (req.done_ns or trace.now()) - req.arrival_ns
         telemetry.slo_observe(self.pool or "", req.tenant, dur / 1e6)
+        if frontdoor_mod.enabled:
+            # the admission plane watches the SAME signal the SLO
+            # accountant and autoscaler read — one escalation ladder
+            frontdoor_mod.observe(self.pool or "", req.slo, dur / 1e6)
         if trace.enabled:
             # request latency (arrival -> last token) into the log2
             # histogram the percentile estimator reads; "size" is the

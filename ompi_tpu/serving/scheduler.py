@@ -77,11 +77,11 @@ class ServeRequest:
                  "state", "tokens", "slot", "worker", "prefilled",
                  "admit_ns", "done_ns", "tenant", "model", "prompt",
                  "hashes", "hint", "prefill_skipped",
-                 "dispatch_ns", "decode_ns", "last_res_ns")
+                 "dispatch_ns", "decode_ns", "last_res_ns", "slo")
 
     def __init__(self, prompt_len: int, max_new_tokens: int,
                  rid: Optional[int] = None, tenant: str = "",
-                 model: str = "", prompt=None) -> None:
+                 model: str = "", prompt=None, slo: str = "") -> None:
         if prompt is not None and not prompt_len:
             prompt_len = len(prompt)
         if prompt_len <= 0 or max_new_tokens <= 0:
@@ -103,6 +103,9 @@ class ServeRequest:
         self.model = str(model)
         self.prompt = tuple(int(t) for t in prompt) \
             if prompt is not None else None
+        # SLO class ("interactive"/"batch", frontdoor-assigned; ""
+        # means unclassified — never shed, never preempted)
+        self.slo = str(slo)
         self.hashes: Optional[tuple] = None   # router-computed digests
         self.hint: Optional[tuple] = None     # (hash, generation)
         self.prefill_skipped = False
@@ -376,6 +379,25 @@ class ContinuousBatchScheduler:
                     self._tenant_names = tuple(self._tenants)
                 q.insert(0, r)
         spc.record("serve_requeued", len(back))
+
+    def withdraw(self, slo: str) -> list:
+        """Pull every QUEUED request of one SLO class out of the tenant
+        queues, arrival-ordered — the front door's preemption path:
+        after requeueing a pool's RUNNING batch work, the door also
+        withdraws the QUEUED batch work so nothing batch re-admits
+        ahead of the interactive backlog (withdrawn requests go back
+        BEHIND the door; they are never dropped).  Pulling the whole
+        class keeps every tenant queue arrival-ordered when the door
+        later re-forwards in its own FIFO order."""
+        with self._slock:
+            out = []
+            for q in self._tq.values():
+                mine = [r for r in q if r.slo == slo]
+                if mine:
+                    out.extend(mine)
+                    q[:] = [r for r in q if r.slo != slo]
+            out.sort(key=lambda r: r.arrival_ns)
+            return out
 
     # -- invariants (tests) ------------------------------------------------
     def check_invariants(self) -> None:

@@ -41,6 +41,7 @@ import numpy as np
 from ompi_tpu.api.errors import (ErrorClass, MpiError, ProcFailedError,
                                  RevokedError)
 from ompi_tpu.api.errhandler import ERRORS_RETURN
+from ompi_tpu.base.var import VarType, registry
 from ompi_tpu.runtime import spc, trace
 
 #: user-space tags of the serving protocol (below the 2^20 cap)
@@ -50,6 +51,22 @@ TAG_KV = 603
 
 _VOCAB = 50021
 _KV_MOD = 997
+
+#: simulated model-forward costs (f32 tanh pass sizes).  Autoregressive
+#: decode pays one TARGET pass per emitted token; a speculative verify
+#: round pays one target pass for the whole window plus one cheap DRAFT
+#: pass per proposed token — the gap IS the speculative win the bench
+#: A/B rows measure, so both sides must price their passes.
+_TARGET_PASS_ELEMS = 1 << 20
+_DRAFT_PASS_ELEMS = 1 << 14
+
+_spec_k_var = registry.register(
+    "serving", None, "spec_k", vtype=VarType.INT, default=0,
+    help="Speculative-decoding window: the draft model proposes this "
+         "many tokens per decode step and the target model verifies "
+         "them in one batched pass (accepted prefix + one "
+         "correction/bonus token emitted per round).  0 (the default) "
+         "decodes one target pass per token — speculative off")
 
 
 def toy_kv(rid: int, elems: int) -> np.ndarray:
@@ -67,6 +84,18 @@ def toy_token(rid: int, t: int) -> int:
     return (int(rid) * 1_000_003 + int(t) * 7919) % _VOCAB
 
 
+def toy_draft_token(rid: int, t: int) -> int:
+    """The draft model's proposal for token ``t``: agrees with the
+    target on 7 of every 8 positions and is off-by-one on the rest
+    (``(rid + t) % 8 == 5``) — a deterministic acceptance pattern, so
+    the speculative accept/reject counters are exactly reproducible
+    and the tests pin them instead of sampling them."""
+    tok = toy_token(rid, t)
+    if (int(rid) + int(t)) % 8 == 5:
+        return (tok + 1) % _VOCAB
+    return tok
+
+
 class ShardWorker:
     """One worker rank's engine loop (see module doc)."""
 
@@ -74,7 +103,8 @@ class ShardWorker:
                  role: str = "colocated", peer=None,
                  slots: int = 8, kv_elems: int = 256,
                  kv_partitions: Optional[int] = None,
-                 kv_codec: Optional[str] = None) -> None:
+                 kv_codec: Optional[str] = None,
+                 spec_k: Optional[int] = None) -> None:
         from ompi_tpu import serving as _pkg
         from ompi_tpu.mca.coll import quant as quant_mod
         from ompi_tpu.serving.kv_stream import (KvSlabReceiver,
@@ -91,6 +121,11 @@ class ShardWorker:
         # this job resolve the same var, so the pairings agree
         self._kv_codec = quant_mod.kv_codec() if kv_codec is None \
             else str(kv_codec or "")
+        # speculative window (None = the otpu_serving_spec_k default;
+        # 0 = plain one-pass-per-token decode).  Resolved once: both
+        # decode modes of a job agree for its lifetime
+        self.spec_k = int(_spec_k_var.value or 0) if spec_k is None \
+            else int(spec_k)
         self._kv: dict = {}          # rid -> local KV block (decode state)
         #: rids whose otpu-req flow hops this rank already emitted (a
         #: rid gets many work commands; its hop-0 finish and hop-2
@@ -205,8 +240,59 @@ class ShardWorker:
                            f"decode of rid {rid} without its KV block")
         # one fused read of the KV block per chunk keeps the toy model
         # honest about touching its state
+        n = int(n)
         _ = float(kv[: max(1, n)].sum())
-        return [toy_token(rid, tokens_done + i) for i in range(int(n))]
+        if self.spec_k <= 0:
+            # plain autoregressive decode: one target forward pass per
+            # emitted token (each token conditions on the previous)
+            for _i in range(n):
+                _ = np.tanh(np.arange(_TARGET_PASS_ELEMS,
+                                      dtype=np.float32)).sum()
+            return [toy_token(rid, tokens_done + i) for i in range(n)]
+        return self._decode_speculative(rid, tokens_done, n)
+
+    def _decode_speculative(self, rid: int, tokens_done: int,
+                            n: int) -> list:
+        """Speculative decode of one chunk: the draft proposes up to
+        ``spec_k`` tokens, the target verifies the whole window in ONE
+        batched pass, and the accepted prefix plus one target token
+        (the correction at the first mismatch, or the bonus token after
+        a fully accepted window) is emitted — so every round makes
+        progress and the output is the target model's token stream
+        bit-for-bit regardless of what the draft proposed (the router
+        re-verifies every token downstream)."""
+        out: list = []
+        t = int(tokens_done)
+        while len(out) < n:
+            window = min(self.spec_k, n - len(out))
+            proposals = []
+            for i in range(window):
+                _ = np.tanh(np.arange(_DRAFT_PASS_ELEMS,
+                                      dtype=np.float32)).sum()
+                proposals.append(toy_draft_token(rid, t + i))
+            # one batched target pass verifies all `window` positions
+            # (and yields the window+1'th logits for free)
+            _ = np.tanh(np.arange(_TARGET_PASS_ELEMS,
+                                  dtype=np.float32)).sum()
+            accepted = 0
+            for i, prop in enumerate(proposals):
+                if prop != toy_token(rid, t + i):
+                    break
+                accepted += 1
+            rejected = window - accepted
+            if accepted:
+                spc.record("serve_spec_accepts", accepted)
+            if rejected:
+                spc.record("serve_spec_rejects", rejected)
+            out.extend(toy_token(rid, t + i) for i in range(accepted))
+            t += accepted
+            if len(out) < n:
+                # the verify pass already computed this position's
+                # target token: correction on a mismatch, bonus after
+                # a clean window
+                out.append(toy_token(rid, t))
+                t += 1
+        return out
 
     # -- command handlers --------------------------------------------------
     def _handle(self, msg) -> None:

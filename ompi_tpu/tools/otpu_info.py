@@ -324,8 +324,11 @@ def main(argv=None) -> int:
                             f"{var.value!r} — {var.help}", p))
         out.append(_fmt("serving telemetry key slo",
                         _stelemetry.SCHEMA["slo"], p))
+        out.append(_fmt("serving telemetry key frontdoor",
+                        _stelemetry.SCHEMA["frontdoor"], p))
         for cname in _sspc._COUNTERS:
-            if cname.startswith(("req_", "slo_")):
+            if cname.startswith(("req_", "slo_", "serve_shed",
+                                 "serve_preempt", "serve_spec_")):
                 out.append(_fmt(f"serving counter {cname}",
                                 "SPC counter (see --pvars for values)",
                                 p))

@@ -196,6 +196,19 @@ def _slo_cell(sample: dict) -> str:
     return f"{max(burns):.1f}x"
 
 
+def _door_cell(sample: dict) -> str:
+    """Front-door summary of a rank publishing the ``frontdoor`` key
+    (the fleet controller rank): door-held depth + lifetime sheds,
+    with a '!' while any pool is holding batch after a preemption —
+    'd2/s14!' ('-' off the controller rank or with no door armed)."""
+    fd = sample.get("frontdoor")
+    if not fd:
+        return "-"
+    depth = sum(int(n) for n in (fd.get("queued") or {}).values())
+    mark = "!" if fd.get("holds") else ""
+    return f"d{depth}/s{fd.get('shed', 0)}{mark}"
+
+
 def render_table(session: TopSession, samples: dict, coll: str,
                  parsable: bool = False) -> str:
     """The per-rank live table (or ``:``-separated rows)."""
@@ -205,7 +218,7 @@ def render_table(session: TopSession, samples: dict, coll: str,
         out = []
         for rank, s, stale in rows:
             if s is None:
-                out.append(f"{rank}:-:-:-:-:-:-:-:-:-:{int(stale)}")
+                out.append(f"{rank}:-:-:-:-:-:-:-:-:-:-:{int(stale)}")
                 continue
             tcp = s.get("tcp") or {}
             chaos = s.get("chaos") or {}
@@ -216,19 +229,20 @@ def render_table(session: TopSession, samples: dict, coll: str,
                 _coll_cell(s, coll), tcp.get("outq_frags", 0),
                 sum(chaos.values()),
                 "-" if pct is None else round(pct, 1),
-                _fleet_cell(s), _slo_cell(s), int(stale))))
+                _fleet_cell(s), _slo_cell(s), _door_cell(s),
+                int(stale))))
         return "\n".join(out)
     hdr = (f"{'rank':>4}  {'seq':>6}  {'msg/s':>8}  {'bytes/s':>8}  "
            f"{coll + ' p50/p99':>16}  {'outq':>5}  {'stage':>6}  "
            f"{'serveq':>6}  {'chaos':>5}  {'host%/gil':>10}  "
-           f"{'fleet':>8}  {'burn':>5}  flag")
+           f"{'fleet':>8}  {'burn':>5}  {'door':>8}  flag")
     lines = [hdr]
     for rank, s, stale in rows:
         if s is None:
             lines.append(f"{rank:>4}  {'-':>6}  {'-':>8}  {'-':>8}  "
                          f"{'-':>16}  {'-':>5}  {'-':>6}  {'-':>6}  "
                          f"{'-':>5}  {'-':>10}  {'-':>8}  {'-':>5}  "
-                         "STALE")
+                         f"{'-':>8}  STALE")
             continue
         tcp = s.get("tcp") or {}
         staging = s.get("staging") or {}
@@ -246,6 +260,7 @@ def render_table(session: TopSession, samples: dict, coll: str,
             f"{_host_cell(s):>10}  "
             f"{_fleet_cell(s):>8}  "
             f"{_slo_cell(s):>5}  "
+            f"{_door_cell(s):>8}  "
             f"{'STALE' if stale else 'ok'}")
     return "\n".join(lines)
 

@@ -63,3 +63,11 @@ def mcount():
 
 def mpublish(telemetry):
     telemetry.register_source("moe_extra", dict)  # BAD: not a SCHEMA key
+
+
+def fcount():
+    spc.record("serve_shedz")                 # BAD: not in _COUNTERS
+
+
+def fpublish(telemetry):
+    telemetry.register_source("frontdoorz", dict)  # BAD: not a SCHEMA key

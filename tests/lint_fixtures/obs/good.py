@@ -16,6 +16,8 @@ def count():
     spc.record("req_traced")                  # declared in _COUNTERS
     spc.record("slo_breaches")                # declared in _COUNTERS
     spc.record("moe_dispatch_tokens")         # declared in _COUNTERS
+    spc.record("serve_shed")                  # declared in _COUNTERS
+    spc.record("serve_spec_accepts")          # declared in _COUNTERS
     spc.record(_dynamic_name())               # non-literal: out of scope
 
 
@@ -45,6 +47,7 @@ def publish(telemetry):
     telemetry.register_source("fleet", dict)  # the fleet control plane
     telemetry.register_source("slo", dict)    # the otpu-req SLO plane
     telemetry.register_source("moe", dict)    # the expert-parallel plane
+    telemetry.register_source("frontdoor", dict)  # the admission plane
 
 
 def crash(flight):

@@ -3,4 +3,5 @@
 _COUNTERS = (
     "send", "recv", "fast_frames", "quant_encodes",
     "req_traced", "slo_breaches", "moe_dispatch_tokens",
+    "serve_shed", "serve_spec_accepts",
 )

@@ -7,4 +7,5 @@ SCHEMA = {
     "fleet": "serving-fleet pool/prefix/autoscale tables",
     "slo": "per-pool/per-tenant SLO burn accounting",
     "moe": "MoE dispatch/dropped-token and load-imbalance tables",
+    "frontdoor": "serving admission plane: queue depths, sheds, holds",
 }

@@ -148,6 +148,57 @@ def test_serving_stage_medians_pinned(pins):
             "regression in the per-request decode stage")
 
 
+def test_frontdoor_rows_pinned(pins):
+    """The front-door rows (bench.py --serving: speculative-decode A/B
+    and the sustained-overload contract) must stay in the committed
+    sweep.  The multiplier pin is the whole point of speculation — at
+    matched chips the k=4 leg must emit tokens FASTER than plain
+    decode, or the draft/verify machinery is a net loss.  The overload
+    row pins the SLO contract itself: interactive exact p99 held under
+    `otpu_serving_slo_p99_ms` while the door sheds (with every shed
+    retried), and batch degrades — never the other way around."""
+    sweep = _load("BENCH_SWEEP.json")
+    rows = {r.get("coll"): r for r in sweep["results"]}
+    fd = pins["frontdoor"]
+    mult = rows.get("serving_spec_multiplier")
+    assert mult is not None, "serving_spec_multiplier row vanished"
+    assert mult.get("ok", True), "spec A/B bench FAILED"
+    got = mult["multiplier"]
+    assert got > 1.0, (
+        f"speculative decode multiplier {got} <= 1 — draft/verify is "
+        "a net loss at matched chips")
+    assert got >= 0.5 * fd["spec_multiplier"], (
+        f"multiplier {got} fell >2x below pin {fd['spec_multiplier']}")
+    k4 = rows.get("serving_spec_k4")
+    assert k4 is not None and k4.get("ok", True)
+    assert k4["tokens_per_s"] >= 0.25 * fd["spec_k4_tokens_per_s"], (
+        f"spec k=4 {k4['tokens_per_s']} tokens/s vs pin "
+        f"{fd['spec_k4_tokens_per_s']} — >4x collapse")
+    inter = rows.get("serving_overload_interactive")
+    batch = rows.get("serving_overload_batch")
+    assert inter is not None and inter.get("ok", True), (
+        "serving_overload_interactive row vanished")
+    assert batch is not None and batch.get("ok", True), (
+        "serving_overload_batch row vanished")
+    assert inter["p99_exact_ms"] <= fd["overload_slo_p99_ms"], (
+        f"interactive p99 {inter['p99_exact_ms']}ms breached the "
+        f"{fd['overload_slo_p99_ms']}ms SLO under overload")
+    assert inter["p99_exact_ms"] <= 4.0 * fd[
+        "overload_interactive_p99_ms"], (
+        f"interactive p99 {inter['p99_exact_ms']}ms vs pin "
+        f"{fd['overload_interactive_p99_ms']}ms — >4x regression")
+    assert batch["p99_exact_ms"] >= inter["p99_exact_ms"], (
+        "overload degraded INTERACTIVE past batch — the SLO tiers "
+        "inverted")
+    for r in (inter, batch):
+        assert r["shed"] > 0, (
+            f"{r['coll']}: overload drive shed nothing — the bench "
+            "is no longer above capacity")
+        assert r["retried"] >= r["shed"], (
+            f"{r['coll']}: {r['shed']} sheds but only {r['retried']} "
+            "retries — the driver stopped honoring retry-after")
+
+
 def test_recovery_rows_pinned(pins):
     """The recovery benchmark row (bench.py --recovery: elastic
     train-through-failure, detect→resume latency over 3 chaos-scheduled

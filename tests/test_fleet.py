@@ -606,6 +606,15 @@ def test_otpu_info_serving_surface():
     for ctr in ("req_traced", "req_stages", "slo_goodput",
                 "slo_breaches"):
         assert f"serving counter {ctr}" in out.stdout, ctr
+    # the front-door surfaces: admission vars, the speculative window,
+    # the frontdoor telemetry key, and the shed/preempt/spec counters
+    for var in ("otpu_serving_fd_queue_cap", "otpu_serving_fd_rate_rps",
+                "otpu_serving_fd_hold_ticks", "otpu_serving_spec_k"):
+        assert var in out.stdout, var
+    assert "serving telemetry key frontdoor" in out.stdout
+    for ctr in ("serve_shed", "serve_preempt", "serve_spec_accepts",
+                "serve_spec_rejects"):
+        assert f"serving counter {ctr}" in out.stdout, ctr
     par = subprocess.run(
         [sys.executable, "-m", "ompi_tpu.tools.otpu_info", "--serving",
          "--parsable"],
@@ -613,3 +622,130 @@ def test_otpu_info_serving_surface():
     assert par.returncode == 0
     assert any(ln.startswith("serving var otpu_serving_prefix_block:")
                for ln in par.stdout.splitlines()), par.stdout
+
+# ---------------------------------------- coord recovery budget (the flake)
+
+def test_coord_recovery_budget_resolution():
+    """The documented fleet-soak flake fix: RPCs inside
+    ``recovery_scope()`` take the recovery retry/timeout budget
+    (``otpu_coord_recovery_retry_max`` / ``_rpc_timeout``), scopes
+    nest, the budget never SHORTENS a raised steady-state ladder, and
+    everything reverts when the outermost scope exits."""
+    from ompi_tpu.base.var import registry
+    from ompi_tpu.rte import coord
+
+    c = coord.CoordClient.__new__(coord.CoordClient)
+    c._retry_max = 2
+    c._recovery_depth = 0
+    c._rpc_timeout = 1.5
+    assert c._effective_retry_max() == 2
+    assert c._effective_rpc_timeout() == 1.5
+    with c.recovery_scope():
+        assert c._effective_retry_max() == 24       # the var default
+        with c.recovery_scope():                    # scopes nest
+            assert c._effective_retry_max() == 24
+        assert c._effective_retry_max() == 24       # outer still open
+        # recovery never shortens a caller-raised steady-state ladder
+        c._retry_max = 100
+        assert c._effective_retry_max() == 100
+        # the rpc timeout inherits steady state until the var is set
+        assert c._effective_rpc_timeout() == 1.5
+        registry.set("otpu_coord_recovery_rpc_timeout", 9.0)
+        try:
+            assert c._effective_rpc_timeout() == 9.0
+        finally:
+            registry.set("otpu_coord_recovery_rpc_timeout", 0.0)
+    assert c._recovery_depth == 0
+    assert c._effective_retry_max() == 100
+    assert c._effective_rpc_timeout() == 1.5
+
+
+def test_coord_recovery_scope_survives_reconnect_burst():
+    """Behavioral pin against a hostile server: with the steady-state
+    ladder (retries=1) a burst of connection kills exhausts the budget
+    and raises; the SAME burst inside ``recovery_scope()`` is absorbed
+    by the recovery budget and the RPC completes."""
+    import socket
+
+    from ompi_tpu.rte import coord
+
+    kills = {"n": 0}
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    addr = srv.getsockname()
+
+    def _conn(conn):
+        try:
+            while True:
+                req = coord._recv_frame(conn)
+                if kills["n"] > 0:
+                    # swallow the request, reset the connection — the
+                    # client sees a ConnectionError and walks its
+                    # reconnect ladder
+                    kills["n"] -= 1
+                    conn.close()
+                    return
+                coord._send_frame(conn, {"ok": True, "value": None,
+                                         "_rid": req.get("_rid")})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _accept():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=_conn, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=_accept, daemon=True).start()
+    try:
+        c = coord.CoordClient(addr=addr, retries=1)
+        c.put(0, "warm", 1)                  # the happy path works
+        kills["n"] = 3
+        with pytest.raises((ConnectionError, OSError)):
+            c.put(0, "k", 2)                 # steady-state ladder: 1
+        kills["n"] = 3                       # retry, then exhausted
+        with c.recovery_scope():
+            c.put(0, "k", 3)                 # recovery budget: 24
+        assert kills["n"] == 0, "recovery path never hit the server"
+        c.put(0, "after", 4)                 # steady state restored
+        assert c._recovery_depth == 0
+    finally:
+        srv.close()
+
+
+def test_agreement_wraps_coord_in_recovery_scope():
+    """agree_kv's coord traffic rides the client's recovery scope when
+    one exists — and degrades to a no-op context for bare test fakes
+    (the shrink path must not demand the full client surface)."""
+    import contextlib
+
+    from ompi_tpu.ft import agreement
+
+    class _Client:
+        entered = 0
+
+        @contextlib.contextmanager
+        def recovery_scope(self):
+            _Client.entered += 1
+            try:
+                yield self
+            finally:
+                _Client.entered -= 1
+
+    cl = _Client()
+    with agreement._recovery_scope(cl):
+        assert _Client.entered == 1
+    assert _Client.entered == 0
+    # a fake without the method gets nullcontext, not AttributeError
+    with agreement._recovery_scope(object()):
+        pass

@@ -98,7 +98,7 @@ def test_observability_contracts():
                    FIXTURES / "obs" / "telemetry.py",
                    FIXTURES / "obs" / "profile.py",
                    FIXTURES / "obs" / "trace.py")
-    assert len(bad) == 15, bad
+    assert len(bad) == 17, bad
     msgs = " | ".join(f.message for f in bad)
     assert "moe_dispatch_tokenz" in msgs      # the moe counter twin
     assert "moe_extra" in msgs                # the moe SCHEMA-key twin

@@ -636,6 +636,38 @@ def test_telemetry_disabled_zero_overhead():
         telemetry.register_source("not_in_schema", dict)
 
 
+def test_frontdoor_disabled_zero_overhead():
+    """Front-door satellite pin: with no FrontDoor constructed the
+    admission plane is an identity — module bool off, no armed
+    instance, no thread ever (the door pumps on the fleet tick even
+    when armed), the router completion hook is one module-attribute
+    check, speculative decoding defaults off, and the
+    serve_shed/serve_preempt SPC counters stay EXACTLY flat."""
+    import threading
+
+    from ompi_tpu.runtime import spc
+    from ompi_tpu.serving import frontdoor
+    from ompi_tpu.serving.worker import _spec_k_var
+
+    assert frontdoor.enabled is False            # default off
+    assert frontdoor._active is None             # no armed instance
+    assert not [t for t in threading.enumerate()
+                if "frontdoor" in t.name.lower()], "door thread exists"
+    shed0 = spc.read("serve_shed")
+    pre0 = spc.read("serve_preempt")
+    # the module observe() hook with no door armed is a no-op
+    frontdoor.observe("pool", "interactive", 5.0)
+    frontdoor.observe("pool", "batch", 5.0)
+    assert spc.read("serve_shed") == shed0
+    assert spc.read("serve_preempt") == pre0
+    # disarm without a door is likewise inert
+    frontdoor.disarm()
+    assert frontdoor.enabled is False and frontdoor._active is None
+    # speculative decoding is off by default: otpu_serving_spec_k=0
+    # means one target pass per token, draft model never consulted
+    assert int(_spec_k_var.value or 0) == 0
+
+
 _TELEMETRY_PIN_SCRIPT = textwrap.dedent("""
     import json, os, time
     from ompi_tpu.rte.coord import CoordServer
