@@ -2023,7 +2023,8 @@ def history_file() -> str:
 
 
 def _run_history_worker(body: str, marker: str, n: int,
-                        extra_mca=()) -> list:
+                        extra_mca=(), extra_argv=(),
+                        extra_env=()) -> list:
     """One tpurun job over the PML wire path (coll/sm pushed below
     coll/tuned so the rows measure the datapath the stage clocks cover
     — and so a chaos wire fault actually lands in the numbers)."""
@@ -2037,12 +2038,15 @@ def _run_history_worker(body: str, marker: str, n: int,
         argv = [sys.executable, "-m", "ompi_tpu.tools.tpurun",
                 "-n", str(n),
                 "--mca", "otpu_coll_sm_coll_priority", "0"]
+        argv += list(extra_argv)
         for k, v in extra_mca:
             argv += ["--mca", k, v]
         argv += [sys.executable, script]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(dict(extra_env))
         proc = subprocess.run(
             argv, capture_output=True, text=True, timeout=600,
-            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            env=env)
         line = next((ln for ln in proc.stdout.splitlines()
                      if marker in ln), None)
         if proc.returncode or line is None:
@@ -2077,6 +2081,30 @@ def history_rows(n: int = 2) -> list:
     one run to BENCH_HISTORY.jsonl (the otpu_perf --diff input)."""
     rows = _run_history_worker(_HISTORY_WORKER, "HISTORY", n)
     return append_history(rows, "bench", f"host_sm_n{n}")
+
+
+def reactor_history_rows(n: int = 3, native: bool = True) -> list:
+    """``--reactor-history``: the native-reactor acceptance lane — the
+    same min-of-k worker forced onto btl/tcp (``--fake-nodes`` so sm
+    declines every peer and the eager 4KB allreduce rides the wire the
+    epoll reactor drains).  Run once with ``native=False`` (pure-Python
+    selector loop, the "before" baseline) and once with the default
+    (reactor on, the "after"): both land under the same
+    ``host_tcp_n{n}`` topology and identical keys, so ``otpu_perf
+    --diff`` compares reactor-on against the reactor-off min — the hard
+    4KB-eager latency budget.  Pingpong needs exactly 2 ranks, so the
+    default point set here is allreduce-only (override via
+    OTPU_BENCH_HISTORY_POINTS)."""
+    extra_env = []
+    if "OTPU_BENCH_HISTORY_POINTS" not in os.environ:
+        extra_env.append(("OTPU_BENCH_HISTORY_POINTS",
+                          "allreduce:4096,allreduce:65536"))
+    extra_mca = () if native else (("otpu_progress_native", "0"),)
+    rows = _run_history_worker(
+        _HISTORY_WORKER, "HISTORY", n,
+        extra_mca=extra_mca, extra_argv=("--fake-nodes", str(n)),
+        extra_env=extra_env)
+    return append_history(rows, "bench", f"host_tcp_n{n}")
 
 
 def ladder_host_rows(n: int = 2) -> list:
@@ -3047,6 +3075,10 @@ if __name__ == "__main__":
     elif "--multidev" in sys.argv:
         for row in multidev_sweep():
             print(row)
+    elif "--reactor-history" in sys.argv:
+        for row in reactor_history_rows(
+                native="--baseline" not in sys.argv):
+            print(json.dumps(row))
     elif "--history" in sys.argv:
         for row in history_rows():
             print(json.dumps(row))

@@ -218,6 +218,10 @@ class SmBtl(Btl):
         # inter-node traffic honestly exercises the DCN (tcp) path
         self._hostname = os.environ.get("OTPU_NODE_ID", socket.gethostname())
         self._ring_size = 4 << 20
+        # doorbell registered with the native reactor (MODE_DRAIN): the
+        # epoll thread consumes the dgrams and its notify eventfd wakes
+        # idle_wait — the Python drain loop in progress() is skipped
+        self._db_reactor = False
 
     def _clamped(self, limit: int) -> int:
         """A frame larger than the ring can NEVER be pushed (push would
@@ -276,7 +280,13 @@ class SmBtl(Btl):
             self._db_rx = db
             self._db_tx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
             self._db_tx.setblocking(False)
-            progress_mod.register_waiter(db)
+            from ompi_tpu.runtime import reactor as reactor_mod
+
+            self._db_reactor = reactor_mod.engage() and reactor_mod.add(
+                db.fileno(), reactor_mod.MODE_DRAIN,
+                self._on_doorbell_record)
+            if not self._db_reactor:
+                progress_mod.register_waiter(db)
         except OSError:
             self._db_rx = self._db_tx = None
             db_name = None
@@ -368,11 +378,19 @@ class SmBtl(Btl):
                 profile.stage_span("send.wire", _t0, t1)
         self._ring_doorbell(ep.world_rank, ep.addr)
 
+    def _on_doorbell_record(self, etype: int, payload) -> int:
+        """Reactor DOORBELL record: the dgrams were already consumed on
+        the epoll thread and the notify eventfd woke any idle waiter —
+        the ring drain below runs on this same progress tick, so there
+        is nothing left to do here (the record IS the wakeup)."""
+        return 0
+
     @hot_path
     def progress(self) -> int:
         events = 0
-        # drain doorbell pings (edge signal only; frames carry the data)
-        if self._db_rx is not None:
+        # drain doorbell pings (edge signal only; frames carry the
+        # data); with the reactor engaged the epoll thread consumed them
+        if self._db_rx is not None and not self._db_reactor:
             while True:
                 try:
                     self._db_rx.recv(512)
@@ -523,9 +541,15 @@ class SmBtl(Btl):
             if self.progress() == 0:
                 _time.sleep(0.0005)
         if self._db_rx is not None:
-            from ompi_tpu.runtime import progress as progress_mod
+            if self._db_reactor:
+                from ompi_tpu.runtime import reactor as reactor_mod
 
-            progress_mod.unregister_waiter(self._db_rx)
+                reactor_mod.remove(self._db_rx.fileno())
+                self._db_reactor = False
+            else:
+                from ompi_tpu.runtime import progress as progress_mod
+
+                progress_mod.unregister_waiter(self._db_rx)
             try:
                 self._db_rx.close()
             except OSError:

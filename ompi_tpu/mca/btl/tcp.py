@@ -49,15 +49,28 @@ import threading
 import time
 import zlib
 from collections import deque
+from functools import partial
 from typing import Optional
+
+import numpy as np
 
 from ompi_tpu.base.var import VarType
 from ompi_tpu.ft import chaos
 from ompi_tpu.mca.btl.base import ACK, CTL, FRAG, MATCH, RGET, RNDV, \
     Btl, Endpoint, Frag
 from ompi_tpu.mca.coll import quant as quant_mod
-from ompi_tpu.runtime import profile, sanitizer, spc, trace
+from ompi_tpu.runtime import profile, reactor as reactor_mod, \
+    sanitizer, spc, trace
 from ompi_tpu.runtime.hotpath import hot_path
+
+# reactor record types, bound to locals for the dispatch hot path
+_R_RAW = reactor_mod.REC_RAW
+_R_FAST = reactor_mod.REC_FAST
+_R_EOF = reactor_mod.REC_EOF
+_R_ACCEPT = reactor_mod.REC_ACCEPT
+_R_WRITABLE = reactor_mod.REC_WRITABLE
+_R_OVERSIZE = reactor_mod.REC_OVERSIZE
+_R_DESYNC = reactor_mod.REC_DESYNC
 
 _LEN = struct.Struct("!I")
 _MAX_FRAME = (1 << 32) - 1          # the !I length prefix's ceiling
@@ -140,6 +153,9 @@ class _Conn:
     def __init__(self, sock: socket.socket, rank: Optional[int] = None):
         self.sock = sock
         self.rank = rank
+        # fd registered with the native reactor (None on the pure-
+        # Python selector lane); cleared on EOF teardown
+        self.fd: Optional[int] = None
         # holds only the partial TAIL frame split across recv calls;
         # complete frames are parsed zero-copy from the recv scratch
         self.inbuf = bytearray()
@@ -191,6 +207,13 @@ class TcpBtl(Btl):
         self._rte = None
         self._listener: Optional[socket.socket] = None
         self._sel = selectors.DefaultSelector()
+        # native-reactor lane: when True the epoll loop in otpu_native
+        # owns every socket (drain/framing/parse off-GIL) and progress()
+        # only fires deferred suspicions — records arrive through
+        # reactor_mod.drain() -> _reactor_event.  _rconns mirrors the
+        # reactor's fd registrations for close() teardown.
+        self._reactor = False
+        self._rconns: dict[int, _Conn] = {}
         # multi-link (btl_tcp_links): several connections per peer, frames
         # round-robined across them — the reference's per-link striping
         self._by_rank: dict[int, list[_Conn]] = {}
@@ -257,14 +280,41 @@ class TcpBtl(Btl):
         self._rte = rte
         self._listener = socket.create_server(("127.0.0.1", 0), backlog=64)
         self._listener.setblocking(False)
-        self._sel.register(self._listener, selectors.EVENT_READ, "listener")
-        # idle waiters block on the listener too: an inbound connect (the
-        # peer's first message) must wake a sleeping receiver
-        from ompi_tpu.runtime import progress as progress_mod
+        # native-reactor lane: hand the listener to the epoll thread as
+        # a NOTIFY (oneshot) fd — inbound connects surface as ACCEPT
+        # records and the reactor's notify eventfd (a progress waiter)
+        # wakes idle sleepers, so neither the selector nor the waiter
+        # registry sees this socket at all
+        self._reactor = reactor_mod.engage() and reactor_mod.add(
+            self._listener.fileno(), reactor_mod.MODE_NOTIFY,
+            self._on_accept_record)
+        if not self._reactor:
+            self._sel.register(self._listener, selectors.EVENT_READ,
+                               "listener")
+            # idle waiters block on the listener too: an inbound connect
+            # (the peer's first message) must wake a sleeping receiver
+            from ompi_tpu.runtime import progress as progress_mod
 
-        progress_mod.register_waiter(self._listener)
+            progress_mod.register_waiter(self._listener)
         rte.modex_put("btl_tcp_addr", self._listener.getsockname())
         return True
+
+    def _register_conn(self, conn: _Conn) -> None:
+        """Register a fresh connection for receive progress: with the
+        native reactor its fd becomes a STREAM (drain/framing/parse run
+        on the epoll thread); otherwise the classic selector + idle-
+        waiter pair."""
+        if self._reactor:
+            fd = conn.sock.fileno()
+            if reactor_mod.add(fd, reactor_mod.MODE_STREAM,
+                               partial(self._reactor_event, conn)):
+                conn.fd = fd
+                self._rconns[fd] = conn
+                return
+        self._sel.register(conn.sock, selectors.EVENT_READ, conn)
+        from ompi_tpu.runtime import progress as progress_mod
+
+        progress_mod.register_waiter(conn.sock)
 
     def reachable(self, world_rank: int, rte) -> Optional[Endpoint]:
         if self._rte is None or world_rank == rte.my_world_rank:
@@ -336,10 +386,7 @@ class TcpBtl(Btl):
                     break   # some links up: run with what connected
                 conn = _Conn(sock, rank)
                 sock.setblocking(False)
-                self._sel.register(sock, selectors.EVENT_READ, conn)
-                from ompi_tpu.runtime import progress as progress_mod
-
-                progress_mod.register_waiter(sock)
+                self._register_conn(conn)
                 conns.append(conn)
             self._connect_backoff.pop(rank, None)
             # MERGE, never assign: _drain's handshake path may have
@@ -634,6 +681,13 @@ class TcpBtl(Btl):
         """(De)register EVENT_WRITE interest for a backpressured conn."""
         if conn.want_write == want:
             return
+        if conn.fd is not None:
+            # reactor-owned stream: EPOLLOUT interest lives on the epoll
+            # thread; the WRITABLE record it emits routes back through
+            # _reactor_event -> _flush (interest auto-clears on fire)
+            if reactor_mod.want_write(conn.fd, want):
+                conn.want_write = want
+            return
         events = selectors.EVENT_READ | (selectors.EVENT_WRITE if want
                                          else 0)
         try:
@@ -642,11 +696,131 @@ class TcpBtl(Btl):
             return   # conn already torn down / never registered
         conn.want_write = want
 
+    # -- native-reactor record dispatch ----------------------------------
+    @hot_path
+    def _reactor_event(self, conn: _Conn, etype: int, payload) -> int:
+        """Handler for one reactor record on this conn's stream.  FAST
+        records carry a ready-to-unpack !IIIiqBqqq header + payload (the
+        native thread already drained, framed, and lane-routed); the
+        payload memoryview is borrowed drain-buffer scratch — valid
+        until the next drain, the same contract as recv-scratch frames
+        on the selector lane."""
+        if etype == _R_FAST:
+            if chaos.enabled:
+                # recv-side chaos on the fast lane: delay only — corrupt
+                # targets checksummed frames, and those never arrive
+                # here (htype & _H_CK_BASE diverts to the RAW lane)
+                rule = chaos.wire_recv("tcp", False)
+                if rule is not None and rule["fault"] == "delay":
+                    chaos.sleep_ms(rule)
+            _pt = profile.now() if profile.enabled else 0
+            (cid, src, dst, tag, seq, code, total_len, offset,
+             req_id) = _FAST.unpack_from(payload, 0)
+            data = np.frombuffer(payload, np.uint8, offset=_FAST.size)
+            frag = Frag(cid, src, dst, tag, seq, _CODE_TO_KIND[code],
+                        data, total_len, offset,
+                        {} if req_id < 0 else {"req_id": req_id},
+                        borrowed=True)
+            if profile.enabled:
+                profile.stage_span("recv.parse", _pt)
+            spc.record("fastpath_native_frags")
+            if self._recv_cb is not None:
+                self._recv_cb(frag)
+                return 1
+            return 0
+        if etype == _R_RAW:
+            return self._reactor_raw(conn, payload)
+        if etype == _R_WRITABLE:
+            # the epoll thread cleared its EPOLLOUT interest before
+            # emitting this record: mirror that here so the flush's
+            # _mark_writable re-arms when the queue is still non-empty
+            conn.want_write = False
+            self._flush(conn)
+            return 1
+        if etype == _R_EOF:
+            self._reactor_eof(conn)
+            return 1
+        if etype == _R_OVERSIZE:
+            return self._reactor_raw(
+                conn, memoryview(reactor_mod.take_oversize(conn.fd)))
+        if etype == _R_DESYNC:
+            self._wire_fault(
+                "wire_desync", _conn_peer(conn), 0, "framing desync",
+                "btl/tcp framing desync: zero-length frame on the wire "
+                "(native reactor)")
+        return 0
+
+    @hot_path
+    def _reactor_raw(self, conn: _Conn, frame) -> int:
+        """Slow-lane record: the native side forwards any frame that is
+        not a plain fast header (crc-armed, quantized, pickle,
+        handshake, unknown kind byte) VERBATIM, and this feeds it to the
+        exact `_parse_frame` the selector lane uses — behavior stays
+        bit-identical, including crc verification and the chaos
+        recv-side corrupt hook below."""
+        if chaos.enabled:
+            rule = chaos.wire_recv("tcp", False)
+            if rule is not None:
+                if rule["fault"] == "delay":
+                    chaos.sleep_ms(rule)
+                elif rule["fault"] == "corrupt" \
+                        and len(frame) > 1 + _CKSUM.size + 1 \
+                        and frame[0] & _H_CK_BASE:
+                    frame[1 + _CKSUM.size] ^= 0x01
+        _pt = profile.now() if profile.enabled else 0
+        frag = self._parse_frame(conn, frame, borrowed=True)
+        if profile.enabled:
+            profile.stage_span("recv.parse", _pt)
+        spc.record("fastpath_native_raw")
+        if frag is not None and self._recv_cb is not None:
+            self._recv_cb(frag)
+            return 1
+        return 0
+
+    def _on_accept_record(self, etype: int, payload) -> int:
+        """NOTIFY record for the listener: accept everything pending,
+        register each conn as a reactor stream, then re-arm the oneshot
+        registration."""
+        if etype != _R_ACCEPT or self._listener is None:
+            return 0
+        events = 0
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break
+            sock.setblocking(False)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._register_conn(_Conn(sock))
+            events += 1
+        reactor_mod.rearm(self._listener.fileno())
+        return events
+
+    def _reactor_eof(self, conn: _Conn) -> None:
+        """Peer closed (or hard error) on a reactor stream: same
+        teardown as the selector lane's zero-byte recv."""
+        fd, conn.fd = conn.fd, None
+        if fd is not None:
+            reactor_mod.remove(fd)
+            self._rconns.pop(fd, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._drop_conn(conn)
+
     # -- progress --------------------------------------------------------
     @hot_path
     def progress(self) -> int:
         events = 0
         self._drain_suspects()
+        if self._reactor and not self._sel.get_map():
+            # native-reactor lane: every socket lives on the epoll
+            # thread and completed records arrive via reactor_mod.drain
+            # (a sibling progress callback) — nothing to select here.
+            # The map check keeps any selector-registered straggler (a
+            # reactor add() that failed mid-teardown) progressing.
+            return 0
         try:
             ready = self._sel.select(timeout=0)
         except OSError:
@@ -838,8 +1012,6 @@ class TcpBtl(Btl):
         attributed error, never a silently-corrupt delivery — and
         quantized frames (``htype & _H_QUANT``) dequantize straight out
         of the recv view into an OWNED array of the original bytes."""
-        import numpy as np
-
         htype = frame[0]
         off = 1
         if htype & _H_CK_BASE:
@@ -958,6 +1130,21 @@ class TcpBtl(Btl):
                 time.sleep(0.0005)
         from ompi_tpu.runtime import progress as progress_mod
 
+        # reactor-owned fds leave the epoll set before their sockets
+        # close (an fd closed while still registered would be silently
+        # dropped from epoll and could recycle into a new stream)
+        if self._reactor:
+            for fd, conn in list(self._rconns.items()):
+                reactor_mod.remove(fd)
+                self._rconns.pop(fd, None)
+                conn.fd = None
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+            if self._listener is not None:
+                reactor_mod.remove(self._listener.fileno())
+            self._reactor = False
         # every registered socket — including accepted-but-unhandshaked
         # conns that never made it into _by_rank — must leave the global
         # waiter selector, or their EOF-readable fds make idle_wait()

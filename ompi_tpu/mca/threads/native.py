@@ -115,6 +115,20 @@ class NativePool(base.WorkPool):
             self._h = 0
 
 
+def substrate() -> dict:
+    """Capability report of the native substrate this component fronts:
+    which otpu_native tiers compiled in (worker pool, ring ops, the
+    progress reactor) and whether the reactor is live in THIS process.
+    Surfaced by ``otpu_info --progress`` and the threads telemetry so a
+    slow run can be attributed to a missing toolchain at a glance."""
+    from ompi_tpu.runtime import reactor
+
+    return {"available": native.available(),
+            "pool": native.available(),
+            "reactor": native.reactor_supported(),
+            "reactor_active": reactor.active()}
+
+
 class NativeThreadsComponent(base.ThreadsComponent):
     name = "native"
     priority = 40

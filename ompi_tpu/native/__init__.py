@@ -25,6 +25,7 @@ _tried = False
 
 _I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 _U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+_has_reactor = False
 
 
 def _build_path() -> str:
@@ -45,6 +46,11 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        if os.environ.get("OTPU_NATIVE_DISABLE"):
+            # explicit fallback-lane switch: behave exactly as if the
+            # toolchain were absent (CI runs the whole suite this way
+            # to prove the pure-Python lanes carry the job alone)
+            return None
         try:
             so = _build_path()
             if not os.path.exists(so):
@@ -125,12 +131,147 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.otpu_pool_test.argtypes = [ctypes.c_int64]
         lib.otpu_pool_wait.restype = None
         lib.otpu_pool_wait.argtypes = [ctypes.c_int64]
+        # progress reactor (runtime/reactor.py front-end)
+        try:
+            lib.otpu_reactor_create.restype = ctypes.c_int64
+            lib.otpu_reactor_create.argtypes = [ctypes.c_int64,
+                                                ctypes.c_int64]
+            lib.otpu_reactor_destroy.restype = None
+            lib.otpu_reactor_destroy.argtypes = [ctypes.c_int64]
+            lib.otpu_reactor_notify_fd.restype = ctypes.c_int
+            lib.otpu_reactor_notify_fd.argtypes = [ctypes.c_int64]
+            lib.otpu_reactor_wait_fd.restype = ctypes.c_int
+            lib.otpu_reactor_wait_fd.argtypes = [ctypes.c_int64]
+            lib.otpu_reactor_add.restype = ctypes.c_int
+            lib.otpu_reactor_add.argtypes = [
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+            lib.otpu_reactor_del.restype = ctypes.c_int
+            lib.otpu_reactor_del.argtypes = [ctypes.c_int64, ctypes.c_int]
+            lib.otpu_reactor_rearm.restype = ctypes.c_int
+            lib.otpu_reactor_rearm.argtypes = [ctypes.c_int64,
+                                               ctypes.c_int]
+            lib.otpu_reactor_want_write.restype = ctypes.c_int
+            lib.otpu_reactor_want_write.argtypes = [
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+            # raw void* out-buffer (not an ndpointer): the per-tick
+            # caller passes a cached buffer ADDRESS, skipping numpy's
+            # from_param validation on the hottest ctypes call
+            lib.otpu_reactor_drain.restype = ctypes.c_int64
+            lib.otpu_reactor_drain.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_uint64]
+            lib.otpu_reactor_take_oversize.restype = ctypes.c_int64
+            lib.otpu_reactor_take_oversize.argtypes = [
+                ctypes.c_int64, ctypes.c_int, _U8P, ctypes.c_uint64]
+            lib.otpu_reactor_stats.restype = ctypes.c_int
+            lib.otpu_reactor_stats.argtypes = [
+                ctypes.c_int64, _I64P, ctypes.c_int]
+            _reactor_ok = True
+        except AttributeError:
+            # stale cached .so from an older source (hash collision is
+            # impossible, but a hand-copied cache is not): the pack/
+            # ring/pool substrate still works, only the reactor is off
+            _reactor_ok = False
+        global _has_reactor
+        _has_reactor = _reactor_ok
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def reactor_supported() -> bool:
+    """The library is loaded AND exports the progress-reactor entry
+    points (a non-Linux build stubs them; ``reactor_create`` then
+    returns 0 and the runtime stays on the pure-Python lane)."""
+    return _load() is not None and _has_reactor
+
+
+# -- progress reactor entry points ----------------------------------------
+
+def reactor_create(ring_cap: int = 8 << 20,
+                   oversize_limit: int = 4 << 20) -> int:
+    """Start the epoll reactor thread; returns a handle (0: failed)."""
+    if not reactor_supported():
+        return 0
+    return int(_load().otpu_reactor_create(ring_cap, oversize_limit))
+
+
+def reactor_destroy(handle: int) -> None:
+    _load().otpu_reactor_destroy(handle)
+
+
+def reactor_notify_fd(handle: int) -> int:
+    """The eventfd the reactor pokes when completed records land
+    (drain clears it)."""
+    return int(_load().otpu_reactor_notify_fd(handle))
+
+
+def reactor_wait_fd(handle: int) -> int:
+    """The consumer waiter fd: readable when the reactor's epoll set
+    has ready events OR completed records are queued.  Register THIS
+    as the progress waiter — an idle consumer then wakes on raw socket
+    readiness and picks the frame up inline via the drain-time pump,
+    without waiting for the (idle-priority) reactor thread to be
+    scheduled on a saturated host."""
+    return int(_load().otpu_reactor_wait_fd(handle))
+
+
+def reactor_add(handle: int, fd: int, mode: int) -> bool:
+    """Register ``fd``: mode 0 = byte stream (framing + parse), 1 =
+    notify-only oneshot (listener), 2 = drain-dgram (doorbell)."""
+    return int(_load().otpu_reactor_add(handle, fd, mode)) == 0
+
+
+def reactor_del(handle: int, fd: int) -> bool:
+    return int(_load().otpu_reactor_del(handle, fd)) == 0
+
+
+def reactor_rearm(handle: int, fd: int) -> bool:
+    """Re-arm a notify-mode fd after servicing its ACCEPT record."""
+    return int(_load().otpu_reactor_rearm(handle, fd)) == 0
+
+
+def reactor_want_write(handle: int, fd: int, on: bool) -> bool:
+    """(De)register EPOLLOUT interest for a backpressured stream fd."""
+    return int(_load().otpu_reactor_want_write(
+        handle, fd, 1 if on else 0)) == 0
+
+
+def reactor_drain(handle: int, out: np.ndarray) -> int:
+    """Copy completed records into ``out``; returns bytes copied, or a
+    NEGATIVE needed-size when the next record does not fit (grow and
+    retry).  The one ctypes call on the per-tick hot path."""
+    return int(_load().otpu_reactor_drain(
+        handle, out.ctypes.data, len(out)))
+
+
+def reactor_drain_fn():
+    """The bound ctypes drain entry point itself, for the per-tick
+    caller (runtime/reactor.drain) to cache: calling it directly with
+    (handle, buffer_address, capacity) ints skips the module lookup
+    and wrapper frame on every progress tick.  Releases the GIL for
+    the duration like any CDLL call — the inline pump's recv/parse
+    runs GIL-free on the consumer thread too."""
+    lib = _load()
+    return None if lib is None else lib.otpu_reactor_drain
+
+
+def reactor_take_oversize(handle: int, fd: int, out: np.ndarray) -> int:
+    """Fetch a parked oversize frame (resumes the stream); returns its
+    length, a negative needed-size, or -1 when nothing is parked."""
+    return int(_load().otpu_reactor_take_oversize(handle, fd, out,
+                                                  len(out)))
+
+
+def reactor_stats(handle: int) -> dict:
+    """Reactor counters for telemetry/otpu_info (racy reads)."""
+    out = np.zeros(7, np.int64)
+    n = int(_load().otpu_reactor_stats(handle, out, len(out)))
+    keys = ("fds", "records", "frames_fast", "frames_raw",
+            "overflow", "wakeups", "pumps")
+    return {k: int(out[i]) for i, k in enumerate(keys[:n])}
 
 
 # -- datatype engine entry points ----------------------------------------

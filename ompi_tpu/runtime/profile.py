@@ -241,6 +241,13 @@ _BLOCKED_FILES = ("threading.py", "selectors.py", "socket.py",
                   "connection.py", "queue.py", "ssl.py")
 _BLOCKED_NAMES = ("idle_wait", "select", "poll", "epoll")
 
+#: native entry points that release the GIL while doing real work —
+#: ctypes drops the GIL for the call's duration, so a thread sampled
+#: here counts toward gil_released but keeps its hot-path phase (the
+#: reactor's drain/pump runs socket drain + framing inside this call;
+#: classifying it "idle" would hide the work from phase attribution)
+_NATIVE_NAMES = ("_native_drain",)
+
 
 class HostProfiler:
     """The per-rank sampling thread.  Aggregates are WRITTEN by the
@@ -300,6 +307,7 @@ class HostProfiler:
         if fn.endswith(_BLOCKED_FILES) or \
                 top.f_code.co_name in _BLOCKED_NAMES:
             return "idle", True
+        released = top.f_code.co_name in _NATIVE_NAMES
         phase = None
         f = frame
         while f is not None:
@@ -309,7 +317,7 @@ class HostProfiler:
                 phase = label
                 break
             f = f.f_back
-        return phase or "other", False
+        return phase or ("native" if released else "other"), released
 
     def _run(self) -> None:
         from ompi_tpu.runtime import spc

@@ -10,15 +10,30 @@ collective schedules (libnbc equivalent), FT heartbeats, RMA passive targets.
 """
 from __future__ import annotations
 
+import os
 import selectors
 import threading
 import time
 from typing import Callable
 
+from ompi_tpu.base.var import VarType, registry
 from ompi_tpu.runtime import sanitizer
 from ompi_tpu.runtime.hotpath import hot_path
 
 _LOW_PRIORITY_CADENCE = 8  # opal_progress.c:227
+
+
+def _set_lp_cadence(v) -> None:
+    global _LOW_PRIORITY_CADENCE
+    _LOW_PRIORITY_CADENCE = max(1, int(v))
+
+
+registry.register(
+    "progress", None, "lp_cadence",
+    vtype=VarType.INT, default=_LOW_PRIORITY_CADENCE,
+    help="Run low-priority progress callbacks every Nth tick "
+         "(opal_progress's event-loop tick ratio)",
+    on_set=_set_lp_cadence)
 
 _lock = threading.RLock()
 _callbacks: list[Callable[[], int]] = []
@@ -59,17 +74,49 @@ def unregister_waiter(fileobj) -> None:
             pass
 
 
+def _prune_dead_waiters() -> None:
+    """Drop registrations whose fd has been closed out from under the
+    selector (a conn torn down concurrently by ``_drop_conn``): probe
+    each registered fd and unregister the dead ones so the surviving
+    registrations keep working."""
+    global _waiter_count
+    with _lock:
+        for key in list(_waiter_sel.get_map().values()):
+            try:
+                os.fstat(key.fd)
+            except OSError:
+                try:
+                    _waiter_sel.unregister(key.fileobj)
+                    _waiter_count -= 1
+                except KeyError:
+                    pass
+
+
 def idle_wait(timeout: float) -> bool:
     """Block until a transport fd is readable or ``timeout`` elapses.
     Returns True when woken by an fd (caller should poll progress)."""
     if _waiter_count == 0:
         time.sleep(timeout)
         return False
-    try:
-        return bool(_waiter_sel.select(timeout))
-    except OSError:
-        time.sleep(timeout)
-        return False
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        try:
+            return bool(_waiter_sel.select(remaining))
+        except OSError:
+            # an fd closed concurrently with the select (a conn dropped
+            # by another thread): prune the dead registrations and
+            # RETRY on the survivors for the remaining budget — the old
+            # blind time.sleep(timeout) here burned the full timeout
+            # and turned every teardown race into a latency cliff
+            _prune_dead_waiters()
+            if _waiter_count == 0:
+                remaining = deadline - time.monotonic()
+                if remaining > 0:
+                    time.sleep(remaining)
+                return False
 
 
 def register(cb: Callable[[], int], low_priority: bool = False) -> None:
@@ -135,6 +182,13 @@ def callback_count() -> int:
 
 def reset_for_testing() -> None:
     global _counter
+    # the native reactor registers a callback + waiter here: tear its
+    # thread down BEFORE clearing the lists so a late record dispatch
+    # cannot fire into a half-reset engine (instance teardown routes
+    # through this too)
+    from ompi_tpu.runtime import reactor as _reactor
+
+    _reactor.shutdown()
     with _lock:
         _callbacks.clear()
         _lp_callbacks.clear()
@@ -151,10 +205,14 @@ from ompi_tpu.runtime import telemetry as _telemetry
 
 
 def _telemetry_stats() -> dict:
+    from ompi_tpu.runtime import reactor as _reactor
+
     with _lock:
-        return {"callbacks": len(_callbacks) + len(_lp_callbacks),
-                "low_priority": len(_lp_callbacks),
-                "waiters": _waiter_count}
+        out = {"callbacks": len(_callbacks) + len(_lp_callbacks),
+               "low_priority": len(_lp_callbacks),
+               "waiters": _waiter_count}
+    out["reactor_active"] = _reactor.active()
+    return out
 
 
 _telemetry.register_source("progress", _telemetry_stats)

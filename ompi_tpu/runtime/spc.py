@@ -21,6 +21,14 @@ _COUNTERS = (
     "fastpath_payload_copies",
     "fastpath_sched_hits", "fastpath_sched_misses", "fastpath_eager_lane",
     "fastpath_staging_hits", "fastpath_staging_misses",
+    # native-reactor progress engine (runtime/reactor): non-empty record
+    # drains per tick, fast-lane frags parsed natively, and slow-lane
+    # frames forwarded to the Python _parse_frame — the frags/raw split
+    # shows how much of the receive path actually ran off-GIL.  All
+    # three stay EXACTLY flat with otpu_progress_native=0 (identity pin
+    # in test_perf_guard).
+    "progress_native_drains", "fastpath_native_frags",
+    "fastpath_native_raw",
     # serving counters (ompi_tpu/serving): continuous-batching engine
     # admissions/evictions per tick, decoded token volume, KV-slab
     # streaming epochs, and requests requeued by serve-through-failure
@@ -40,6 +48,9 @@ _COUNTERS = (
     # self-healing coord/wire layer: reconnect-retry activity and
     # detected (checksummed) wire corruption
     "coord_reconnects", "coord_rpc_retries", "wire_cksum_fail",
+    # native-reactor framing desync (a zero-length frame on the wire,
+    # detected on the epoll thread and failed loudly on dispatch)
+    "wire_desync",
     # live-telemetry plane (runtime/telemetry + runtime/flight):
     # samples published into the coord KV, crash dumps written
     "telemetry_samples", "flight_dumps",

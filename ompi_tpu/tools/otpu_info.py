@@ -117,6 +117,13 @@ def main(argv=None) -> int:
                          "STAGES), the stage-clock / sampling-profiler "
                          "MCA vars, and the perf-history file "
                          "otpu_perf reads")
+    ap.add_argument("--progress", action="store_true",
+                    help="Show the progress-engine plane: the "
+                         "registry-enumerated progress vars (native "
+                         "reactor switch, low-priority cadence), the "
+                         "reactor's capability/engagement state, live "
+                         "callback/waiter counts, and the "
+                         "progress_native_* SPC counters")
     ap.add_argument("--quant", action="store_true",
                     help="Show the coll/quant plane: the quantization "
                          "MCA vars (codec block, wire enable, KV "
@@ -258,6 +265,32 @@ def main(argv=None) -> int:
                         f"{DEFAULT_HISTORY} (bench.py --history / "
                         "--ladder append; otpu_perf --diff/--check "
                         "compare)", p))
+
+    if args.all or args.progress:
+        # registry-enumerated like --telemetry/--profile: importing the
+        # engine registers the 'progress' var group; reactor state and
+        # the counter names come from their declared tables, never a
+        # hand-kept list
+        from ompi_tpu.runtime import progress as _progress
+        from ompi_tpu.runtime import reactor as _reactor
+        from ompi_tpu.runtime import spc as _pspc
+
+        for var in registry.all_vars("progress"):
+            out.append(_fmt(f"progress var {var.name}",
+                            f"{var.value!r} — {var.help}", p))
+        for key, val in sorted(_reactor.stats().items()):
+            out.append(_fmt(f"progress reactor {key}", val, p))
+        from ompi_tpu.mca.threads import native as _threads_native
+
+        for key, val in sorted(_threads_native.substrate().items()):
+            out.append(_fmt(f"progress substrate {key}", val, p))
+        for key, val in sorted(_progress._telemetry_stats().items()):
+            out.append(_fmt(f"progress engine {key}", val, p))
+        for cname in _pspc._COUNTERS:
+            if cname.startswith(("progress_native", "fastpath_native")):
+                out.append(_fmt(f"progress counter {cname}",
+                                "SPC counter (see --pvars for values)",
+                                p))
 
     if args.all or args.quant:
         # registry-enumerated like --telemetry/--profile: the coll/

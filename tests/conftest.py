@@ -22,6 +22,26 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def prewarm_native():
+    """Build (or load) the otpu_native .so ONCE at session start.
+
+    The first ``native.available()`` call may pay a ~2-minute g++
+    compile into OTPU_NATIVE_CACHE; letting that land inside whichever
+    test happens to call it first skews timing-sensitive tests (the
+    bench-pin windows in test_perf_guard) and double-compiles under
+    multi-process launches.  Warming here makes every later call a
+    cheap cache hit — including the tpurun children, which inherit the
+    populated cache directory."""
+    if os.environ.get("OTPU_NATIVE_DISABLE"):
+        yield
+        return
+    from ompi_tpu import native
+
+    native.available()
+    yield
+
+
 @pytest.fixture
 def fresh_registry():
     """Isolated var registry state for config-system tests."""

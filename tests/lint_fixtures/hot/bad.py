@@ -20,3 +20,20 @@ def bad_raise(buf):
     if len(buf) > 1 << 20:
         raise struct.error("too big")   # BAD: bare struct.error
     return buf
+
+
+_REC = struct.Struct("<IiB")
+
+
+@hot_path
+def bad_drain(buf, n, byfd):
+    # reactor-drain twin gone wrong: per-record serialization + string
+    # building inside the per-tick loop
+    pos = 0
+    while pos < n:
+        plen, fd, etype = _REC.unpack_from(buf, pos)
+        pos += _REC.size
+        meta = pickle.loads(buf[pos:pos + plen])    # BAD: pickle per record
+        byfd[fd] = f"record {etype}: {meta}"        # BAD: f-string
+        pos += plen
+    return byfd

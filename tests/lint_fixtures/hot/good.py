@@ -26,5 +26,24 @@ def drains(queue):
         return note
 
 
+_REC = struct.Struct("<IiB")
+
+
+@hot_path
+def drain_records(buf, n, byfd):
+    # reactor-drain shape: preallocated struct unpack + dict-get
+    # dispatch, zero allocation sugar per record
+    events = 0
+    pos = 0
+    while pos < n:
+        plen, fd, etype = _REC.unpack_from(buf, pos)
+        pos += _REC.size
+        handler = byfd.get(fd)
+        if handler is not None:
+            events += handler(etype, buf[pos:pos + plen])
+        pos += plen
+    return events
+
+
 def untagged_slow(meta):
     return pickle.dumps(meta)           # not @hot_path: no budget

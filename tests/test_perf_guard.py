@@ -200,6 +200,37 @@ def test_sanitizer_off_zero_overhead():
         assert any(q.endswith(qual) for q in regs), qual
 
 
+def test_reactor_off_zero_overhead():
+    """otpu_progress_native=0 must be IDENTITY: no reactor thread, no
+    handle, no drain callback on the progress tick path, and drain()
+    itself is a pure-Python two-load early return (no ctypes call ever
+    fires).  The fallback selector lane in btl/tcp is the same code
+    that shipped before the reactor existed."""
+    from ompi_tpu.base.var import registry
+    from ompi_tpu.runtime import progress, reactor, spc
+
+    var = registry.lookup("otpu_progress_native")
+    saved = var.value
+    var.set(False)
+    try:
+        assert not reactor.configured()
+        assert not reactor.engage()              # declines, no side effects
+        assert reactor._handle == 0              # no native object
+        assert not reactor.active()
+        with progress._lock:
+            assert reactor.drain not in progress._callbacks
+            assert reactor.drain not in progress._lp_callbacks
+        spc.init()
+        before = (spc.read("progress_native_drains"),
+                  spc.read("fastpath_native_frags"))
+        assert reactor.drain() == 0              # early return, no ctypes
+        assert (spc.read("progress_native_drains"),
+                spc.read("fastpath_native_frags")) == before
+    finally:
+        var.set(saved)
+        progress.reset_for_testing()
+
+
 def test_weave_off_zero_overhead():
     """With no weave run active (the production state — OTPU_SANITIZE
     off, no explorer), the interleaving instrumentation must cost the

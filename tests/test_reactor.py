@@ -1,0 +1,539 @@
+"""Native-reactor progress engine: the epoll loop in otpu_native owns
+the btl fds (drain, framing, fast-frame parse) and Python only sees
+completed records through one ctypes drain per progress tick.
+
+The tests here pin the tentpole's contracts:
+
+- differential fuzz: the native framing/parsing twin delivers the EXACT
+  frag stream the pure-Python ``_drain``/``_parse_frame`` lane does,
+  over fuzzed split boundaries and mixed fast/pickle/crc-armed headers;
+- lane routing: anything that is not a plain fast header (crc bits,
+  pickle, unknown kind byte) reaches Python as verbatim RAW bytes;
+- the completed-record plumbing: doorbell drain, writability records,
+  oversize parking, EOF, desync, idle-wait wakeup via the notify fd;
+- engagement gating: otpu_progress_native=0 and the sanitizer keep the
+  reactor off entirely;
+- progress.idle_wait survives a waiter unregistered/closed mid-wait
+  (the regression that used to burn the full timeout in a blind sleep).
+
+Everything skips cleanly when the native toolchain is unavailable —
+the pure-Python lane is the behavior baseline, not a degraded mode.
+"""
+import os
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+import zlib
+from functools import partial
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mca.btl import tcp as tcp_mod
+from ompi_tpu.mca.btl.base import CTL, FRAG, MATCH, RNDV, Frag
+from ompi_tpu.runtime import progress, reactor
+
+needs_reactor = pytest.mark.skipif(
+    not reactor.available(),
+    reason="otpu_native reactor not built in this environment")
+
+_LEN = tcp_mod._LEN
+_FAST = tcp_mod._FAST
+_CKSUM = tcp_mod._CKSUM
+
+
+@pytest.fixture
+def clean_engine():
+    """Every test leaves the process-wide reactor/progress singletons
+    exactly as it found them (instance teardown's reset path)."""
+    yield
+    progress.reset_for_testing()
+
+
+def encode(frag: Frag, cksum: bool = False) -> bytes:
+    """Wire-encode one fragment exactly the way TcpBtl.send frames it
+    (the test_btl_wire encode twin, plus the crc-armed variant)."""
+    payload = frag.data
+    if not isinstance(payload, (bytes, bytearray, memoryview)):
+        payload = memoryview(payload)
+    if isinstance(payload, memoryview) and (
+            payload.ndim != 1 or payload.itemsize != 1):
+        payload = payload.cast("B")
+    hdr = tcp_mod._fast_header(frag)
+    if hdr is not None:
+        htype = tcp_mod._H_FAST
+    else:
+        hdr = pickle.dumps(
+            (frag.cid, frag.src, frag.dst, frag.tag, frag.seq, frag.kind,
+             frag.total_len, frag.offset, frag.meta),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        hdr = _LEN.pack(len(hdr)) + hdr
+        htype = tcp_mod._H_PICKLE
+    if cksum:
+        crc = zlib.crc32(payload, zlib.crc32(hdr))
+        fl = 1 + _CKSUM.size + len(hdr) + len(payload)
+        return (_LEN.pack(fl) + bytes((htype | tcp_mod._H_CK_BASE,))
+                + _CKSUM.pack(crc) + hdr + bytes(payload))
+    fl = 1 + len(hdr) + len(payload)
+    return _LEN.pack(fl) + bytes((htype,)) + hdr + bytes(payload)
+
+
+def _mixed_frags(rng: random.Random, n=32) -> list:
+    """Fragments alternating fast-header, pickle, and crc-armed lanes."""
+    frags = []
+    for i in range(n):
+        payload = np.frombuffer(
+            bytes(rng.randrange(256)
+                  for _ in range(rng.randrange(0, 300))), np.uint8)
+        pick = i % 4
+        if pick == 0:       # eager MATCH, empty meta -> fast lane
+            f = Frag(3, 0, 1, rng.randrange(1000), i, MATCH, payload,
+                     total_len=len(payload))
+        elif pick == 1:     # FRAG continuation -> fast lane (req_id)
+            f = Frag(3, 1, 0, -1, 0, FRAG, payload,
+                     total_len=1 << 20, offset=rng.randrange(1 << 20),
+                     meta={"req_id": rng.randrange(1 << 40)})
+        elif pick == 2:     # RNDV rich meta -> pickle (RAW lane)
+            f = Frag(3, 0, 1, rng.randrange(1000), i, RNDV, payload,
+                     total_len=len(payload) + 512,
+                     meta={"req_id": i, "window": [1, 2]})
+        else:               # CTL proto -> pickle (RAW lane)
+            f = Frag(3, 1, 0, -1, 0, CTL, payload,
+                     meta={"proto": "ob1_rget_done", "req_id": i})
+        frags.append((f, pick == 3 and i % 8 == 7 or i % 5 == 4))
+    return frags
+
+
+def _own(frag: Frag) -> tuple:
+    return (frag.cid, frag.src, frag.dst, frag.tag, frag.seq, frag.kind,
+            frag.total_len, frag.offset, dict(frag.meta),
+            bytes(memoryview(np.ascontiguousarray(frag.data))))
+
+
+def _stream_pair():
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    b.setblocking(True)
+    return a, b
+
+
+def _drain_until(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        reactor.drain()
+        time.sleep(0.002)
+    assert cond(), "reactor records did not arrive in time"
+
+
+# -- engagement gating -------------------------------------------------
+
+@needs_reactor
+def test_engage_is_idempotent_and_shutdown_resets(clean_engine):
+    assert reactor.engage()
+    assert reactor.active()
+    h = reactor._handle
+    assert reactor.engage()          # second engage: same reactor
+    assert reactor._handle == h
+    assert progress.callback_count() >= 1   # drain rides as a callback
+    reactor.shutdown()
+    assert not reactor.active()
+    assert reactor._handle == 0
+
+
+@needs_reactor
+def test_var_off_keeps_reactor_disengaged(clean_engine):
+    from ompi_tpu.base.var import registry
+
+    var = registry.lookup("otpu_progress_native")
+    saved = var.value
+    var.set(False)
+    try:
+        assert not reactor.configured()
+        assert not reactor.engage()
+        assert not reactor.active()
+    finally:
+        var.set(saved)
+
+
+@needs_reactor
+def test_sanitizer_keeps_reactor_disengaged(clean_engine, monkeypatch):
+    from ompi_tpu.runtime import sanitizer
+
+    monkeypatch.setattr(sanitizer, "enabled", True)
+    assert not reactor.engage()
+    assert not reactor.active()
+
+
+# -- differential fuzz: native framing twin vs the Python lane ---------
+
+@needs_reactor
+@pytest.mark.parametrize("seed", range(4))
+def test_differential_fuzz_native_vs_python(clean_engine, seed):
+    """The acceptance fuzz: identical byte streams — mixed fast/pickle
+    headers, crc-armed frames, fuzzed split boundaries — through the
+    native reactor and through the pure-Python ``_drain`` twin must
+    deliver byte-identical frag streams."""
+    rng = random.Random(seed)
+    frags = _mixed_frags(rng)
+    stream = b"".join(encode(f, cksum=ck) for f, ck in frags)
+
+    # Python reference lane
+    btl_py = tcp_mod.TcpBtl()
+    got_py = []
+    btl_py.set_recv_callback(lambda f: got_py.append(_own(f)))
+    pyconn = tcp_mod._Conn(None, rank=7)
+    pos = 0
+    while pos < len(stream):
+        step = rng.choice((1, 2, 3, 5, 7, 13, 64, 1024))
+        pyconn.inbuf += stream[pos:pos + step]
+        pos += step
+        btl_py._drain(pyconn)
+    assert not pyconn.inbuf
+
+    # native reactor lane, same stream re-chunked with the same rng
+    assert reactor.engage()
+    a, b = _stream_pair()
+    btl_nat = tcp_mod.TcpBtl()
+    got_nat = []
+    btl_nat.set_recv_callback(lambda f: got_nat.append(_own(f)))
+    conn = tcp_mod._Conn(a, rank=7)
+    conn.fd = a.fileno()
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       partial(btl_nat._reactor_event, conn))
+    rng2 = random.Random(seed + 1000)
+
+    def feed():
+        p = 0
+        while p < len(stream):
+            step = rng2.choice((1, 2, 3, 5, 7, 13, 64, 1024))
+            b.sendall(stream[p:p + step])
+            p += step
+            if step < 8:
+                time.sleep(0)   # let the epoll thread see odd splits
+        b.close()
+
+    t = threading.Thread(target=feed)
+    t.start()
+    _drain_until(lambda: len(got_nat) >= len(got_py))
+    t.join()
+    reactor.remove(a.fileno())
+    a.close()
+
+    assert len(got_py) == len(frags)
+    assert got_nat == got_py
+
+
+@needs_reactor
+def test_unknown_kind_byte_diverts_to_raw_lane(clean_engine):
+    """A fast-header frame whose kind byte is outside the known codes
+    must NOT be parsed natively: it arrives as verbatim RAW bytes so
+    the Python lane fails on it exactly like the fallback would."""
+    assert reactor.engage()
+    hdr = _FAST.pack(7, 1, 2, 42, 9, 6, 5, 0, -1)   # kind code 6: unknown
+    frame = _LEN.pack(1 + len(hdr) + 5) + bytes((1,)) + hdr + b"xxxxx"
+    a, b = _stream_pair()
+    records = []
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       lambda et, pl: records.append((et, bytes(pl))) or 1)
+    b.sendall(frame)
+    _drain_until(lambda: records)
+    assert records[0][0] == reactor.REC_RAW
+    assert records[0][1] == frame[_LEN.size:]
+    # and the Python parse of those bytes raises the same KeyError the
+    # selector lane raises for an unknown kind code
+    btl = tcp_mod.TcpBtl()
+    with pytest.raises(KeyError):
+        btl._parse_frame(tcp_mod._Conn(None, rank=1), records[0][1])
+    reactor.remove(a.fileno())
+    a.close()
+    b.close()
+
+
+@needs_reactor
+def test_crc_armed_frames_take_raw_lane_and_verify(clean_engine):
+    assert reactor.engage()
+    payload = np.arange(64, dtype=np.uint8)
+    frame = encode(Frag(3, 0, 1, 5, 9, MATCH, payload, total_len=64),
+                   cksum=True)
+    a, b = _stream_pair()
+    records = []
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       lambda et, pl: records.append((et, bytes(pl))) or 1)
+    b.sendall(frame)
+    _drain_until(lambda: records)
+    assert records[0][0] == reactor.REC_RAW     # crc bit -> slow lane
+    btl = tcp_mod.TcpBtl()
+    frag = btl._parse_frame(tcp_mod._Conn(None, rank=0), records[0][1])
+    assert bytes(memoryview(frag.data)) == bytes(payload)
+    reactor.remove(a.fileno())
+    a.close()
+    b.close()
+
+
+# -- completed-record plumbing -----------------------------------------
+
+@needs_reactor
+def test_oversize_frame_parks_and_resumes(clean_engine):
+    """A frame above the oversize limit parks its stream; take_oversize
+    fetches the whole frame and the stream resumes with the trailing
+    bytes intact."""
+    assert reactor.engage()
+    big = os.urandom(5 << 20)        # > the 4MB default oversize limit
+    bighdr = _FAST.pack(7, 1, 2, 42, 10, 0, len(big), 0, -1)
+    bigframe = _LEN.pack(1 + len(bighdr) + len(big)) \
+        + bytes((1,)) + bighdr + big
+    tail = encode(Frag(3, 0, 1, 5, 11, MATCH,
+                       np.arange(9, dtype=np.uint8), total_len=9))
+    a, b = _stream_pair()
+    records = []
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       lambda et, pl: records.append((et, bytes(pl))) or 1)
+    t = threading.Thread(target=lambda: b.sendall(bigframe + tail))
+    t.start()
+    _drain_until(lambda: records)
+    assert records[0][0] == reactor.REC_OVERSIZE
+    (flen,) = struct.unpack("<Q", records[0][1])
+    assert flen == len(bigframe) - _LEN.size
+    got = reactor.take_oversize(a.fileno())
+    assert bytes(got) == bigframe[_LEN.size:]
+    _drain_until(lambda: len(records) >= 2)
+    t.join()
+    assert records[1][0] == reactor.REC_FAST
+    assert records[1][1] == tail[_LEN.size + 1:]
+    reactor.remove(a.fileno())
+    a.close()
+    b.close()
+
+
+@needs_reactor
+def test_desync_record_fails_loudly(clean_engine):
+    """A zero-length frame on the wire is a framing desync: the reactor
+    emits DESYNC and the btl dispatch raises SanitizeError (the
+    selector lane's sanitizer does the same check in _on_bytes)."""
+    from ompi_tpu.runtime import sanitizer
+
+    assert reactor.engage()
+    a, b = _stream_pair()
+    records = []
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       lambda et, pl: records.append((et, bytes(pl))) or 1)
+    b.sendall(_LEN.pack(0))
+    _drain_until(lambda: records)
+    assert records[0][0] == reactor.REC_DESYNC
+    btl = tcp_mod.TcpBtl()
+    conn = tcp_mod._Conn(a, rank=3)
+    with pytest.raises(sanitizer.SanitizeError):
+        btl._reactor_event(conn, reactor.REC_DESYNC, records[0][1])
+    reactor.remove(a.fileno())
+    a.close()
+    b.close()
+
+
+@needs_reactor
+def test_doorbell_drain_mode_consumes_dgrams(clean_engine):
+    """MODE_DRAIN: the epoll thread consumes doorbell dgrams (the sm
+    wakeup) and surfaces one DOORBELL record — Python never loops on
+    recv(512)."""
+    assert reactor.engage()
+    rx, tx = socket.socketpair(socket.AF_UNIX, socket.SOCK_DGRAM)
+    rx.setblocking(False)
+    records = []
+    assert reactor.add(rx.fileno(), reactor.MODE_DRAIN,
+                       lambda et, pl: records.append(et) or 1)
+    for _ in range(3):
+        tx.send(b"x")
+    _drain_until(lambda: records)
+    assert records[0] == reactor.REC_DOORBELL
+    time.sleep(0.05)
+    with pytest.raises(BlockingIOError):
+        rx.recv(512)                 # dgrams already consumed natively
+    reactor.remove(rx.fileno())
+    rx.close()
+    tx.close()
+
+
+@needs_reactor
+def test_writable_record_after_want_write(clean_engine):
+    """EPOLLOUT interest is oneshot-by-contract: one WRITABLE record
+    per want_write arm, auto-cleared on fire."""
+    assert reactor.engage()
+    a, b = _stream_pair()
+    records = []
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       lambda et, pl: records.append(et) or 1)
+    assert reactor.want_write(a.fileno(), True)
+    _drain_until(lambda: records)
+    assert records[0] == reactor.REC_WRITABLE
+    time.sleep(0.05)
+    reactor.drain()
+    assert records.count(reactor.REC_WRITABLE) == 1   # interest cleared
+    reactor.remove(a.fileno())
+    a.close()
+    b.close()
+
+
+@needs_reactor
+def test_notify_fd_wakes_idle_wait(clean_engine):
+    assert reactor.engage()
+    a, b = _stream_pair()
+    got = []
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       lambda et, pl: got.append(et) or 1)
+    reactor.drain()                  # settle any startup records
+
+    def poke():
+        time.sleep(0.1)
+        b.sendall(encode(Frag(3, 0, 1, 5, 9, MATCH,
+                              np.arange(4, dtype=np.uint8), total_len=4)))
+
+    t = threading.Thread(target=poke)
+    t.start()
+    t0 = time.monotonic()
+    woke = progress.idle_wait(3.0)
+    dt = time.monotonic() - t0
+    t.join()
+    assert woke, "native completion must wake the idle waiter"
+    assert dt < 1.0, f"woke after {dt:.3f}s — notify fd not registered?"
+    reactor.remove(a.fileno())
+    a.close()
+    b.close()
+
+
+@needs_reactor
+def test_eof_record_and_stats(clean_engine):
+    assert reactor.engage()
+    a, b = _stream_pair()
+    records = []
+    assert reactor.add(a.fileno(), reactor.MODE_STREAM,
+                       lambda et, pl: records.append(et) or 1)
+    b.close()
+    _drain_until(lambda: records)
+    assert records[-1] == reactor.REC_EOF
+    st = reactor.stats()
+    assert st["active"] and st["records"] >= 1
+    reactor.remove(a.fileno())
+    a.close()
+
+
+# -- progress.idle_wait teardown race (the satellite regression) -------
+
+def test_idle_wait_retries_after_waiter_unregistered_mid_wait():
+    """A waiter whose fd dies mid-select must not burn the full timeout:
+    idle_wait prunes the dead registration and keeps waiting on the
+    survivors, which can still wake it early."""
+    dead_a, dead_b = socket.socketpair()
+    live_a, live_b = socket.socketpair()
+    progress.register_waiter(dead_a)
+    progress.register_waiter(live_a)
+
+    def chaos_then_wake():
+        time.sleep(0.1)
+        # teardown race: the fd closes while idle_wait is in select()
+        dead_a.close()
+        dead_b.close()
+        time.sleep(0.1)
+        live_b.sendall(b"!")
+
+    t = threading.Thread(target=chaos_then_wake)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        woke = progress.idle_wait(5.0)
+        dt = time.monotonic() - t0
+        assert woke, "the surviving waiter's byte must wake idle_wait"
+        assert dt < 4.0, \
+            f"idle_wait burned {dt:.2f}s — blind-sleep regression"
+    finally:
+        t.join()
+        progress.unregister_waiter(dead_a)
+        progress.unregister_waiter(live_a)
+        live_a.close()
+        live_b.close()
+
+
+def test_idle_wait_select_oserror_prunes_and_retries(monkeypatch):
+    """Drive the OSError branch directly (selector backends differ in
+    when they raise): the first select blows up, the dead registration
+    is pruned, and the retry on the survivor still wakes early."""
+    live_a, live_b = socket.socketpair()
+    dead_a, dead_b = socket.socketpair()
+    progress.register_waiter(live_a)
+    progress.register_waiter(dead_a)
+    # close the raw fd out from under the selector (what _drop_conn's
+    # concurrent teardown does) so the registration is stale
+    os.close(dead_a.fileno())
+    real_select = progress._waiter_sel.select
+    calls = {"n": 0}
+
+    def flaky_select(timeout=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(9, "Bad file descriptor")
+        return real_select(timeout)
+
+    monkeypatch.setattr(progress._waiter_sel, "select", flaky_select)
+    threading.Timer(0.1, lambda: live_b.sendall(b"!")).start()
+    t0 = time.monotonic()
+    woke = progress.idle_wait(5.0)
+    dt = time.monotonic() - t0
+    assert woke and dt < 4.0, (woke, dt)
+    assert calls["n"] >= 2, "select was not retried after the OSError"
+    monkeypatch.undo()
+    progress.unregister_waiter(live_a)
+    progress.unregister_waiter(dead_a)
+    live_a.close()
+    detached = dead_a.detach()      # fd already closed above
+    dead_b.close()
+    assert detached >= 0
+
+
+def test_idle_wait_all_waiters_dead_sleeps_remaining(monkeypatch):
+    """When every registration is dead the retry loop must degrade to
+    the bounded sleep, never raise or spin."""
+    dead_a, dead_b = socket.socketpair()
+    before = progress._waiter_count
+    progress.register_waiter(dead_a)
+    os.close(dead_a.fileno())
+    real_select = progress._waiter_sel.select
+
+    def flaky_select(timeout=None):
+        raise OSError(9, "Bad file descriptor")
+
+    monkeypatch.setattr(progress._waiter_sel, "select", flaky_select)
+    t0 = time.monotonic()
+    woke = progress.idle_wait(0.3)
+    dt = time.monotonic() - t0
+    assert not woke
+    assert 0.1 < dt < 2.0, dt
+    assert progress._waiter_count == before, "dead waiter was not pruned"
+    monkeypatch.undo()
+    assert real_select is not None
+    dead_a.detach()
+    dead_b.close()
+
+
+# -- fallback lane identity --------------------------------------------
+
+def test_drain_is_identity_when_disengaged():
+    """With no reactor engaged, drain() is two attribute loads and a
+    return — no ctypes, no native call (the perf-guard identity pin
+    leans on this)."""
+    assert not reactor.active()
+    assert reactor.drain() == 0
+
+
+@needs_reactor
+def test_tcp_btl_reports_native_counters(clean_engine):
+    """The spc counters that attribute the two lanes exist and the
+    reactor stats surface through reactor.stats()."""
+    from ompi_tpu.runtime import spc
+
+    spc.init()
+    for name in ("progress_native_drains", "fastpath_native_frags",
+                 "fastpath_native_raw"):
+        assert name in spc._COUNTERS
+    st = reactor.stats()
+    assert {"configured", "available", "active"} <= set(st)

@@ -5,6 +5,8 @@ typed parallel jobs (memcpy / reduce / pack / unpack) matching their
 serial twins, request-style completion handles, and the convertor's
 wide-pack integration.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -47,6 +49,8 @@ def test_native_available_in_ci():
 
     if shutil.which("g++") is None:
         pytest.skip("no C++ toolchain on this host")
+    if os.environ.get("OTPU_NATIVE_DISABLE"):
+        pytest.skip("explicit fallback-lane run (OTPU_NATIVE_DISABLE)")
     assert native_comp.open()
 
 
